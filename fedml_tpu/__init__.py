@@ -1,0 +1,101 @@
+"""fedml_tpu — a TPU-native federated & distributed ML framework.
+
+Brand-new design with the capabilities of the reference FL platform
+(see /root/repo/SURVEY.md): FL simulation where an entire round is one jitted
+SPMD program over a named ``client`` mesh axis; cross-silo/cross-device FL
+with a message-driven FSM at the WAN boundary; pluggable trust/privacy
+(defenses, DP, secure aggregation); an LLM fine-tuning path on XLA FSDP with
+Pallas attention; data/model zoos; federated analytics; observability.
+
+Public API parity (reference ``python/fedml/__init__.py:67+``):
+
+    import fedml_tpu as fedml
+    args = fedml.init()
+    device = fedml.device.get_device(args)
+    dataset, output_dim = fedml.data.load(args)
+    model = fedml.model.create(args, output_dim)
+    fedml.FedMLRunner(args, device, dataset, model).run()
+
+or the one-liner ``fedml_tpu.run_simulation(backend="tpu")``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Any, Optional
+
+import numpy as np
+
+from .arguments import Arguments, add_args, load_arguments
+from .runner import FedMLRunner
+from . import constants
+
+__version__ = "0.1.0"
+
+_logger_configured = False
+
+
+def _setup_logging() -> None:
+    global _logger_configured
+    if not _logger_configured:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="[fedml_tpu] %(asctime)s %(levelname)s %(name)s: %(message)s")
+        _logger_configured = True
+
+
+def init(args: Optional[Arguments] = None, **overrides: Any) -> Arguments:
+    """Parse config + seed RNGs (reference ``__init__.py:67,103-108``).
+
+    With no ``args``, reads ``--cf <yaml>`` from the CLI if present; keyword
+    overrides always win (convenient for tests/notebooks).
+    """
+    _setup_logging()
+    if args is None:
+        cli = add_args()
+        args = load_arguments(cli.yaml_config_file, rank=cli.rank,
+                              role=cli.role, run_id=cli.run_id, **overrides)
+    else:
+        for k, v in overrides.items():
+            setattr(args, k, v)
+    seed = int(getattr(args, "random_seed", 0))
+    random.seed(seed)
+    np.random.seed(seed)
+    return args
+
+
+def run_simulation(backend: str = "tpu", args: Optional[Arguments] = None,
+                   **overrides: Any) -> Any:
+    """One-call simulation entrypoint (reference ``launch_simulation.py:9``)."""
+    from . import data as data_mod
+    from . import model as model_mod
+
+    args = init(args, backend=backend, **overrides)
+    args.training_type = constants.FEDML_TRAINING_PLATFORM_SIMULATION
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    runner = FedMLRunner(args, dataset=fed, model=bundle)
+    return runner.run()
+
+
+def run_cross_silo_server(args: Optional[Arguments] = None, **overrides: Any):
+    args = init(args, **overrides)
+    args.training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+    args.role = "server"
+    from . import data as data_mod
+    from . import model as model_mod
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    return FedMLRunner(args, dataset=fed, model=bundle).run()
+
+
+def run_cross_silo_client(args: Optional[Arguments] = None, **overrides: Any):
+    args = init(args, **overrides)
+    args.training_type = constants.FEDML_TRAINING_PLATFORM_CROSS_SILO
+    args.role = "client"
+    from . import data as data_mod
+    from . import model as model_mod
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    return FedMLRunner(args, dataset=fed, model=bundle).run()

@@ -1,0 +1,206 @@
+"""Typed flat configuration.
+
+Reproduces the load-bearing semantics of the reference's config system
+(``python/fedml/arguments.py:36,75,187,193``): a YAML file with sections
+(``common_args``, ``data_args``, ``model_args``, ``train_args``, ...) is
+flattened into ONE attribute namespace so every component reads ``args.X``.
+Differences from the reference, by design:
+
+* a dataclass-backed schema with defaults + type coercion instead of a
+  free-form attribute bag (unknown keys are still kept, so user extensions
+  and reference YAMLs work unchanged);
+* per-silo override files (``data_silo_config``) are resolved here, mirroring
+  ``__init__.py:188-212`` of the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from .constants import (
+    FEDML_SIMULATION_BACKEND_ALIASES,
+    FEDML_TRAINING_PLATFORM_SIMULATION,
+)
+
+# Schema of known fields: (default, type). Everything else is passed through
+# untyped. Types are used for coercion when values arrive as strings (CLI).
+_SCHEMA: Dict[str, Any] = {
+    # common_args
+    "training_type": FEDML_TRAINING_PLATFORM_SIMULATION,
+    "random_seed": 0,
+    "scenario": "horizontal",
+    "config_version": "release",
+    "run_id": "0",
+    "using_mlops": False,
+    # data_args
+    "dataset": "synthetic_mnist",
+    "data_cache_dir": "~/.cache/fedml_tpu/data",
+    "partition_method": "hetero",
+    "partition_alpha": 0.5,
+    # model_args
+    "model": "lr",
+    # train_args
+    "federated_optimizer": "FedAvg",
+    "client_id_list": None,
+    "client_num_in_total": 8,
+    "client_num_per_round": 8,
+    "comm_round": 10,
+    "epochs": 1,
+    "batch_size": 32,
+    "client_optimizer": "sgd",
+    "learning_rate": 0.03,
+    "weight_decay": 0.0,
+    "momentum": 0.0,
+    "server_optimizer": "sgd",
+    "server_lr": 1.0,
+    "server_momentum": 0.9,
+    "fedprox_mu": 0.1,
+    "feddyn_alpha": 0.01,
+    # validation_args
+    "frequency_of_the_test": 5,
+    # device_args / tpu_args
+    "worker_num": None,          # devices used; defaults to local device count
+    "using_gpu": True,
+    "device_type": "tpu",
+    "mesh_shape": None,          # e.g. {"client": 8} or {"client": 4, "fsdp": 2}
+    "clients_per_device": None,  # schedule width; derived if None
+    "precision": "float32",      # or "bfloat16" for the compute path
+    # comm_args
+    "backend": "tpu",
+    "grpc_ipconfig_path": None,
+    "mqtt_config_path": None,
+    # tracking_args
+    "enable_wandb": False,
+    "log_file_dir": "~/.cache/fedml_tpu/logs",
+    "checkpoint_dir": None,
+    "checkpoint_every_rounds": 0,  # 0 = off
+    # security/privacy (consulted by hook chain; parity with L4 singletons)
+    "enable_attack": False,
+    "attack_type": None,
+    "enable_defense": False,
+    "defense_type": None,
+    "enable_dp": False,
+    "dp_mechanism": "gaussian",
+    "enable_dp_ldp": False,
+    "enable_secure_agg": False,
+    "enable_fhe": False,
+}
+
+
+class Arguments:
+    """Flat config namespace. Known keys get defaults from ``_SCHEMA``;
+    unknown keys from the YAML are attached as-is (reference
+    ``set_attr_from_config`` ``arguments.py:187-190``)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None, **overrides: Any):
+        for key, default in _SCHEMA.items():
+            setattr(self, key, default)
+        merged: Dict[str, Any] = {}
+        if config:
+            merged.update(_flatten_sections(config))
+        merged.update(overrides)
+        for key, value in merged.items():
+            setattr(self, key, _coerce(key, value))
+        self._finalize()
+
+    def _finalize(self) -> None:
+        backend = str(getattr(self, "backend", "tpu")).lower()
+        self.backend = FEDML_SIMULATION_BACKEND_ALIASES.get(backend, backend)
+        if self.client_num_per_round > self.client_num_in_total:
+            self.client_num_per_round = self.client_num_in_total
+        for key in ("data_cache_dir", "log_file_dir", "checkpoint_dir"):
+            val = getattr(self, key, None)
+            if isinstance(val, str):
+                setattr(self, key, os.path.expanduser(val))
+
+    # dict-style helpers used across the framework
+    def get(self, key: str, default: Any = None) -> Any:
+        return getattr(self, key, default)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+
+    def __repr__(self) -> str:  # keep logs readable
+        keys = sorted(self.to_dict())
+        return "Arguments(" + ", ".join(f"{k}={getattr(self, k)!r}" for k in keys) + ")"
+
+
+_SECTION_SUFFIX = "_args"
+
+
+def _flatten_sections(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten ``{section_args: {k: v}}`` into ``{k: v}``; non-section keys
+    pass through. Later sections win on duplicate keys, matching the
+    reference's setattr order."""
+    flat: Dict[str, Any] = {}
+    for key, value in config.items():
+        if key.endswith(_SECTION_SUFFIX) and isinstance(value, dict):
+            flat.update(value)
+        else:
+            flat[key] = value
+    return flat
+
+
+def _coerce(key: str, value: Any) -> Any:
+    default = _SCHEMA.get(key)
+    if default is None or value is None:
+        return value
+    ty = type(default)
+    if isinstance(value, ty):
+        return value
+    try:
+        if ty is bool and isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return ty(value)
+    except (TypeError, ValueError):
+        return value
+
+
+def load_arguments(
+    config_path: Optional[str] = None,
+    rank: int = 0,
+    role: Optional[str] = None,
+    **overrides: Any,
+) -> Arguments:
+    """Load YAML config (if given) → flat ``Arguments``.
+
+    Mirrors ``load_arguments`` (reference ``arguments.py:193``) including the
+    per-silo override files: if the YAML names ``data_silo_config`` (a list of
+    YAML paths) and ``rank >= 1``, the rank-specific file is merged on top
+    (reference ``__init__.py:188-212``).
+    """
+    config: Dict[str, Any] = {}
+    if config_path:
+        with open(config_path, "r") as f:
+            config = yaml.safe_load(f) or {}
+    args = Arguments(config, **overrides)
+    args.rank = rank
+    if role is not None:
+        args.role = role
+    silo_configs: Optional[List[str]] = getattr(args, "data_silo_config", None)
+    if silo_configs and rank >= 1 and rank - 1 < len(silo_configs):
+        base = os.path.dirname(os.path.abspath(config_path)) if config_path else "."
+        silo_path = os.path.join(base, silo_configs[rank - 1])
+        with open(silo_path, "r") as f:
+            silo_cfg = yaml.safe_load(f) or {}
+        for key, value in _flatten_sections(silo_cfg).items():
+            setattr(args, key, _coerce(key, value))
+        args._finalize()
+    return args
+
+
+def add_args() -> argparse.Namespace:
+    """Bootstrap CLI flags (reference ``arguments.py:36-72``)."""
+    parser = argparse.ArgumentParser(description="fedml_tpu")
+    parser.add_argument("--cf", "--config_file", dest="yaml_config_file",
+                        type=str, default=None, help="yaml configuration file")
+    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--role", type=str, default="client")
+    parser.add_argument("--run_id", type=str, default="0")
+    parser.add_argument("--run_device_id", type=str, default="0")
+    known, _ = parser.parse_known_args()
+    return known

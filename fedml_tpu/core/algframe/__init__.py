@@ -1,0 +1,10 @@
+from .types import ClientData, ClientOutput, TrainHyper
+from .client_trainer import (TrainerSpec, ClassificationTrainer,
+                             RegressionTrainer, make_inner_optimizer)
+from .local_training import run_local_sgd, evaluate
+from .params import Params, Context
+
+__all__ = ["ClientData", "ClientOutput", "TrainHyper", "TrainerSpec",
+           "ClassificationTrainer", "RegressionTrainer",
+           "make_inner_optimizer", "run_local_sgd", "evaluate",
+           "Params", "Context"]

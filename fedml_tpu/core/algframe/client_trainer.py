@@ -1,0 +1,144 @@
+"""Client-side trainer specs as pure functions.
+
+Parity target: ``ClientTrainer`` ABC (reference
+``core/alg_frame/client_trainer.py:10`` — ``get/set_model_params``, ``train``,
+``test``) and the default concrete trainers
+(``ml/trainer/my_model_trainer_classification.py:14`` train loop :21-77).
+
+A trainer here is a *spec*: ``loss(params, batch, rng) -> (loss, aux)`` and
+``eval_stats(params, batch) -> dict of sums``. The local SGD loop itself lives
+in ``local_training.py`` and is shared by every federated optimizer; get/set
+of model params is replaced by pytrees flowing through function arguments.
+The reference's before/after-training attack/DP hooks
+(``client_trainer.py:61,80``) map to the engine-level hook chain in
+``server_aggregator.py`` and ``trust/``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+PyTree = Any
+Batch = Dict[str, jnp.ndarray]  # {"x", "y", "mask"}
+
+
+class TrainerSpec:
+    """Pure-function trainer: subclass or compose to customize the loss.
+
+    ``apply_fn(params, x, rng=...)`` is the model forward (flax ``apply``).
+    """
+
+    def __init__(self, apply_fn: Callable[..., jnp.ndarray]):
+        self.apply_fn = apply_fn
+
+    def loss(self, params: PyTree, batch: Batch, rng: jax.Array
+             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        raise NotImplementedError
+
+    def eval_stats(self, params: PyTree, batch: Batch) -> Dict[str, jnp.ndarray]:
+        raise NotImplementedError
+
+
+class ClassificationTrainer(TrainerSpec):
+    """Cross-entropy classification (``ModelTrainerCLS``,
+    ``my_model_trainer_classification.py:14``). Masked mean over real samples
+    so padded slots contribute nothing."""
+
+    def loss(self, params, batch, rng):
+        logits = self.apply_fn(params, batch["x"], rng=rng, train=True)
+        labels = batch["y"].astype(jnp.int32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        mask = batch["mask"].astype(per_ex.dtype)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        loss = jnp.sum(per_ex * mask) / denom
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels) * mask)
+        return loss, {"loss_sum": jnp.sum(per_ex * mask),
+                      "correct": correct, "count": jnp.sum(mask)}
+
+    def eval_stats(self, params, batch):
+        logits = self.apply_fn(params, batch["x"], train=False)
+        labels = batch["y"].astype(jnp.int32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        mask = batch["mask"].astype(per_ex.dtype)
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels) * mask)
+        return {"loss_sum": jnp.sum(per_ex * mask), "correct": correct,
+                "count": jnp.sum(mask)}
+
+
+class SequenceTrainer(TrainerSpec):
+    """Per-token cross-entropy for next-word-prediction tasks (reference
+    ``my_model_trainer_nwp.py``): labels [bs, L], logits [bs, L, V]; the
+    per-sample mask broadcasts over tokens."""
+
+    def loss(self, params, batch, rng):
+        logits = self.apply_fn(params, batch["x"], rng=rng, train=True)
+        labels = batch["y"].astype(jnp.int32)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        mask = batch["mask"].astype(per_tok.dtype)[:, None]  # [bs,1] over [bs,L]
+        tok_count = jnp.sum(mask * jnp.ones_like(per_tok))
+        denom = jnp.maximum(tok_count, 1.0)
+        loss = jnp.sum(per_tok * mask) / denom
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels) * mask)
+        return loss, {"loss_sum": jnp.sum(per_tok * mask),
+                      "correct": correct, "count": tok_count}
+
+    def eval_stats(self, params, batch):
+        logits = self.apply_fn(params, batch["x"], train=False)
+        labels = batch["y"].astype(jnp.int32)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        mask = batch["mask"].astype(per_tok.dtype)[:, None]
+        tok_count = jnp.sum(mask * jnp.ones_like(per_tok))
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels) * mask)
+        return {"loss_sum": jnp.sum(per_tok * mask), "correct": correct,
+                "count": tok_count}
+
+
+class RegressionTrainer(TrainerSpec):
+    """MSE regression (covers the reference's tag-prediction style trainers,
+    ``my_model_trainer_tag_prediction.py``)."""
+
+    def loss(self, params, batch, rng):
+        preds = self.apply_fn(params, batch["x"], rng=rng, train=True)
+        labels = batch["y"].astype(preds.dtype)
+        if preds.ndim > labels.ndim:
+            labels = labels[..., None]
+        per_ex = jnp.mean((preds - labels) ** 2, axis=-1)
+        mask = batch["mask"].astype(per_ex.dtype)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        loss = jnp.sum(per_ex * mask) / denom
+        return loss, {"loss_sum": jnp.sum(per_ex * mask),
+                      "correct": jnp.zeros(()), "count": jnp.sum(mask)}
+
+    def eval_stats(self, params, batch):
+        preds = self.apply_fn(params, batch["x"], train=False)
+        labels = batch["y"].astype(preds.dtype)
+        if preds.ndim > labels.ndim:
+            labels = labels[..., None]
+        per_ex = jnp.mean((preds - labels) ** 2, axis=-1)
+        mask = batch["mask"].astype(per_ex.dtype)
+        return {"loss_sum": jnp.sum(per_ex * mask),
+                "correct": jnp.zeros(()), "count": jnp.sum(mask)}
+
+
+def make_inner_optimizer(name: str, learning_rate, momentum: float = 0.0,
+                         weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """The client's inner optimizer (reference: torch SGD/Adam built in the
+    trainer, ``my_model_trainer_classification.py:21-40``)."""
+    name = (name or "sgd").lower()
+    if name == "adamw":
+        # adamw handles decoupled decay itself — do not also add_decayed_weights
+        return optax.adamw(learning_rate, weight_decay=weight_decay)
+    txs = []
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay))
+    if name == "sgd":
+        txs.append(optax.sgd(learning_rate, momentum=momentum or None))
+    elif name == "adam":
+        txs.append(optax.adam(learning_rate))
+    else:
+        raise ValueError(f"unknown client_optimizer {name!r}")
+    return optax.chain(*txs)

@@ -1,0 +1,65 @@
+"""Core pytree datatypes of the algorithm frame.
+
+The reference passes model state-dicts + ``(num_samples, params)`` tuples
+between ``ClientTrainer`` and ``ServerAggregator``
+(``core/alg_frame/client_trainer.py``, ``server_aggregator.py``,
+``ml/aggregator/agg_operator.py:8-30``). Here the equivalents are typed
+pytrees so an entire round can flow through ``jit``/``shard_map``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from flax import struct
+
+PyTree = Any
+
+
+@struct.dataclass
+class ClientData:
+    """One client's local dataset, padded to a static shape.
+
+    ``x``: [n_batches, batch_size, ...features]
+    ``y``: [n_batches, batch_size] (int labels) or [..., dim] for regression
+    ``mask``: [n_batches, batch_size] — 1.0 for real samples, 0.0 for padding
+    ``num_samples``: scalar float — the aggregation weight ``n_k``
+    (reference ``fedavg_api.py:144``: weights are post-sampling ``n_k/Σn``).
+
+    Padding+masking is how ragged per-client datasets become jit-compatible
+    (SURVEY §7 "hard parts": per-client data heterogeneity inside jit).
+    """
+    x: jnp.ndarray
+    y: jnp.ndarray
+    mask: jnp.ndarray
+    num_samples: jnp.ndarray
+
+
+@struct.dataclass
+class ClientOutput:
+    """What one simulated client returns from local training.
+
+    ``update``: pytree delta (local_params − global_params). Delta form makes
+    FedOpt/SCAFFOLD/FedNova server transforms uniform and keeps secure
+    aggregation / DP noise addition linear.
+    ``weight``: scalar aggregation weight (``n_k``).
+    ``client_state``: persistent per-client optimizer state (SCAFFOLD control
+    variate ``c_i``, FedDyn ``h_i`` — empty dict for stateless optimizers).
+    ``extras``: optimizer-specific auxiliary reductions that must ride the
+    same psum (e.g. SCAFFOLD's Δc, FedNova's normalization coefficients).
+    ``metrics``: scalar training metrics (summed/averaged by the engine).
+    """
+    update: PyTree
+    weight: jnp.ndarray
+    client_state: PyTree
+    extras: Dict[str, Any]
+    metrics: Dict[str, jnp.ndarray]
+
+
+@struct.dataclass
+class TrainHyper:
+    """Static-ish per-round hyperparameters threaded into local training."""
+    learning_rate: jnp.ndarray
+    epochs: int = struct.field(pytree_node=False, default=1)
+    round_idx: jnp.ndarray = struct.field(default_factory=lambda: jnp.int32(0))

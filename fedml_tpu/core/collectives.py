@@ -1,0 +1,129 @@
+"""In-pod collective primitives over named mesh axes.
+
+These are the TPU-native equivalents of the reference's NCCL helpers
+(``nccl/base_framework/common.py:180-228``: ``broadcast_model_state``,
+``reduce`` of pre-scaled state-dicts) and of ``FedMLAggOperator.agg``
+(``ml/aggregator/agg_operator.py:8-30``). They are pure functions intended to
+run *inside* ``shard_map`` — the whole FL round compiles to one XLA program
+and the collectives ride ICI.
+
+Everything operates on pytrees of arrays (the JAX analogue of a state-dict).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import AXIS_CLIENT
+
+PyTree = Any
+
+
+def psum_tree(tree: PyTree, axis_name: str = AXIS_CLIENT) -> PyTree:
+    """SUM-reduce a pytree across a named axis (``dist.reduce(SUM)`` of
+    ``common.py:196`` — but symmetric: every participant gets the result,
+    which is what the next round's broadcast needs anyway)."""
+    return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis_name), tree)
+
+
+def pmean_tree(tree: PyTree, axis_name: str = AXIS_CLIENT) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), tree)
+
+
+def weighted_psum_tree(
+    tree: PyTree,
+    weight: jnp.ndarray,
+    axis_name: str = AXIS_CLIENT,
+    total_weight: Optional[jnp.ndarray] = None,
+) -> PyTree:
+    """The FedAvg kernel: pre-scale by ``weight`` then SUM-reduce, dividing by
+    the global weight sum.
+
+    Exactness note (SURVEY §7 "hard parts"): the reference computes client
+    weights ``n_k/Σn`` with the *post-sampling global* denominator
+    (``sp/fedavg/fedavg_api.py:144-159``); we reproduce that by psum-ing the
+    local weights to form Σn unless a precomputed ``total_weight`` is given.
+    """
+    if total_weight is None:
+        total_weight = jax.lax.psum(weight, axis_name)
+    scaled = jax.tree_util.tree_map(
+        lambda x: x * weight.astype(x.dtype), tree)
+    summed = psum_tree(scaled, axis_name)
+    return jax.tree_util.tree_map(
+        lambda x: x / jnp.maximum(total_weight, 1e-12).astype(x.dtype), summed)
+
+
+def all_gather_tree(tree: PyTree, axis_name: str = AXIS_CLIENT,
+                    tiled: bool = False) -> PyTree:
+    """Gather per-shard values into a leading axis on every shard. Used by
+    robust-aggregation defenses (krum/median need all client updates, not a
+    sum — reference ``core/security/defense``)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.all_gather(x, axis_name, tiled=tiled), tree)
+
+
+def ppermute_tree(tree: PyTree, perm, axis_name: str = AXIS_CLIENT) -> PyTree:
+    """Neighbor exchange for decentralized/gossip FL (reference
+    ``simulation/mpi/decentralized_framework``) and ring attention."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.ppermute(x, axis_name, perm), tree)
+
+
+def tree_weighted_average(stacked: PyTree, weights: jnp.ndarray) -> PyTree:
+    """Host/golden-loop aggregation: leaves have a leading client axis;
+    returns the weighted average (``FedMLAggOperator.agg``,
+    ``agg_operator.py:8-30``, engine-neutral)."""
+    norm = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+
+    def avg(leaf):
+        w = norm.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+        return jnp.sum(leaf * w, axis=0)
+
+    return jax.tree_util.tree_map(avg, stacked)
+
+
+def tree_add(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def tree_sub(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: PyTree, s) -> PyTree:
+    return jax.tree_util.tree_map(lambda x: x * jnp.asarray(s, x.dtype), tree)
+
+
+def tree_zeros_like(tree: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_dot(a: PyTree, b: PyTree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_map(
+        lambda x, y: jnp.sum(x.astype(jnp.float32) * y.astype(jnp.float32)), a, b)
+    return jax.tree_util.tree_reduce(jnp.add, leaves, jnp.float32(0.0))
+
+
+def global_norm(tree: PyTree) -> jnp.ndarray:
+    return jnp.sqrt(tree_dot(tree, tree))
+
+
+def tree_flatten_to_vector(tree: PyTree) -> jnp.ndarray:
+    """Flatten a pytree to one vector (reference ``utils/model_utils.py``
+    flatten; used by defenses & secagg masking)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([jnp.ravel(l) for l in leaves]) if leaves else jnp.zeros((0,))
+
+
+def vector_to_tree_like(vec: jnp.ndarray, tree: PyTree) -> PyTree:
+    """Inverse of :func:`tree_flatten_to_vector`."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, off = [], 0
+    for leaf in leaves:
+        n = leaf.size
+        out.append(jnp.reshape(vec[off:off + n], leaf.shape).astype(leaf.dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,12 @@
+"""Horizontal cross-silo runner — full WAN FSM runtime lands with the
+cross-silo milestone; until then the entrypoint fails with a clear message."""
+
+from __future__ import annotations
+
+
+class CrossSiloRunner:
+    def __init__(self, args, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        raise NotImplementedError(
+            "cross-silo runtime is not built yet in this checkout; "
+            "use training_type='simulation' (backends: 'sp', 'tpu')")

@@ -1,0 +1,11 @@
+"""Cross-silo runner dispatch (Octopus parity). Placeholder wiring until the
+WAN runtime lands; gives a clear error instead of ModuleNotFoundError."""
+
+from __future__ import annotations
+
+
+def build_cross_silo_runner(args, dataset, model, client_trainer=None,
+                            server_aggregator=None):
+    from .horizontal.runner import CrossSiloRunner
+    return CrossSiloRunner(args, dataset, model, client_trainer,
+                           server_aggregator)

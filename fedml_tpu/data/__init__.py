@@ -1,0 +1,6 @@
+from .data_loader import load
+from .containers import (FederatedDataset, build_federated_dataset,
+                         from_central_arrays, batchify)
+
+__all__ = ["load", "FederatedDataset", "build_federated_dataset",
+           "from_central_arrays", "batchify"]

@@ -1,0 +1,71 @@
+"""Synthetic datasets (deterministic, no network egress).
+
+Counterpart of the reference's ``data/synthetic_1_1`` loader and the stand-in
+for MNIST/CIFAR-shaped tasks when the real files are absent (the reference
+downloads MNIST from S3, ``data/data_loader.py`` + ``constants.py:36``; this
+environment has zero egress, so loaders fall back here — see
+``data_loader.py``).
+
+The generator is class-prototype + Gaussian noise: linearly separable enough
+for LR to learn, hard enough that accuracy curves are informative.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def make_classification(
+    n_samples: int,
+    n_features: int,
+    n_classes: int,
+    seed: int = 0,
+    noise: float = 1.0,
+    flat: bool = True,
+    image_shape: Tuple[int, ...] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    prototypes = rng.randn(n_classes, n_features).astype(np.float32)
+    y = rng.randint(0, n_classes, size=n_samples).astype(np.int32)
+    x = prototypes[y] + noise * rng.randn(n_samples, n_features).astype(np.float32)
+    if not flat and image_shape is not None:
+        x = x.reshape((n_samples,) + tuple(image_shape))
+    return x, y
+
+
+def synthetic_mnist(n_train: int = 6000, n_test: int = 1000, seed: int = 0,
+                    flat: bool = True):
+    """784-feature, 10-class MNIST-shaped task."""
+    shape = (28, 28, 1)
+    x, y = make_classification(n_train + n_test, 784, 10, seed=seed,
+                               noise=2.0, flat=flat, image_shape=shape)
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def synthetic_cifar10(n_train: int = 5000, n_test: int = 1000, seed: int = 0):
+    """32×32×3, 10-class CIFAR-shaped task (images, for conv models)."""
+    shape = (32, 32, 3)
+    x, y = make_classification(n_train + n_test, 32 * 32 * 3, 10, seed=seed,
+                               noise=3.0, flat=False, image_shape=shape)
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def synthetic_sequences(n_train: int = 2000, n_test: int = 400,
+                        seq_len: int = 32, vocab: int = 64, seed: int = 0):
+    """Next-token-predictable integer sequences (Shakespeare-NWP stand-in):
+    class = parity pattern of a hidden Markov-ish generator."""
+    rng = np.random.RandomState(seed)
+    n = n_train + n_test
+    # order-1 Markov chain with a random sparse transition structure
+    trans = rng.dirichlet(np.ones(vocab) * 0.1, size=vocab).astype(np.float32)
+    seqs = np.zeros((n, seq_len), np.int32)
+    state = rng.randint(0, vocab, size=n)
+    for t in range(seq_len):
+        seqs[:, t] = state
+        u = rng.rand(n, 1)
+        state = (np.cumsum(trans[state], axis=1) < u).sum(axis=1).clip(0, vocab - 1)
+    x = seqs[:, :-1]
+    y = seqs[:, 1:]
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])

@@ -1,0 +1,20 @@
+"""Device discovery (reference ``fedml.device.get_device`` →
+``ml/engine/ml_engine_adapter.py:118,198``). On TPU the "device" handed to
+user code is the mesh itself; single-device callers get ``jax.devices()[0]``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .core.mesh import build_mesh
+
+
+def get_device(args=None):
+    if args is not None and getattr(args, "mesh_shape", None):
+        return build_mesh(args.mesh_shape)
+    return jax.devices()[0]
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,3 @@
+from .model_hub import create, ModelBundle
+
+__all__ = ["create", "ModelBundle"]

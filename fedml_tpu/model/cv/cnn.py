@@ -1,0 +1,55 @@
+"""CNNs for federated vision tasks.
+
+Parity targets: ``model/cv/cnn.py`` (FedAvg-paper CNN for MNIST/FEMNIST) and
+``model/cv/simple_cnn.py`` (CIFAR CNN) of the reference. GroupNorm instead of
+BatchNorm keeps the model purely functional (no mutable batch stats crossing
+jit boundaries) — the reference itself ships GN variants for federated CIFAR
+(``model/cv/resnet_gn.py``) because BN statistics break under non-IID FL.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNFemnist(nn.Module):
+    """The 2-conv CNN from the FedAvg paper (reference ``model/cv/cnn.py``
+    ``CNN_DropOut``)."""
+    num_classes: int = 62
+    dropout: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat input -> image
+            side = int(round((x.shape[-1]) ** 0.5))
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class SimpleCNN(nn.Module):
+    """CIFAR-10 CNN (reference ``model/cv/simple_cnn.py`` — conv-pool x2 +
+    3 dense), GN-normalized."""
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (5, 5))(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5))(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(384)(x))
+        x = nn.relu(nn.Dense(192)(x))
+        return nn.Dense(self.num_classes)(x)

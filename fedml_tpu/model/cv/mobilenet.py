@@ -1,0 +1,98 @@
+"""MobileNetV3-Small (GN variant) — the cross-device/Beehive model family
+(parity: reference ``model/cv/mobilenet_v3.py``, used by the FEMNIST
+hierarchical benchmark). Depthwise convs map to XLA's feature-group convs."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def hard_swish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+def hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(c // self.reduce, 8))(s))
+        s = hard_sigmoid(nn.Dense(c)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    exp: int
+    out: int
+    kernel: int
+    stride: int
+    use_se: bool
+    use_hs: bool
+
+    @nn.compact
+    def __call__(self, x):
+        act = hard_swish if self.use_hs else nn.relu
+        inp = x.shape[-1]
+        y = x
+        if self.exp != inp:
+            y = nn.Conv(self.exp, (1, 1), use_bias=False)(y)
+            y = nn.GroupNorm(num_groups=min(8, self.exp))(y)
+            y = act(y)
+        y = nn.Conv(self.exp, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride),
+                    feature_group_count=self.exp, use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.exp))(y)
+        y = act(y)
+        if self.use_se:
+            y = SqueezeExcite()(y)
+        y = nn.Conv(self.out, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.out))(y)
+        if self.stride == 1 and inp == self.out:
+            y = y + x
+        return y
+
+
+# (kernel, exp, out, SE, HS, stride) — MobileNetV3-Small spec
+_V3_SMALL: Sequence[Tuple[int, int, int, bool, bool, int]] = (
+    (3, 16, 16, True, False, 2),
+    (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1),
+    (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1),
+    (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1),
+    (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2),
+    (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+)
+
+
+class MobileNetV3Small(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat grayscale input
+            side = int(round(x.shape[-1] ** 0.5))
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.Conv(16, (3, 3), strides=(2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = hard_swish(x)
+        for k, e, o, se, hs, s in _V3_SMALL:
+            x = InvertedResidual(e, o, k, s, se, hs)(x)
+        x = nn.Conv(576, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = hard_swish(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = hard_swish(nn.Dense(1024)(x))
+        return nn.Dense(self.num_classes)(x)

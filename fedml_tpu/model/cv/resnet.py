@@ -1,0 +1,91 @@
+"""CIFAR ResNets (ResNet-20/56, 6n+2 layout) and ResNet-18.
+
+Parity targets: ``model/cv/resnet.py`` (resnet56 for the north-star CIFAR-10
+benchmark) and ``model/cv/resnet_gn.py`` of the reference. GroupNorm is the
+default normalization — the reference's own federated configs use GN because
+BatchNorm statistics break under non-IID client data, and GN keeps the model
+a pure function of (params, x), which is what lets a whole FL round jit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    groups: int = 8
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.filters))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.filters))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False)(x)
+            residual = nn.GroupNorm(
+                num_groups=min(self.groups, self.filters))(residual)
+        return nn.relu(residual + y)
+
+
+class CifarResNet(nn.Module):
+    """6n+2 ResNet: stages of n blocks at widths 16/32/64."""
+    num_classes: int
+    blocks_per_stage: int  # n: 3 -> resnet20, 9 -> resnet56
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(self.blocks_per_stage):
+                strides = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNet18(nn.Module):
+    """ImageNet-style ResNet-18 (reference ``model/cv/resnet.py`` resnet18)."""
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        small = x.shape[1] <= 64  # CIFAR-style stem for small images
+        if small:
+            x = nn.Conv(64, (3, 3), use_bias=False)(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu(x)
+        if not small:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, filters in enumerate((64, 128, 256, 512)):
+            for block in range(2):
+                strides = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def create_resnet(name: str, num_classes: int) -> nn.Module:
+    name = name.lower()
+    if name in ("resnet20", "resnet20_gn"):
+        return CifarResNet(num_classes, blocks_per_stage=3)
+    if name in ("resnet56", "resnet56_gn", "resnet"):
+        return CifarResNet(num_classes, blocks_per_stage=9)
+    if name in ("resnet18", "resnet18_gn"):
+        return ResNet18(num_classes)
+    raise ValueError(f"unknown resnet variant {name!r}")

@@ -1,0 +1,59 @@
+"""Model dispatch: ``fedml_tpu.model.create(args, output_dim)``.
+
+Parity target: ``model/model_hub.py:19-88`` of the reference (dispatch on
+``(model, dataset)``). Returns a :class:`ModelBundle` wrapping a flax module
+with init/apply closures the algorithm frame consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class ModelBundle:
+    module: nn.Module
+    name: str
+    _has_dropout: bool = False
+
+    def init(self, rng: jax.Array, sample_input: jnp.ndarray) -> PyTree:
+        variables = self.module.init(rng, sample_input, train=False)
+        return variables["params"]
+
+    def apply(self, params: PyTree, x: jnp.ndarray, rng: Optional[jax.Array] = None,
+              train: bool = False) -> jnp.ndarray:
+        rngs = {"dropout": rng} if (rng is not None and self._has_dropout) else None
+        return self.module.apply({"params": params}, x, train=train, rngs=rngs)
+
+
+def create(args, output_dim: int) -> ModelBundle:
+    name = str(getattr(args, "model", "lr")).lower()
+    from .linear import LogisticRegression, MLP
+    from .cv.cnn import CNNFemnist, SimpleCNN
+
+    if name in ("lr", "logistic_regression"):
+        return ModelBundle(LogisticRegression(output_dim), name)
+    if name == "mlp":
+        return ModelBundle(MLP(output_dim), name, _has_dropout=True)
+    if name in ("cnn", "cnn_dropout", "femnist_cnn"):
+        return ModelBundle(CNNFemnist(output_dim), name, _has_dropout=True)
+    if name in ("simple_cnn", "cifar_cnn"):
+        return ModelBundle(SimpleCNN(output_dim), name)
+    if name.startswith("resnet"):
+        from .cv.resnet import create_resnet
+        return ModelBundle(create_resnet(name, output_dim), name)
+    if name in ("rnn", "lstm", "rnn_shakespeare", "stacked_lstm"):
+        from .nlp.rnn import RNNShakespeare
+        return ModelBundle(RNNShakespeare(vocab_size=output_dim), name)
+    if name.startswith("mobilenet"):
+        from .cv.mobilenet import MobileNetV3Small
+        return ModelBundle(MobileNetV3Small(output_dim), name)
+    raise ValueError(f"unknown model {name!r}")

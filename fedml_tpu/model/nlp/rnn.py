@@ -1,0 +1,42 @@
+"""Federated NLP RNNs.
+
+Parity targets: ``model/nlp/rnn.py`` of the reference —
+``RNN_OriginalFedAvg`` (the FedAvg-paper Shakespeare model: 8-dim embedding,
+2×LSTM(256), per-token vocab logits) and ``RNN_StackOverFlow`` (NWP:
+embedding 96, LSTM 670). Implemented with ``nn.RNN``/``OptimizedLSTMCell`` —
+XLA unrolls the recurrence into one fused scan on TPU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNShakespeare(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: [batch, seq_len] int tokens -> [batch, seq_len, vocab] logits
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        for _ in range(self.num_layers):
+            h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    """Next-word prediction (reference ``RNN_StackOverFlow``)."""
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

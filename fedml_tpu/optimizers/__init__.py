@@ -1,0 +1,5 @@
+from .base import FedOptimizer
+from .registry import create_optimizer, available_optimizers, register
+
+__all__ = ["FedOptimizer", "create_optimizer", "available_optimizers",
+           "register"]

@@ -1,0 +1,103 @@
+"""Federated optimizer protocol: a (client transform, server transform) pair.
+
+This is the functional re-design of the reference's algorithm layer — one
+class per federated optimizer replaces the reference's per-optimizer
+trainer/aggregator/manager triples (``ml/trainer/fedprox_trainer.py``,
+``simulation/sp/*``, ``simulation/mpi/*``). The engine (SP golden loop or TPU
+mesh) is optimizer-agnostic: it calls ``local_train`` per scheduled client,
+reduces ``update * weight`` (and ``extras``) with a weighted psum, then calls
+``server_update`` — exactly the NCCL simulator's pre-scaled SUM-reduce shape
+(``nccl/base_framework/LocalAggregator.py:85-96``, ``Server.py:192-198``)
+generalized to every optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.algframe.types import ClientData, ClientOutput, TrainHyper
+from ..core.algframe.client_trainer import TrainerSpec, make_inner_optimizer
+from ..core.algframe.local_training import run_local_sgd
+from ..core.collectives import tree_add, tree_sub
+
+PyTree = Any
+
+
+class FedOptimizer:
+    """Base = FedAvg semantics (``sp/fedavg/fedavg_api.py:144``: weighted
+    average of client models with post-sampling ``n_k/Σn`` weights; in delta
+    form: ``w ← w + Σ n_k Δ_k / Σ n_k``)."""
+
+    name = "FedAvg"
+    has_client_state = False
+
+    def __init__(self, args, spec: TrainerSpec):
+        self.args = args
+        self.spec = spec
+        self.inner_opt_name = getattr(args, "client_optimizer", "sgd")
+        self.momentum = getattr(args, "momentum", 0.0) or 0.0
+        self.weight_decay = getattr(args, "weight_decay", 0.0) or 0.0
+
+    # --- state constructors -------------------------------------------------
+    def server_init(self, params: PyTree) -> PyTree:
+        return {}
+
+    def client_state_init(self, params: PyTree) -> PyTree:
+        """Per-client persistent state (one client's worth; engines stack it
+        over all clients)."""
+        return {}
+
+    def server_extras_zero(self, params: PyTree) -> Dict[str, Any]:
+        """Zero-valued pytree matching ``ClientOutput.extras`` — engines need
+        it to initialize the weighted-psum accumulator."""
+        return {}
+
+    # --- client transform ---------------------------------------------------
+    def make_inner_opt(self, hyper: TrainHyper):
+        return make_inner_optimizer(
+            self.inner_opt_name, hyper.learning_rate,
+            momentum=self.momentum, weight_decay=self.weight_decay)
+
+    def grad_transform(self, grads: PyTree, params: PyTree,
+                       ctx: Dict[str, Any]) -> PyTree:
+        return grads
+
+    def local_train(
+        self,
+        global_params: PyTree,
+        server_state: PyTree,
+        client_state: PyTree,
+        cdata: ClientData,
+        rng: jax.Array,
+        hyper: TrainHyper,
+    ) -> ClientOutput:
+        inner_opt = self.make_inner_opt(hyper)
+        ctx = {"global_params": global_params, "server_state": server_state,
+               "client_state": client_state, "hyper": hyper}
+        params, _, metrics = run_local_sgd(
+            self.spec, inner_opt, global_params, cdata, rng, hyper,
+            grad_transform=self.grad_transform, ctx=ctx)
+        update = tree_sub(params, global_params)
+        return ClientOutput(
+            update=update,
+            weight=cdata.num_samples.astype(jnp.float32),
+            client_state=client_state,
+            extras={},
+            metrics=metrics,
+        )
+
+    # --- server transform ---------------------------------------------------
+    def server_update(
+        self,
+        params: PyTree,
+        server_state: PyTree,
+        agg_update: PyTree,
+        agg_extras: Dict[str, Any],
+        round_idx: jnp.ndarray,
+    ) -> Tuple[PyTree, PyTree]:
+        """``agg_update`` and ``agg_extras`` are already weight-averaged by
+        the engine (Σ n_k x_k / Σ n_k)."""
+        return tree_add(params, agg_update), server_state

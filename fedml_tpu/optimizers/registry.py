@@ -1,0 +1,36 @@
+"""Registry mapping ``federated_optimizer`` names to optimizer classes —
+the dispatch analogue of ``simulation/simulator.py:27-216`` (SP: 11
+optimizers, MPI: 14) without the per-backend duplication: one optimizer class
+serves every engine."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from .base import FedOptimizer
+
+_REGISTRY: Dict[str, Type[FedOptimizer]] = {}
+
+
+def register(cls: Type[FedOptimizer]) -> Type[FedOptimizer]:
+    _REGISTRY[cls.name.lower()] = cls
+    return cls
+
+
+def create_optimizer(args, spec) -> FedOptimizer:
+    name = str(getattr(args, "federated_optimizer", "FedAvg"))
+    # "_seq" suffixes pick the same math; sequential multi-client-per-chip
+    # scheduling is an engine concern here (schedule tensor), not a separate
+    # algorithm (reference has FedAvg_seq/FedOpt_seq as distinct stacks).
+    key = name.lower().removesuffix("_seq")
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown federated_optimizer {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](args, spec)
+
+
+def available_optimizers():
+    return sorted(_REGISTRY)
+
+
+register(FedOptimizer)  # FedAvg

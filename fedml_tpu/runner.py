@@ -1,0 +1,68 @@
+"""FedMLRunner façade — picks the scenario runner.
+
+Parity target: ``python/fedml/runner.py:19,34-53,181`` of the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .constants import (
+    FEDML_SIMULATION_TYPE_SP,
+    FEDML_SIMULATION_TYPE_TPU,
+    FEDML_TRAINING_PLATFORM_CROSS_DEVICE,
+    FEDML_TRAINING_PLATFORM_CROSS_SILO,
+    FEDML_TRAINING_PLATFORM_CROSS_CLOUD,
+    FEDML_TRAINING_PLATFORM_SIMULATION,
+)
+
+
+class FedMLRunner:
+    """Dispatch to the right scenario runner based on
+    ``args.training_type`` × ``args.backend`` (reference ``runner.py:34-53``)."""
+
+    def __init__(self, args, device=None, dataset=None, model=None,
+                 client_trainer=None, server_aggregator=None):
+        self.args = args
+        self.dataset = dataset
+        self.model = model
+        self.client_trainer = client_trainer
+        self.server_aggregator = server_aggregator
+        self.runner = self._build(args)
+
+    def _build(self, args):
+        ttype = getattr(args, "training_type", FEDML_TRAINING_PLATFORM_SIMULATION)
+        if ttype == FEDML_TRAINING_PLATFORM_SIMULATION:
+            return self._build_simulator(args)
+        if ttype in (FEDML_TRAINING_PLATFORM_CROSS_SILO,
+                     FEDML_TRAINING_PLATFORM_CROSS_CLOUD):
+            from .cross_silo.runner import build_cross_silo_runner
+            return build_cross_silo_runner(
+                args, self.dataset, self.model,
+                self.client_trainer, self.server_aggregator)
+        if ttype == FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+            from .cross_device.runner import build_cross_device_runner
+            return build_cross_device_runner(args, self.dataset, self.model)
+        raise ValueError(f"unknown training_type {ttype!r}")
+
+    def _build_simulator(self, args):
+        from .core.algframe.client_trainer import (ClassificationTrainer,
+                                                   SequenceTrainer)
+        from .optimizers.registry import create_optimizer
+        fed, bundle = self.dataset, self.model
+        if self.client_trainer is not None:
+            spec = self.client_trainer
+        elif fed.train.y.ndim >= 4:  # [clients, nb, bs, L] — per-token task
+            spec = SequenceTrainer(bundle.apply)
+        else:
+            spec = ClassificationTrainer(bundle.apply)
+        opt = create_optimizer(args, spec)
+        backend = getattr(args, "backend", FEDML_SIMULATION_TYPE_TPU)
+        if backend == FEDML_SIMULATION_TYPE_SP:
+            from .simulation.sp.simulator import SPSimulator
+            return SPSimulator(args, fed, bundle, opt, spec)
+        from .simulation.tpu.engine import TPUSimulator
+        return TPUSimulator(args, fed, bundle, opt, spec)
+
+    def run(self, comm_round: Optional[int] = None) -> Any:
+        return self.runner.run(comm_round)

@@ -1,0 +1,61 @@
+"""Per-round client sampling and device schedules.
+
+Parity targets: ``_client_sampling`` (reference ``sp/fedavg/fedavg_api.py:127``
+— seeded ``np.random.choice`` per round, deterministic given round index) and
+the NCCL simulator's ``client_schedule`` (``nccl/base_framework/Server.py:111``
+— ``np.array_split`` of sampled clients over workers). Here the schedule is a
+*tensor* ([n_devices, n_slots] local indices + active mask) consumed inside
+the jitted round, replacing the broadcast ``client_schedule{i}`` params.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def client_sampling(round_idx: int, client_num_in_total: int,
+                    client_num_per_round: int) -> List[int]:
+    if client_num_in_total == client_num_per_round:
+        return list(range(client_num_in_total))
+    np.random.seed(round_idx)  # deterministic per round, like the reference
+    num = min(client_num_per_round, client_num_in_total)
+    return list(np.random.choice(range(client_num_in_total), num, replace=False))
+
+
+def build_schedule(
+    sampled: List[int],
+    n_devices: int,
+    clients_per_device: int,
+    max_slots: int = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Map sampled *global* client ids to per-device slots.
+
+    Clients are owned by device ``cid // clients_per_device`` (their data
+    shard lives there), so a sampled client trains where its data is — no
+    cross-device data motion. Returns ``(local_idx[n_devices, S] int32,
+    active[n_devices, S] float32)`` with padded slots masked out.
+
+    The slot count S is bucketed to a power of two (capped at ``max_slots``)
+    so the jitted round function sees at most log2 distinct schedule shapes
+    across training instead of recompiling whenever the per-round max
+    clients-on-one-device changes.
+    """
+    per_dev: List[List[int]] = [[] for _ in range(n_devices)]
+    for cid in sampled:
+        d = cid // clients_per_device
+        per_dev[d].append(cid % clients_per_device)
+    need = max(1, max(len(p) for p in per_dev))
+    n_slots = 1
+    while n_slots < need:
+        n_slots *= 2
+    if max_slots is not None:
+        n_slots = min(max(n_slots, need), max(max_slots, need))
+    idx = np.zeros((n_devices, n_slots), np.int32)
+    active = np.zeros((n_devices, n_slots), np.float32)
+    for d, locs in enumerate(per_dev):
+        for s, li in enumerate(locs):
+            idx[d, s] = li
+            active[d, s] = 1.0
+    return idx, active

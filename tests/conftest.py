@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE any test
+imports jax, so every test can exercise real multi-chip sharding semantics
+without TPU hardware (SURVEY §4: parity tests run on
+``--xla_force_host_platform_device_count``).
+
+Note: the environment pins ``JAX_PLATFORMS`` to the TPU tunnel and the env
+var alone does not win — ``jax.config.update`` does.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

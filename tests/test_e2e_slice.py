@@ -1,0 +1,67 @@
+"""The minimum end-to-end slice (SURVEY §7): FedAvg on MNIST-shaped data with
+LR, SP golden loop vs TPU mesh backend — learning happens and the two
+backends agree."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+
+
+def make_args(**kw):
+    base = dict(
+        dataset="synthetic_mnist", model="lr",
+        client_num_in_total=8, client_num_per_round=8,
+        comm_round=4, epochs=1, batch_size=16, learning_rate=0.1,
+        frequency_of_the_test=2, random_seed=42,
+    )
+    base.update(kw)
+    return Arguments(**base)
+
+
+def test_devices_virtualized():
+    assert jax.device_count() == 8
+
+
+def test_sp_golden_loop_learns():
+    result = fedml_tpu.run_simulation(backend="sp", args=make_args(comm_round=10))
+    assert result["final_test_acc"] > 0.5, result["history"][-1]
+
+
+def test_tpu_mesh_backend_learns():
+    result = fedml_tpu.run_simulation(backend="tpu", args=make_args(comm_round=10))
+    assert result["final_test_acc"] > 0.5, result["history"][-1]
+
+
+def test_sp_tpu_parity():
+    """The reference's strongest testability idea made first-class: the mesh
+    backend must match the golden single-process loop numerically."""
+    r_sp = fedml_tpu.run_simulation(backend="sp", args=make_args())
+    r_tpu = fedml_tpu.run_simulation(backend="tpu", args=make_args())
+    flat_sp = jax.tree_util.tree_leaves(r_sp["params"])
+    flat_tpu = jax.tree_util.tree_leaves(r_tpu["params"])
+    for a, b in zip(flat_sp, flat_tpu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_partial_participation_parity():
+    """Sampling fewer clients than total exercises the schedule tensor path."""
+    kw = dict(client_num_in_total=16, client_num_per_round=5, comm_round=3)
+    r_sp = fedml_tpu.run_simulation(backend="sp", args=make_args(**kw))
+    r_tpu = fedml_tpu.run_simulation(backend="tpu", args=make_args(**kw))
+    for a, b in zip(jax.tree_util.tree_leaves(r_sp["params"]),
+                    jax.tree_util.tree_leaves(r_tpu["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_uneven_clients_over_devices():
+    """client_num_in_total not divisible by device count → dummy padding."""
+    result = fedml_tpu.run_simulation(
+        backend="tpu", args=make_args(client_num_in_total=11,
+                                      client_num_per_round=6, comm_round=2))
+    assert np.isfinite(result["final_test_acc"])

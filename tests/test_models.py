@@ -1,0 +1,53 @@
+"""Model zoo shape tests (reference test analogue: ``model/cv/test_cnn.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.model import create
+
+
+@pytest.mark.parametrize("name,shape,classes", [
+    ("lr", (2, 784), 10),
+    ("mlp", (2, 784), 10),
+    ("cnn", (2, 28, 28, 1), 62),
+    ("simple_cnn", (2, 32, 32, 3), 10),
+    ("resnet20", (2, 32, 32, 3), 10),
+    ("resnet56", (2, 32, 32, 3), 10),
+    ("resnet18", (2, 32, 32, 3), 10),
+    ("mobilenet_v3", (2, 32, 32, 3), 62),
+])
+def test_model_forward_shapes(name, shape, classes):
+    args = Arguments(model=name)
+    bundle = create(args, classes)
+    x = jnp.zeros(shape, jnp.float32)
+    params = bundle.init(jax.random.PRNGKey(0), x)
+    out = bundle.apply(params, x)
+    assert out.shape == (shape[0], classes)
+
+
+def test_rnn_per_token_logits():
+    args = Arguments(model="rnn")
+    bundle = create(args, 64)
+    x = jnp.zeros((2, 16), jnp.int32)
+    params = bundle.init(jax.random.PRNGKey(0), x)
+    out = bundle.apply(params, x)
+    assert out.shape == (2, 16, 64)
+
+
+def test_unknown_model_raises():
+    with pytest.raises(ValueError):
+        create(Arguments(model="transformerXL"), 10)
+
+
+def test_sequence_task_end_to_end():
+    """shakespeare-style NWP with LSTM trains through both backends."""
+    import fedml_tpu
+    args = Arguments(dataset="shakespeare", model="rnn",
+                     client_num_in_total=4, client_num_per_round=4,
+                     comm_round=2, batch_size=8, learning_rate=0.5,
+                     frequency_of_the_test=1, random_seed=0)
+    r = fedml_tpu.run_simulation(backend="tpu", args=args)
+    assert np.isfinite(r["final_test_acc"])
