@@ -1,0 +1,90 @@
+"""Benchmark: FL round throughput of the jitted mesh engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.md), so the baseline
+here is the reference's own *architecture* on identical hardware: the
+single-process golden loop (per-client dispatch + host-side aggregation —
+the shape of ``sp/fedavg/fedavg_api.py``) vs our fused whole-round SPMD
+program. ``vs_baseline`` = mesh rounds/hour ÷ golden-loop rounds/hour.
+
+Workload: FedAvg ResNet-20/CIFAR-10-shaped, 8 clients/round, 1 local epoch —
+a scaled-down sibling of the BASELINE.md north-star (ResNet-56, 128 clients).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def run():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.sp.simulator import SPSimulator
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    args = Arguments(
+        dataset="cifar10", model="resnet20",
+        client_num_in_total=8, client_num_per_round=8,
+        comm_round=1, epochs=1, batch_size=32, learning_rate=0.1,
+        frequency_of_the_test=10_000, random_seed=0,
+    )
+    fed, output_dim = load(args)
+    bundle = create(args, output_dim)
+    spec = ClassificationTrainer(bundle.apply)
+    hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate), epochs=1)
+
+    def force(params):
+        # NB: block_until_ready does not reliably synchronize on the tunneled
+        # TPU platform — force a scalar readback to time actual execution.
+        return float(jax.tree_util.tree_leaves(params)[0].sum())
+
+    def time_rounds(run_one, params_of, warmup=1, iters=3):
+        for _ in range(warmup):
+            run_one()
+        force(params_of())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run_one()
+            force(params_of())
+        return (time.perf_counter() - t0) / iters
+
+    # --- mesh engine (ours): whole round = one jitted SPMD program
+    opt = create_optimizer(args, spec)
+    tpu_sim = TPUSimulator(args, fed, bundle, opt, spec)
+    r = [0]
+
+    def tpu_round():
+        tpu_sim.run_round(r[0], hyper)
+        r[0] += 1
+
+    tpu_round_s = time_rounds(tpu_round, lambda: tpu_sim.params)
+
+    # --- baseline: golden per-client loop (reference SP architecture)
+    sp_sim = SPSimulator(args, fed, bundle, create_optimizer(args, spec), spec)
+
+    def sp_round():
+        sp_sim.run(comm_round=1)
+
+    sp_round_s = time_rounds(sp_round, lambda: sp_sim.params)
+
+    rounds_per_hour = 3600.0 / tpu_round_s
+    vs_baseline = sp_round_s / tpu_round_s
+    print(json.dumps({
+        "metric": "fedavg_resnet20_cifar10_rounds_per_hour",
+        "value": round(rounds_per_hour, 1),
+        "unit": "rounds/hour (8 clients/round, 1 local epoch)",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    run()
