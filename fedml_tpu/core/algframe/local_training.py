@@ -83,6 +83,55 @@ def run_local_sgd(
     return params, opt_state, metrics
 
 
+def effective_steps(cdata: ClientData, epochs: int) -> jnp.ndarray:
+    """Number of *real* (non-padding) local SGD steps a client runs: padded
+    all-zero-mask batches are gated to no-ops in :func:`run_local_sgd`, so
+    K = epochs x (batches with at least one real sample). SCAFFOLD / FedNova
+    normalizations need this exact count."""
+    real_batches = jnp.sum(jnp.any(cdata.mask > 0, axis=1).astype(jnp.float32))
+    return jnp.maximum(epochs * real_batches, 1.0)
+
+
+def full_batch_grad(
+    spec: TrainerSpec,
+    params: PyTree,
+    cdata: ClientData,
+    rng: jax.Array,
+) -> Tuple[PyTree, Dict[str, jnp.ndarray]]:
+    """Masked full-dataset gradient of the loss at ``params`` — the per-batch
+    mean gradients are re-weighted by real-sample count so the result equals
+    the gradient of the mean loss over all real samples. Used by FedSGD and
+    Mime's server-statistics update."""
+
+    def body(carry, inp):
+        i, batch = inp
+        acc_g, acc_m = carry
+        grads, aux = jax.grad(spec.loss, has_aux=True)(
+            params, batch, jax.random.fold_in(rng, i))
+        n = aux["count"]
+        acc_g = jax.tree_util.tree_map(
+            lambda a, g: a + g * n.astype(g.dtype), acc_g, grads)
+        acc_m = jax.tree_util.tree_map(
+            lambda a, m: a + m.astype(a.dtype), acc_m, aux)
+        return (acc_g, acc_m), None
+
+    zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zero_m = jax.eval_shape(
+        lambda: spec.loss(params, jax.tree_util.tree_map(
+            lambda a: a[0], {"x": cdata.x, "y": cdata.y, "mask": cdata.mask}),
+            rng))[1]
+    zero_m = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), zero_m)
+    (acc_g, metrics), _ = jax.lax.scan(
+        body, (zero_g, zero_m),
+        (jnp.arange(cdata.x.shape[0]),
+         {"x": cdata.x, "y": cdata.y, "mask": cdata.mask}))
+    denom = jnp.maximum(metrics["count"], 1.0)
+    grads = jax.tree_util.tree_map(
+        lambda g: g / denom.astype(g.dtype), acc_g)
+    return grads, metrics
+
+
 def evaluate(
     spec: TrainerSpec,
     params: PyTree,

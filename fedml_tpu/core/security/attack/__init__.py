@@ -1,0 +1,127 @@
+"""Attack zoo — adversarial client behaviors used to *test* defenses.
+
+Parity target: reference ``core/security/attack/`` (byzantine, label-flip,
+backdoor/model-replacement, DLG / invert-gradient) with the
+``FedMLAttacker`` singleton dispatch (``core/security/fedml_attacker.py``).
+Attacks here are pure transforms on either the stacked update matrix
+(model-poisoning) or on client data arrays (data-poisoning), so simulations
+can inject them inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+ATTACK_TYPES = ("byzantine_random", "byzantine_zero", "byzantine_flip",
+                "label_flip", "model_replacement", "gaussian_noise")
+
+
+# --- model poisoning (operate on [K, D] update matrix + byzantine mask) ----
+
+def byzantine_random(mat: jnp.ndarray, byz_mask: jnp.ndarray,
+                     rng: jax.Array, scale: float = 1.0) -> jnp.ndarray:
+    """Replace byzantine clients' updates with gaussian noise (reference
+    ``attack/byzantine_attack.py`` mode 'random')."""
+    noise = scale * jax.random.normal(rng, mat.shape)
+    return jnp.where(byz_mask[:, None] > 0, noise, mat)
+
+
+def byzantine_zero(mat: jnp.ndarray, byz_mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(byz_mask[:, None] > 0, jnp.zeros_like(mat), mat)
+
+
+def byzantine_flip(mat: jnp.ndarray, byz_mask: jnp.ndarray,
+                   scale: float = 1.0) -> jnp.ndarray:
+    """Sign-flip (inner-product manipulation) attack."""
+    return jnp.where(byz_mask[:, None] > 0, -scale * mat, mat)
+
+
+def model_replacement(mat: jnp.ndarray, byz_mask: jnp.ndarray,
+                      boost: float) -> jnp.ndarray:
+    """Backdoor model-replacement boosting (reference
+    ``attack/backdoor_attack.py``): attacker scales its update by ~K so the
+    average equals its target model."""
+    return jnp.where(byz_mask[:, None] > 0, boost * mat, mat)
+
+
+def gaussian_noise(mat: jnp.ndarray, rng: jax.Array,
+                   stddev: float = 0.1) -> jnp.ndarray:
+    """Additive noise on every update (untargeted degradation)."""
+    return mat + stddev * jax.random.normal(rng, mat.shape)
+
+
+# --- data poisoning --------------------------------------------------------
+
+def label_flip(y: np.ndarray, num_classes: int,
+               src: Optional[int] = None, dst: Optional[int] = None
+               ) -> np.ndarray:
+    """Label-flipping (reference ``attack/label_flipping_attack.py``):
+    src->dst targeted flip, or y -> C-1-y untargeted when src is None."""
+    y = np.asarray(y)
+    if src is None:
+        return (num_classes - 1 - y).astype(y.dtype)
+    out = y.copy()
+    out[y == src] = dst if dst is not None else (num_classes - 1 - src)
+    return out
+
+
+class FedMLAttacker:
+    """Singleton dispatch (reference ``fedml_attacker.py``): engines consult
+    it to poison data before training and updates before aggregation."""
+
+    _instance = None
+
+    def __init__(self, args):
+        self.args = args
+        self.attack_type = str(getattr(args, "attack_type", None) or "").lower()
+        self.enabled = bool(getattr(args, "enable_attack", False)) and \
+            self.attack_type in ATTACK_TYPES
+        self.byzantine_client_num = int(
+            getattr(args, "byzantine_client_num", 0) or 0)
+        self.attack_scale = float(getattr(args, "attack_scale", 1.0) or 1.0)
+
+    @classmethod
+    def get_instance(cls, args=None) -> "FedMLAttacker":
+        if args is not None or cls._instance is None:
+            cls._instance = cls(args)
+        return cls._instance
+
+    def is_model_attack(self) -> bool:
+        return self.enabled and self.attack_type in (
+            "byzantine_random", "byzantine_zero", "byzantine_flip",
+            "model_replacement", "gaussian_noise")
+
+    def is_data_attack(self) -> bool:
+        return self.enabled and self.attack_type == "label_flip"
+
+    def byzantine_mask(self, client_ids: np.ndarray) -> np.ndarray:
+        """Clients 0..f-1 are byzantine (deterministic, test-friendly)."""
+        return (np.asarray(client_ids) < self.byzantine_client_num
+                ).astype(np.float32)
+
+    def poison_updates(self, mat: jnp.ndarray, client_ids: np.ndarray,
+                       rng: jax.Array) -> jnp.ndarray:
+        mask = jnp.asarray(self.byzantine_mask(client_ids))
+        t = self.attack_type
+        if t == "byzantine_random":
+            return byzantine_random(mat, mask, rng, self.attack_scale)
+        if t == "byzantine_zero":
+            return byzantine_zero(mat, mask)
+        if t == "byzantine_flip":
+            return byzantine_flip(mat, mask, self.attack_scale)
+        if t == "model_replacement":
+            boost = self.attack_scale if self.attack_scale != 1.0 else float(
+                mat.shape[0])
+            return model_replacement(mat, mask, boost)
+        if t == "gaussian_noise":
+            return gaussian_noise(mat, rng, self.attack_scale)
+        return mat
+
+    def poison_labels(self, y: np.ndarray, num_classes: int) -> np.ndarray:
+        return label_flip(y, num_classes)

@@ -1,0 +1,256 @@
+"""Robust aggregation kernels — Byzantine-tolerant alternatives to FedAvg.
+
+Parity target: the reference's defense zoo (``core/security/defense/`` — 22
+defenses dispatched by ``fedml_defender.py:55-116``). The reference
+implements them as loops over state-dicts of torch tensors; here each defense
+is a pure jit-able function over ``(updates, weights)`` where ``updates`` is
+the [K, D] matrix of flattened client updates — so a robust round can run as
+one XLA program (on the mesh engine the [K, D] matrix arrives via
+``all_gather`` instead of the psum fast path).
+
+All functions return ``(aggregated_vector [D], info dict)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Arr = jnp.ndarray
+
+
+def _normalize(weights: Arr) -> Arr:
+    return weights / jnp.maximum(jnp.sum(weights), 1e-12)
+
+
+def weighted_mean(updates: Arr, weights: Arr) -> Arr:
+    return jnp.einsum("k,kd->d", _normalize(weights), updates)
+
+
+# ---------------------------------------------------------------------------
+# distance / score based selection
+# ---------------------------------------------------------------------------
+
+def pairwise_sq_dists(updates: Arr) -> Arr:
+    """[K, K] squared euclidean distances."""
+    sq = jnp.sum(updates * updates, axis=1)
+    return jnp.maximum(sq[:, None] + sq[None, :]
+                       - 2.0 * updates @ updates.T, 0.0)
+
+
+def krum_scores(updates: Arr, byzantine_count: int) -> Arr:
+    """Krum score per client: sum of its K - f - 2 smallest squared distances
+    to other clients (Blanchard et al.; reference
+    ``defense/krum_defense.py``)."""
+    k = updates.shape[0]
+    closest = max(k - byzantine_count - 2, 1)
+    d = pairwise_sq_dists(updates)
+    d = d + jnp.eye(k) * 1e30  # exclude self
+    sorted_d = jnp.sort(d, axis=1)
+    return jnp.sum(sorted_d[:, :closest], axis=1)
+
+
+def krum(updates: Arr, weights: Arr, byzantine_count: int = 0,
+         multi_k: int = 1) -> Tuple[Arr, Dict]:
+    """Krum (multi_k=1) / Multi-Krum (multi_k=m): select the m lowest-score
+    updates and average them."""
+    scores = krum_scores(updates, byzantine_count)
+    m = max(int(multi_k), 1)
+    _, sel = jax.lax.top_k(-scores, m)
+    sel_mask = jnp.zeros(updates.shape[0]).at[sel].set(1.0)
+    w = weights * sel_mask
+    return weighted_mean(updates, w), {"scores": scores, "selected": sel_mask}
+
+
+def coordinate_median(updates: Arr, weights: Arr) -> Tuple[Arr, Dict]:
+    """Coordinate-wise median (Yin et al.; reference
+    ``defense/coordinate_wise_median_defense.py``)."""
+    return jnp.median(updates, axis=0), {}
+
+
+def trimmed_mean(updates: Arr, weights: Arr, trim_fraction: float = 0.1
+                 ) -> Tuple[Arr, Dict]:
+    """Coordinate-wise beta-trimmed mean (reference
+    ``defense/coordinate_wise_trimmed_mean_defense.py``): drop the highest
+    and lowest ``trim_fraction`` of values per coordinate, average the rest."""
+    k = updates.shape[0]
+    b = min(int(k * trim_fraction), (k - 1) // 2)
+    s = jnp.sort(updates, axis=0)
+    kept = s[b:k - b] if b > 0 else s
+    return jnp.mean(kept, axis=0), {"trimmed_each_side": b}
+
+
+def geometric_median(updates: Arr, weights: Arr, iters: int = 8,
+                     eps: float = 1e-8) -> Tuple[Arr, Dict]:
+    """RFA — smoothed Weiszfeld iteration for the weighted geometric median
+    (Pillutla et al.; reference ``defense/RFA_defense.py``)."""
+    w = _normalize(weights)
+
+    def body(_, v):
+        dist = jnp.sqrt(jnp.sum((updates - v[None]) ** 2, axis=1) + eps)
+        beta = w / jnp.maximum(dist, eps)
+        beta = beta / jnp.maximum(jnp.sum(beta), 1e-12)
+        return jnp.einsum("k,kd->d", beta, updates)
+
+    v0 = weighted_mean(updates, w)
+    v = jax.lax.fori_loop(0, iters, body, v0)
+    return v, {}
+
+
+def bulyan(updates: Arr, weights: Arr, byzantine_count: int = 0
+           ) -> Tuple[Arr, Dict]:
+    """Bulyan (El Mhamdi et al.; reference ``defense/bulyan_defense.py``):
+    iterative Multi-Krum selection of theta = K - 2f updates, then
+    coordinate-wise trimmed mean keeping theta - 2f values per coordinate."""
+    k = updates.shape[0]
+    f = byzantine_count
+    theta = max(k - 2 * f, 1)
+    scores = krum_scores(updates, f)
+    _, sel = jax.lax.top_k(-scores, theta)
+    chosen = updates[sel]
+    beta = max((theta - 2 * f), 1)
+    med = jnp.median(chosen, axis=0)
+    dist_to_med = jnp.abs(chosen - med[None])
+    _, nearest = jax.lax.top_k(-dist_to_med.T, beta)  # [D, beta]
+    vals = jnp.take_along_axis(chosen.T, nearest, axis=1)
+    return jnp.mean(vals, axis=1), {"selected": sel}
+
+
+# ---------------------------------------------------------------------------
+# clipping / noise
+# ---------------------------------------------------------------------------
+
+def norm_clip(updates: Arr, weights: Arr, max_norm: float = 1.0
+              ) -> Tuple[Arr, Dict]:
+    """Norm-bounded aggregation (reference ``defense/norm_diff_clipping_defense.py``):
+    scale each update to at most ``max_norm`` before weighted averaging."""
+    norms = jnp.linalg.norm(updates, axis=1)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-12))
+    return weighted_mean(updates * scale[:, None], weights), {"norms": norms}
+
+
+def centered_clip(updates: Arr, weights: Arr, tau: float = 1.0,
+                  iters: int = 3, momentum: Arr = None) -> Tuple[Arr, Dict]:
+    """Centered clipping (Karimireddy et al.; reference
+    ``defense/cclip_defense.py``): v <- v + mean_k clip(u_k - v, tau)."""
+    v = jnp.zeros(updates.shape[1]) if momentum is None else momentum
+    w = _normalize(weights)
+
+    def body(_, v):
+        diff = updates - v[None]
+        norms = jnp.linalg.norm(diff, axis=1)
+        scale = jnp.minimum(1.0, tau / jnp.maximum(norms, 1e-12))
+        return v + jnp.einsum("k,kd->d", w, diff * scale[:, None])
+
+    v = jax.lax.fori_loop(0, iters, body, v)
+    return v, {}
+
+
+def weak_dp(updates: Arr, weights: Arr, rng: jax.Array,
+            stddev: float = 0.002) -> Tuple[Arr, Dict]:
+    """Weak differential privacy defense (reference
+    ``defense/weak_dp_defense.py``): plain weighted mean + gaussian noise."""
+    agg = weighted_mean(updates, weights)
+    return agg + stddev * jax.random.normal(rng, agg.shape), {}
+
+
+def crfl_clip_and_perturb(global_vec: Arr, rng: jax.Array,
+                          clip_norm: float = 15.0, stddev: float = 0.002
+                          ) -> Arr:
+    """CRFL (reference ``defense/crfl_defense.py``) post-aggregation step:
+    clip the global model norm then add smoothing noise."""
+    norm = jnp.linalg.norm(global_vec)
+    clipped = global_vec * jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+    return clipped + stddev * jax.random.normal(rng, global_vec.shape)
+
+
+# ---------------------------------------------------------------------------
+# similarity / statistics based reweighting
+# ---------------------------------------------------------------------------
+
+def foolsgold_weights(history: Arr, eps: float = 1e-5) -> Arr:
+    """FoolsGold (Fung et al.; reference ``defense/foolsgold_defense.py``):
+    down-weight clients whose *historical* aggregate updates are mutually
+    similar (sybils collude). ``history`` is [K, D] accumulated updates;
+    returns per-client learning weights in [0, 1]."""
+    normed = history / jnp.maximum(
+        jnp.linalg.norm(history, axis=1, keepdims=True), eps)
+    cs = normed @ normed.T - jnp.eye(history.shape[0])
+    maxcs = jnp.max(cs, axis=1)
+    # pardoning: rescale similarity of honest clients
+    pard = jnp.where(maxcs[None, :] > maxcs[:, None],
+                     cs * maxcs[:, None] / jnp.maximum(maxcs[None, :], eps), cs)
+    wv = 1.0 - jnp.max(pard, axis=1)
+    wv = jnp.clip(wv, 0.0, 1.0)
+    # logit rescale emphasises separation
+    wv = wv / jnp.maximum(jnp.max(wv), eps)
+    wv = jnp.clip(wv, eps, 1.0 - eps)
+    logit = jnp.log(wv / (1.0 - wv)) + 0.5
+    return jnp.clip(logit, 0.0, 1.0)
+
+
+def foolsgold(updates: Arr, weights: Arr, history: Arr) -> Tuple[Arr, Dict]:
+    wv = foolsgold_weights(history)
+    return weighted_mean(updates, weights * wv), {"fg_weights": wv}
+
+
+def three_sigma(updates: Arr, weights: Arr, sigma_factor: float = 3.0
+                ) -> Tuple[Arr, Dict]:
+    """3-sigma outlier rejection (reference ``defense/three_sigma_defense.py``
+    family): score = distance to the coordinate median vector; drop clients
+    more than ``sigma_factor`` std above the mean score."""
+    med = jnp.median(updates, axis=0)
+    scores = jnp.linalg.norm(updates - med[None], axis=1)
+    mu, sd = jnp.mean(scores), jnp.std(scores) + 1e-12
+    keep = (scores <= mu + sigma_factor * sd).astype(updates.dtype)
+    w = weights * keep
+    return weighted_mean(updates, w), {"scores": scores, "kept": keep}
+
+
+def outlier_detection(updates: Arr, weights: Arr, z_threshold: float = 2.5
+                      ) -> Tuple[Arr, Dict]:
+    """Norm-based z-score filter (reference ``defense/outlier_detection.py``)."""
+    norms = jnp.linalg.norm(updates, axis=1)
+    mu, sd = jnp.mean(norms), jnp.std(norms) + 1e-12
+    keep = (jnp.abs(norms - mu) <= z_threshold * sd).astype(updates.dtype)
+    return weighted_mean(updates, weights * keep), {"kept": keep}
+
+
+def residual_reweight(updates: Arr, weights: Arr, lam: float = 2.0
+                      ) -> Tuple[Arr, Dict]:
+    """Residual-based reweighting (Fu et al.; reference
+    ``defense/residual_based_reweighting_defense.py``, simplified to its
+    IRLS core): weight each client by a Huber-style factor of its residual
+    to the coordinate-median model."""
+    med = jnp.median(updates, axis=0)
+    resid = jnp.linalg.norm(updates - med[None], axis=1)
+    mad = jnp.median(jnp.abs(resid - jnp.median(resid))) + 1e-12
+    conf = jnp.clip(lam * mad / jnp.maximum(resid, 1e-12), 0.0, 1.0)
+    return weighted_mean(updates, weights * conf), {"confidence": conf}
+
+
+def slsgd(updates: Arr, weights: Arr, trim_b: int = 1, alpha: float = 1.0,
+          prev_global: Arr = None) -> Tuple[Arr, Dict]:
+    """SLSGD (Xie et al.; reference ``defense/slsgd_defense.py``):
+    trimmed-mean aggregation mixed with the previous global model:
+    ``(1-alpha) * prev + alpha * trmean``."""
+    k = updates.shape[0]
+    b = min(trim_b, (k - 1) // 2)
+    s = jnp.sort(updates, axis=0)
+    kept = s[b:k - b] if b > 0 else s
+    agg = jnp.mean(kept, axis=0)
+    if prev_global is not None:
+        agg = (1.0 - alpha) * prev_global + alpha * agg
+    return agg, {}
+
+
+def robust_learning_rate(updates: Arr, weights: Arr, threshold: int = 2
+                         ) -> Tuple[Arr, Dict]:
+    """RLR (Ozdayi et al.; reference ``defense/robust_learning_rate_defense.py``):
+    per-coordinate sign vote — coordinates where fewer than ``threshold``
+    clients agree in sign get their learning rate flipped."""
+    sign_sum = jnp.abs(jnp.sum(jnp.sign(updates), axis=0))
+    lr_sign = jnp.where(sign_sum >= threshold, 1.0, -1.0)
+    return weighted_mean(updates, weights) * lr_sign, {"lr_sign": lr_sign}

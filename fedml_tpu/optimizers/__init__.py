@@ -1,5 +1,8 @@
 from .base import FedOptimizer
 from .registry import create_optimizer, available_optimizers, register
 
+# importing registers each optimizer under its reference name
+from . import fedprox, fedopt, scaffold, fednova, feddyn, mime  # noqa: F401,E402
+
 __all__ = ["FedOptimizer", "create_optimizer", "available_optimizers",
            "register"]

@@ -1,0 +1,85 @@
+"""FedDyn — dynamic regularization (Acar et al.).
+
+Parity target: ``ml/trainer/feddyn_trainer.py`` + ``simulation/sp/feddyn``.
+Client k minimizes ``F_k(w) - <h_k, w> + (alpha/2)||w - w_t||^2`` where
+``h_k`` is its accumulated first-order correction; after training
+``h_k <- h_k - alpha * (w_k - w_t)``. The server keeps
+``h = -(alpha/N) * sum_k accumulated deltas``:
+
+    h+ = h - alpha * (|S|/N) * avg_update,   w+ = (w_t + avg_update) - h+/alpha.
+
+TPU-native form: ``h_k`` is per-client sharded state, the linear + proximal
+terms are a ``grad_transform``, and the server correction is part of the
+replicated server state inside the jitted round.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.algframe.local_training import run_local_sgd
+from ..core.algframe.types import ClientOutput
+from ..core.collectives import tree_sub, tree_zeros_like
+from .base import FedOptimizer, PyTree
+from .registry import register
+
+
+@register
+class FedDyn(FedOptimizer):
+    name = "FedDyn"
+    has_client_state = True
+
+    def __init__(self, args, spec):
+        super().__init__(args, spec)
+        self.alpha = float(getattr(args, "feddyn_alpha", 0.01))
+        n_total = int(getattr(args, "client_num_in_total", 1))
+        n_round = int(getattr(args, "client_num_per_round", n_total))
+        self.participation = float(n_round) / float(max(n_total, 1))
+
+    def server_init(self, params: PyTree) -> PyTree:
+        return {"h": tree_zeros_like(params)}
+
+    def client_state_init(self, params: PyTree) -> PyTree:
+        return {"h_i": tree_zeros_like(params)}
+
+    def grad_transform(self, grads, params, ctx):
+        alpha = self.alpha
+        gp = ctx["global_params"]
+        h_i = ctx["client_state"]["h_i"]
+        return jax.tree_util.tree_map(
+            lambda g, w, w0, h: g + alpha * (w - w0) - h, grads, params, gp, h_i)
+
+    def local_train(self, global_params, server_state, client_state, cdata,
+                    rng, hyper) -> ClientOutput:
+        inner_opt = self.make_inner_opt(hyper)
+        ctx = {"global_params": global_params, "server_state": server_state,
+               "client_state": client_state, "hyper": hyper}
+        params, _, metrics = run_local_sgd(
+            self.spec, inner_opt, global_params, cdata, rng, hyper,
+            grad_transform=self.grad_transform, ctx=ctx)
+        update = tree_sub(params, global_params)
+        alpha = jnp.float32(self.alpha)
+        new_h_i = jax.tree_util.tree_map(
+            lambda h, u: h - alpha.astype(u.dtype) * u,
+            client_state["h_i"], update)
+        return ClientOutput(
+            update=update,
+            weight=cdata.num_samples.astype(jnp.float32),
+            client_state={"h_i": new_h_i},
+            extras={},
+            metrics=metrics)
+
+    def server_update(self, params, server_state, agg_update, agg_extras,
+                      round_idx) -> Tuple[PyTree, PyTree]:
+        alpha = jnp.float32(self.alpha)
+        frac = jnp.float32(self.participation)
+        new_h = jax.tree_util.tree_map(
+            lambda h, u: h - (alpha * frac).astype(u.dtype) * u,
+            server_state["h"], agg_update)
+        new_params = jax.tree_util.tree_map(
+            lambda w, u, h: w + u - h / alpha.astype(w.dtype),
+            params, agg_update, new_h)
+        return new_params, {"h": new_h}

@@ -1,0 +1,66 @@
+"""FedNova — normalized averaging for heterogeneous local work.
+
+Parity target: ``ml/trainer/fednova_trainer.py`` + ``simulation/sp/fednova``
+(Wang et al.): each client normalizes its accumulated update by its own
+effective step budget ``a_i``, the server rescales the average by
+``tau_eff = sum_k p_k a_i`` so objective-inconsistency from unequal local
+steps cancels:
+
+    w+ = w + tau_eff * sum_k p_k (Delta_k / a_i).
+
+For momentum-SGD clients (factor rho), ``a_i = (tau - rho(1-rho^tau)/(1-rho))
+/ (1-rho)``; for plain SGD ``a_i = tau``. The normalized delta is the
+``ClientOutput.update`` and ``a_i`` rides the weighted psum via ``extras``,
+so the server transform needs no extra communication round.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.algframe.local_training import effective_steps, run_local_sgd
+from ..core.algframe.types import ClientOutput
+from ..core.collectives import tree_sub
+from .base import FedOptimizer, PyTree
+from .registry import register
+
+
+@register
+class FedNova(FedOptimizer):
+    name = "FedNova"
+
+    def _a_i(self, tau: jnp.ndarray) -> jnp.ndarray:
+        rho = jnp.float32(self.momentum)
+        plain = tau
+        mom = (tau - rho * (1.0 - jnp.power(rho, tau)) / (1.0 - rho)) / (1.0 - rho)
+        return jnp.where(rho > 0, mom, plain)
+
+    def local_train(self, global_params, server_state, client_state, cdata,
+                    rng, hyper) -> ClientOutput:
+        inner_opt = self.make_inner_opt(hyper)
+        params, _, metrics = run_local_sgd(
+            self.spec, inner_opt, global_params, cdata, rng, hyper)
+        delta = tree_sub(params, global_params)
+        tau = effective_steps(cdata, hyper.epochs)
+        a_i = self._a_i(tau)
+        normalized = jax.tree_util.tree_map(
+            lambda d: d / a_i.astype(d.dtype), delta)
+        return ClientOutput(
+            update=normalized,
+            weight=cdata.num_samples.astype(jnp.float32),
+            client_state=client_state,
+            extras={"a": a_i},
+            metrics=metrics)
+
+    def server_extras_zero(self, params: PyTree):
+        return {"a": jnp.float32(0.0)}
+
+    def server_update(self, params, server_state, agg_update, agg_extras,
+                      round_idx) -> Tuple[PyTree, PyTree]:
+        tau_eff = agg_extras["a"]  # sum_k p_k a_i (weighted psum average)
+        new_params = jax.tree_util.tree_map(
+            lambda w, u: w + tau_eff.astype(w.dtype) * u, params, agg_update)
+        return new_params, server_state

@@ -1,0 +1,109 @@
+"""FedOpt family — adaptive *server* optimization over the aggregated
+pseudo-gradient, plus the degenerate FedSGD / FedLocalSGD variants.
+
+Parity targets: ``simulation/sp/fedopt/`` (server optimizer applied to the
+averaged client delta; reference defaults to momentum SGD), reference
+optimizer names ``FedOpt``/``FedOpt_seq``/``FedSGD``/``FedLocalSGD``
+(``constants.py:40-60``). TPU-native form: the server transform is an optax
+``GradientTransformation`` whose state is part of the replicated
+``server_state`` pytree, so the FedOpt step runs inside the same jitted
+round program as the psum aggregation.
+
+``server_optimizer`` options: sgd (momentum = ``server_momentum``), adam,
+adagrad, yogi — the four from Reddi et al., "Adaptive Federated
+Optimization", which the reference's FedOpt implements.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.algframe.local_training import full_batch_grad
+from ..core.algframe.types import ClientOutput
+from .base import FedOptimizer, PyTree
+from .registry import register
+
+
+def make_server_optimizer(name: str, lr: float, momentum: float = 0.9
+                          ) -> optax.GradientTransformation:
+    name = (name or "sgd").lower()
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum or None)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    if name == "yogi":
+        return optax.yogi(lr)
+    raise ValueError(f"unknown server_optimizer {name!r}")
+
+
+@register
+class FedOpt(FedOptimizer):
+    name = "FedOpt"
+
+    def __init__(self, args, spec):
+        super().__init__(args, spec)
+        self.server_opt = make_server_optimizer(
+            getattr(args, "server_optimizer", "sgd"),
+            float(getattr(args, "server_lr", 1.0)),
+            float(getattr(args, "server_momentum", 0.9)))
+
+    def server_init(self, params: PyTree) -> PyTree:
+        return {"opt_state": self.server_opt.init(params)}
+
+    def server_update(self, params, server_state, agg_update, agg_extras,
+                      round_idx) -> Tuple[PyTree, PyTree]:
+        # pseudo-gradient = -averaged delta (Reddi et al. Eq. 2)
+        pseudo_grad = jax.tree_util.tree_map(lambda u: -u, agg_update)
+        updates, opt_state = self.server_opt.update(
+            pseudo_grad, server_state["opt_state"], params)
+        return optax.apply_updates(params, updates), {"opt_state": opt_state}
+
+
+@register
+class FedSGD(FedOptimizer):
+    """One aggregated gradient step per round: clients return their
+    full-batch gradient (no local SGD), the server applies it with
+    ``server_lr`` — the communication-maximal baseline
+    (``FedML_FEDERATED_OPTIMIZER_FEDSGD``, ``constants.py:59``)."""
+
+    name = "FedSGD"
+
+    def __init__(self, args, spec):
+        super().__init__(args, spec)
+        self.server_lr = float(getattr(args, "server_lr", 1.0))
+
+    def local_train(self, global_params, server_state, client_state, cdata,
+                    rng, hyper) -> ClientOutput:
+        grads, metrics = full_batch_grad(self.spec, global_params, cdata, rng)
+        update = jax.tree_util.tree_map(lambda g: -g, grads)
+        return ClientOutput(update=update,
+                            weight=cdata.num_samples.astype(jnp.float32),
+                            client_state=client_state, extras={},
+                            metrics=metrics)
+
+    def server_update(self, params, server_state, agg_update, agg_extras,
+                      round_idx):
+        lr = jnp.float32(self.server_lr)
+        new = jax.tree_util.tree_map(
+            lambda w, u: w + lr.astype(w.dtype) * u, params, agg_update)
+        return new, server_state
+
+
+@register
+class FedLocalSGD(FedOptimizer):
+    """Local SGD with periodic (uniform) parameter averaging — FedAvg with
+    equal client weights (``FedML_FEDERATED_OPTIMIZER_FEDLOCALSGD``)."""
+
+    name = "FedLocalSGD"
+
+    def local_train(self, global_params, server_state, client_state, cdata,
+                    rng, hyper) -> ClientOutput:
+        out = super().local_train(global_params, server_state, client_state,
+                                  cdata, rng, hyper)
+        return out.replace(weight=jnp.float32(1.0))

@@ -1,0 +1,30 @@
+"""FedProx — proximal-regularized local training.
+
+Parity target: the reference's FedProx trainer (``ml/trainer/fedprox_trainer.py``,
+``simulation/sp/fedprox/``): local objective ``F_k(w) + (mu/2)||w - w_t||^2``.
+TPU-native form: the proximal term is a ``grad_transform`` hook on the shared
+scanned local-SGD loop — ``g <- g + mu (w - w_t)`` — so the whole client step
+stays one fused XLA program; server transform is plain FedAvg.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .base import FedOptimizer
+from .registry import register
+
+
+@register
+class FedProx(FedOptimizer):
+    name = "FedProx"
+
+    def __init__(self, args, spec):
+        super().__init__(args, spec)
+        self.mu = float(getattr(args, "fedprox_mu", 0.1))
+
+    def grad_transform(self, grads, params, ctx):
+        mu = self.mu
+        gp = ctx["global_params"]
+        return jax.tree_util.tree_map(
+            lambda g, w, w0: g + mu * (w - w0), grads, params, gp)

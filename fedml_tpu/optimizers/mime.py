@@ -1,0 +1,76 @@
+"""Mime (MimeLite variant) — server statistics applied, not updated, locally.
+
+Parity target: ``ml/trainer/mime_trainer.py`` + ``simulation/sp/mime``
+(Karimireddy et al.): clients take SGD steps using the *server's* momentum
+buffer ``m`` held fixed (``g' = (1-beta) g + beta m``), and return the
+full-batch gradient at the global parameters; the server refreshes
+``m <- (1-beta) avg_full_grad + beta m`` and averages parameters as usual.
+
+TPU-native form: ``m`` is replicated server state; the fixed-momentum step is
+a ``grad_transform``; the full-batch gradient rides the weighted psum via
+``extras`` — one round stays one XLA program.
+
+Math note: assumes a plain-SGD inner optimizer (``client_optimizer: sgd``,
+zero client momentum); the momentum blending is Mime's own.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.algframe.local_training import full_batch_grad, run_local_sgd
+from ..core.algframe.types import ClientOutput
+from ..core.collectives import tree_sub, tree_zeros_like
+from .base import FedOptimizer, PyTree
+from .registry import register
+
+
+@register
+class Mime(FedOptimizer):
+    name = "Mime"
+
+    def __init__(self, args, spec):
+        super().__init__(args, spec)
+        self.beta = float(getattr(args, "server_momentum", 0.9))
+
+    def server_init(self, params: PyTree) -> PyTree:
+        return {"m": tree_zeros_like(params)}
+
+    def server_extras_zero(self, params: PyTree):
+        return {"full_grad": tree_zeros_like(params)}
+
+    def grad_transform(self, grads, params, ctx):
+        beta = self.beta
+        m = ctx["server_state"]["m"]
+        return jax.tree_util.tree_map(
+            lambda g, mm: (1.0 - beta) * g + beta * mm, grads, m)
+
+    def local_train(self, global_params, server_state, client_state, cdata,
+                    rng, hyper) -> ClientOutput:
+        inner_opt = self.make_inner_opt(hyper)
+        ctx = {"global_params": global_params, "server_state": server_state,
+               "client_state": client_state, "hyper": hyper}
+        sgd_rng, grad_rng = jax.random.split(rng)
+        params, _, metrics = run_local_sgd(
+            self.spec, inner_opt, global_params, cdata, sgd_rng, hyper,
+            grad_transform=self.grad_transform, ctx=ctx)
+        full_grad, _ = full_batch_grad(self.spec, global_params, cdata, grad_rng)
+        return ClientOutput(
+            update=tree_sub(params, global_params),
+            weight=cdata.num_samples.astype(jnp.float32),
+            client_state=client_state,
+            extras={"full_grad": full_grad},
+            metrics=metrics)
+
+    def server_update(self, params, server_state, agg_update, agg_extras,
+                      round_idx) -> Tuple[PyTree, PyTree]:
+        beta = jnp.float32(self.beta)
+        new_m = jax.tree_util.tree_map(
+            lambda mm, g: (1.0 - beta).astype(mm.dtype) * g
+            + beta.astype(mm.dtype) * mm,
+            server_state["m"], agg_extras["full_grad"])
+        new_params = jax.tree_util.tree_map(jnp.add, params, agg_update)
+        return new_params, {"m": new_m}

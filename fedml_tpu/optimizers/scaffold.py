@@ -1,0 +1,91 @@
+"""SCAFFOLD — stochastic controlled averaging with control variates.
+
+Parity target: ``ml/trainer/scaffold_trainer.py`` + ``simulation/sp/scaffold``
+(client drift correction ``g <- g - c_i + c``; option-II control-variate
+update ``c_i+ = c_i - c + (w_t - w_local)/(K * lr)``; server
+``x <- x + lr_g * avg(dx)``, ``c <- c + (|S|/N) * avg(dc)``).
+
+TPU-native form: ``c`` lives in the replicated server state, each client's
+``c_i`` in the per-client sharded state, the correction is a
+``grad_transform`` on the shared scanned loop, and ``dc_i`` rides the same
+weighted psum as the model delta (``ClientOutput.extras``).
+
+Math note: the control-variate update assumes a plain-SGD inner optimizer;
+use ``client_optimizer: sgd`` with zero momentum.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.algframe.local_training import effective_steps, run_local_sgd
+from ..core.algframe.types import ClientOutput
+from ..core.collectives import tree_sub, tree_zeros_like
+from .base import FedOptimizer, PyTree
+from .registry import register
+
+
+@register
+class SCAFFOLD(FedOptimizer):
+    name = "SCAFFOLD"
+    has_client_state = True
+
+    def __init__(self, args, spec):
+        super().__init__(args, spec)
+        self.server_lr = float(getattr(args, "server_lr", 1.0))
+        n_total = int(getattr(args, "client_num_in_total", 1))
+        n_round = int(getattr(args, "client_num_per_round", n_total))
+        self.participation = float(n_round) / float(max(n_total, 1))
+
+    def server_init(self, params: PyTree) -> PyTree:
+        return {"c": tree_zeros_like(params)}
+
+    def client_state_init(self, params: PyTree) -> PyTree:
+        return {"c_i": tree_zeros_like(params)}
+
+    def server_extras_zero(self, params: PyTree):
+        return {"delta_c": tree_zeros_like(params)}
+
+    def grad_transform(self, grads, params, ctx):
+        c = ctx["server_state"]["c"]
+        c_i = ctx["client_state"]["c_i"]
+        return jax.tree_util.tree_map(
+            lambda g, cc, ci: g + cc - ci, grads, c, c_i)
+
+    def local_train(self, global_params, server_state, client_state, cdata,
+                    rng, hyper) -> ClientOutput:
+        inner_opt = self.make_inner_opt(hyper)
+        ctx = {"global_params": global_params, "server_state": server_state,
+               "client_state": client_state, "hyper": hyper}
+        params, _, metrics = run_local_sgd(
+            self.spec, inner_opt, global_params, cdata, rng, hyper,
+            grad_transform=self.grad_transform, ctx=ctx)
+        update = tree_sub(params, global_params)
+        k = effective_steps(cdata, hyper.epochs)
+        inv_klr = 1.0 / (k * hyper.learning_rate)
+        c, c_i = server_state["c"], client_state["c_i"]
+        # option II: c_i+ = c_i - c - update/(K*lr)
+        new_c_i = jax.tree_util.tree_map(
+            lambda ci, cc, u: ci - cc - u * inv_klr.astype(u.dtype),
+            c_i, c, update)
+        delta_c = tree_sub(new_c_i, c_i)
+        return ClientOutput(
+            update=update,
+            weight=cdata.num_samples.astype(jnp.float32),
+            client_state={"c_i": new_c_i},
+            extras={"delta_c": delta_c},
+            metrics=metrics)
+
+    def server_update(self, params, server_state, agg_update, agg_extras,
+                      round_idx) -> Tuple[PyTree, PyTree]:
+        lr_g = jnp.float32(self.server_lr)
+        frac = jnp.float32(self.participation)
+        new_params = jax.tree_util.tree_map(
+            lambda w, u: w + lr_g.astype(w.dtype) * u, params, agg_update)
+        new_c = jax.tree_util.tree_map(
+            lambda cc, dc: cc + frac.astype(cc.dtype) * dc,
+            server_state["c"], agg_extras["delta_c"])
+        return new_params, {"c": new_c}
