@@ -30,6 +30,7 @@ import numpy as np
 from .arguments import Arguments, add_args, load_arguments
 from .runner import FedMLRunner
 from . import constants
+from .core import mlops
 
 __version__ = "0.1.0"
 
@@ -42,6 +43,8 @@ def _setup_logging() -> None:
         logging.basicConfig(
             level=logging.INFO,
             format="[fedml_tpu] %(asctime)s %(levelname)s %(name)s: %(message)s")
+        # orbax/absl emit INFO for every checkpoint IO op — far too chatty
+        logging.getLogger("absl").setLevel(logging.WARNING)
         _logger_configured = True
 
 
@@ -54,14 +57,16 @@ def init(args: Optional[Arguments] = None, **overrides: Any) -> Arguments:
     _setup_logging()
     if args is None:
         cli = add_args()
-        args = load_arguments(cli.yaml_config_file, rank=cli.rank,
-                              role=cli.role, run_id=cli.run_id, **overrides)
+        merged = dict(rank=cli.rank, role=cli.role, run_id=cli.run_id)
+        merged.update(overrides)  # explicit overrides beat CLI bootstrap
+        args = load_arguments(cli.yaml_config_file, **merged)
     else:
         for k, v in overrides.items():
             setattr(args, k, v)
     seed = int(getattr(args, "random_seed", 0))
     random.seed(seed)
     np.random.seed(seed)
+    mlops.init(args)
     return args
 
 

@@ -1,0 +1,10 @@
+"""Contribution assessment (reference ``core/contribution/``): GTG-Shapley,
+leave-one-out, and the manager consulted from the server aggregation hook
+(``ContributionAssessorManager``, reference
+``contribution_assessor_manager.py``; ``ServerAggregator.assess_contribution``
+hook ``server_aggregator.py:105``)."""
+
+from .contribution_assessor import (ContributionAssessorManager,
+                                    gtg_shapley, leave_one_out)
+
+__all__ = ["ContributionAssessorManager", "gtg_shapley", "leave_one_out"]

@@ -1,0 +1,203 @@
+"""Observability façade (reference ``core/mlops/`` 4.5k LoC).
+
+Re-exports the reference's user-facing surface —
+``mlops.init/log/event/log_metric/log_round_info/log_model/...``
+(``core/mlops/__init__.py:99-1466``) — over pluggable local sinks instead of
+the MQTT+platform pipeline: a JSON-lines event/metric log per run (the
+replacement for the MQTT topics the reference publishes to), optional wandb
+(gated — not installed here), and the JAX profiler for device-side traces
+(the TPU-native replacement for the reference's wall-clock profiler events,
+``mlops_profiler_event.py:74-97``).
+
+System perf sampling (``mlops_device_perfs.py``) maps to a psutil sampler
+thread; device utilization comes from jax memory stats.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_state: Dict[str, Any] = {"run_id": "0", "sink": None, "enabled": False,
+                          "sys_thread": None}
+
+
+class JsonSink:
+    """Append-only JSON-lines sink — one file per run, thread-safe."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+        atexit.register(self.close)
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._f.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+
+def init(args) -> None:
+    """(reference ``mlops.init`` :99) — wire sinks from args. Tracking is on
+    by default (as in the reference) and off with ``enable_tracking: false``;
+    an unwritable log dir degrades to disabled instead of failing init."""
+    _state["run_id"] = str(getattr(args, "run_id", "0"))
+    _state["enabled"] = bool(getattr(args, "enable_tracking", True))
+    if not _state["enabled"]:
+        _state["sink"] = None
+        return
+    log_dir = os.path.expanduser(
+        getattr(args, "log_file_dir", None) or "~/.cache/fedml_tpu/logs")
+    path = os.path.join(log_dir, f"run_{_state['run_id']}.jsonl")
+    prev = _state.get("sink")
+    if prev is not None:
+        prev.close()
+    try:
+        _state["sink"] = JsonSink(path)
+    except OSError as e:
+        logger.warning("mlops sink unavailable (%s); tracking disabled", e)
+        _state["sink"] = None
+        _state["enabled"] = False
+    if bool(getattr(args, "sys_perf_profiling", False)):
+        start_sys_perf()
+
+
+def _emit(kind: str, payload: Dict[str, Any]) -> None:
+    sink = _state.get("sink")
+    if sink is None:
+        return
+    payload = dict(payload)
+    payload.update({"kind": kind, "ts": time.time(),
+                    "run_id": _state["run_id"]})
+    sink.emit(payload)
+
+
+def log(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+    """(reference ``mlops.log`` :178)"""
+    _emit("metric", {"metrics": metrics, "step": step})
+
+
+def log_metric(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+    log(metrics, step)
+
+
+def log_round_info(total_rounds: int, round_idx: int) -> None:
+    """(reference ``log_round_info`` :1004)"""
+    _emit("round", {"round_idx": round_idx, "total_rounds": total_rounds})
+
+
+def log_training_status(status: str, run_id: Optional[str] = None) -> None:
+    _emit("status", {"role": "client", "status": status})
+
+
+def log_aggregation_status(status: str, run_id: Optional[str] = None) -> None:
+    _emit("status", {"role": "server", "status": status})
+
+
+def log_model_info(round_idx: int, model_path: str) -> None:
+    _emit("model", {"round_idx": round_idx, "path": model_path})
+
+
+# --- event spans (reference MLOpsProfilerEvent) ----------------------------
+
+class event:
+    """Span context manager / pair API:
+
+        with mlops.event("train", round_idx=3): ...
+    or  mlops.event("train", started=True); ...; mlops.event("train",
+        started=False)
+    """
+
+    _open: Dict[str, float] = {}
+
+    def __init__(self, name: str, started: Optional[bool] = None,
+                 value: Any = None, **extra: Any):
+        self.name = name
+        self.extra = extra
+        if started is True:
+            event._open[name] = time.time()
+            _emit("event_start", {"event": name, "value": value, **extra})
+        elif started is False:
+            t0 = event._open.pop(name, None)
+            dur = time.time() - t0 if t0 else None
+            _emit("event_end", {"event": name, "value": value,
+                                "duration_s": dur, **extra})
+
+    def __enter__(self):
+        event._open[self.name] = time.time()
+        _emit("event_start", {"event": self.name, **self.extra})
+        return self
+
+    def __exit__(self, *exc):
+        t0 = event._open.pop(self.name, None)
+        _emit("event_end", {"event": self.name,
+                            "duration_s": time.time() - t0 if t0 else None,
+                            **self.extra})
+        return False
+
+
+# --- system perf daemon (reference mlops_device_perfs.py) ------------------
+
+def _sys_sample() -> Dict[str, Any]:
+    import psutil
+    vm = psutil.virtual_memory()
+    rec = {"cpu_pct": psutil.cpu_percent(interval=None),
+           "mem_pct": vm.percent,
+           "mem_used_gb": round(vm.used / 2**30, 3)}
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "bytes_in_use" in stats:
+            rec["device_mem_gb"] = round(stats["bytes_in_use"] / 2**30, 3)
+    except Exception:
+        pass
+    return rec
+
+
+def start_sys_perf(interval_s: float = 10.0) -> None:
+    if _state.get("sys_thread"):
+        return
+
+    def loop():
+        # identity check: a stop+start within one interval must not leave
+        # the old thread alive emitting duplicates
+        while _state.get("sys_thread") is threading.current_thread():
+            _emit("sys_perf", _sys_sample())
+            time.sleep(interval_s)
+
+    t = threading.Thread(target=loop, daemon=True)
+    _state["sys_thread"] = t
+    t.start()
+
+
+def stop_sys_perf() -> None:
+    _state["sys_thread"] = None
+
+
+# --- JAX profiler bridge ---------------------------------------------------
+
+def start_device_trace(log_dir: Optional[str] = None) -> str:
+    """Start a JAX/XLA profiler trace (TensorBoard-viewable) — the
+    TPU-native replacement for wall-clock profiling."""
+    import jax
+    path = os.path.expanduser(log_dir or "~/.cache/fedml_tpu/traces")
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+    return path
+
+
+def stop_device_trace() -> None:
+    import jax
+    jax.profiler.stop_trace()

@@ -1,0 +1,169 @@
+"""Finite-field arithmetic for secure aggregation, TPU-friendly.
+
+Parity target: the field math under reference ``core/mpc/secagg.py`` (prime
+field, quantization ``transform_tensor_to_finite`` :351, Shamir/BGW/LCC
+coding). The reference computes in int64 numpy; TPUs have no fast int64, so
+(SURVEY §7 hard parts) everything here is designed for **uint32 lanes with
+p = 2^31 - 1** (Mersenne):
+
+* add/sub fit uint32 with one conditional subtract;
+* multiply uses 16-bit limb decomposition + the Mersenne fold 2^31 ≡ 1 (mod p),
+  so all intermediates stay below 2^32 — jit-able on TPU;
+* host-side helpers use numpy uint64 where convenience wins (share
+  generation, Lagrange coefficients — tiny data).
+
+The masking data path (quantize -> add masks -> sum -> dequantize) is pure
+jnp/uint32 and can run inside the jitted round.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = np.uint32(2**31 - 1)  # Mersenne prime 2147483647
+_P_I = int(P)
+
+
+# ---------------------------------------------------------------------------
+# jnp (TPU) path — uint32 lanes
+# ---------------------------------------------------------------------------
+
+def ff_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) mod p for a, b in [0, p). Sum < 2^32 so uint32 wraps are
+    impossible; one conditional subtract reduces."""
+    s = a.astype(jnp.uint32) + b.astype(jnp.uint32)
+    return jnp.where(s >= _P_I, s - _P_I, s)
+
+
+def ff_neg(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(a == 0, a, _P_I - a.astype(jnp.uint32))
+
+
+def ff_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return ff_add(a, ff_neg(b))
+
+
+def _fold31(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a uint32 value < 2^32 mod p via the Mersenne identity
+    x = (x >> 31) + (x & (2^31-1)) (mod p)."""
+    y = (x >> 31) + (x & _P_I)
+    return jnp.where(y >= _P_I, y - _P_I, y)
+
+
+def ff_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a * b) mod p with all intermediates < 2^32.
+
+    Split a = ah*2^16 + al (ah < 2^15, al < 2^16) and fold partial products:
+        a*b = ah*b*2^16 + al*b (mod p)
+    Each partial product is itself computed by splitting b the same way, and
+    powers of two are folded with 2^31 ≡ 1 (mod p).
+    """
+    a = a.astype(jnp.uint32)
+    b = b.astype(jnp.uint32)
+    ah, al = a >> 16, a & 0xFFFF
+    bh, bl = b >> 16, b & 0xFFFF
+    # partial products, each < 2^31 (15+16 or 16+16 bits)
+    hh = _fold31(ah * bh)          # * 2^32 ≡ * 2 (mod p)
+    hl = _fold31(ah * bl)          # * 2^16
+    lh = _fold31(al * bh)          # * 2^16
+    ll = _fold31(al * bl)          # * 1
+    # t16 = (hl + lh) * 2^16 (mod p), computed exactly:
+    # t*2^16 = (t >> 15) * 2^31 + (t & 0x7FFF) * 2^16
+    #        ≡ (t >> 15) + ((t & 0x7FFF) << 16)   (mod p)
+    t = ff_add(hl, lh)
+    t16 = ff_add(t >> 15, (t & 0x7FFF) << 16)
+    # hh * 2^32 ≡ hh * 2  (mod p)
+    h2 = ff_add(hh, hh)
+    return ff_add(h2, ff_add(t16, ll))
+
+
+def ff_random(rng: jax.Array, shape: Tuple[int, ...]) -> jnp.ndarray:
+    """Uniform field elements in [0, p) — rejection-free: draw 32 bits and
+    fold (bias 2^-31, negligible for masking)."""
+    bits = jax.random.bits(rng, shape, dtype=jnp.uint32)
+    return _fold31(bits)
+
+
+# ---------------------------------------------------------------------------
+# quantization: float tree <-> field vector
+# ---------------------------------------------------------------------------
+
+def quantize(x: jnp.ndarray, frac_bits: int = 16) -> jnp.ndarray:
+    """Signed float -> field element (reference
+    ``transform_tensor_to_finite`` semantics): q = round(x * 2^frac_bits),
+    negatives represented as p - |q|."""
+    scaled = jnp.round(x.astype(jnp.float32) * (2.0 ** frac_bits))
+    # clip to +-2^29 so sums of many clients stay decodable
+    lim = 2.0 ** 29
+    scaled = jnp.clip(scaled, -lim, lim)
+    pos = scaled >= 0
+    mag = jnp.abs(scaled).astype(jnp.uint32)
+    return jnp.where(pos, mag, (_P_I - mag).astype(jnp.uint32))
+
+
+def dequantize(q: jnp.ndarray, frac_bits: int = 16) -> jnp.ndarray:
+    """Field element -> signed float; values above p/2 are negative.
+
+    The signed value is computed in int32 (exact — both branches are
+    < 2^30) before the float conversion; converting the raw ~2^31 uint32 to
+    float32 first would round away up to 7 low bits."""
+    q = q.astype(jnp.uint32)
+    neg = q > (_P_I // 2)
+    mag = jnp.where(neg, (_P_I - q).astype(jnp.int32),
+                    q.astype(jnp.int32))
+    signed = jnp.where(neg, -mag, mag).astype(jnp.float32)
+    return signed / (2.0 ** frac_bits)
+
+
+# ---------------------------------------------------------------------------
+# host (numpy uint64) path — coding math on small arrays
+# ---------------------------------------------------------------------------
+
+def np_mod(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.uint64) % np.uint64(_P_I)
+
+
+def np_mul(a, b) -> np.ndarray:
+    return (np.asarray(a, np.uint64) * np.asarray(b, np.uint64)) % np.uint64(_P_I)
+
+
+def np_add(a, b) -> np.ndarray:
+    return (np.asarray(a, np.uint64) + np.asarray(b, np.uint64)) % np.uint64(_P_I)
+
+
+def np_sub(a, b) -> np.ndarray:
+    return (np.asarray(a, np.uint64) + np.uint64(_P_I)
+            - np.asarray(b, np.uint64) % np.uint64(_P_I)) % np.uint64(_P_I)
+
+
+def np_pow(base: int, exp: int) -> int:
+    return pow(int(base), int(exp), _P_I)
+
+
+def np_inv(a: Union[int, np.ndarray]):
+    """Modular inverse by Fermat's little theorem (p prime)."""
+    if np.isscalar(a) or np.asarray(a).ndim == 0:
+        return np_pow(int(a), _P_I - 2)
+    flat = [np_pow(int(v), _P_I - 2) for v in np.asarray(a).ravel()]
+    return np.asarray(flat, np.uint64).reshape(np.asarray(a).shape)
+
+
+def lagrange_coeffs_at(xs: np.ndarray, x0: int = 0) -> np.ndarray:
+    """Lagrange basis coefficients l_i(x0) over the field for interpolation
+    points ``xs`` (used by Shamir reconstruction and LCC decoding)."""
+    xs = np.asarray(xs, np.uint64)
+    n = len(xs)
+    out = np.zeros(n, np.uint64)
+    for i in range(n):
+        num, den = 1, 1
+        for j in range(n):
+            if j == i:
+                continue
+            num = (num * ((x0 - int(xs[j])) % _P_I)) % _P_I
+            den = (den * ((int(xs[i]) - int(xs[j])) % _P_I)) % _P_I
+        out[i] = (num * np_pow(den, _P_I - 2)) % _P_I
+    return out

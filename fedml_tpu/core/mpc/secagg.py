@@ -1,0 +1,196 @@
+"""Secure aggregation (SecAgg) primitives — Bonawitz et al. style.
+
+Parity target: reference ``core/mpc/secagg.py`` (395 LoC: ``model_masking``
+:83, ``BGW_encoding/decoding`` :164/:192, ``LCC_encoding/decoding``
+:213/:297, ``transform_tensor_to_finite`` :351) re-designed for TPU
+(SURVEY §7: requantized to p = 2^31 - 1 with uint32 lanes; the reference
+uses int64 numpy).
+
+Components:
+* Shamir secret sharing over GF(p) (= the BGW encode/decode the reference
+  uses for mask-seed shares);
+* pairwise + self masks expanded from seeds with a counter-based PRG
+  (deterministic, so a dropped client's masks can be re-expanded after its
+  seed is reconstructed from shares);
+* the jit-able masking data path: quantize -> add masks (uint32 mod p) ->
+  sum -> unmask -> dequantize.
+
+The wire protocol (advertise keys, share seeds, masked input, unmask) lives
+in ``cross_silo/secagg``; this module is the math.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .field_ops import (P, dequantize, ff_add, ff_neg, ff_random, ff_sub,
+                        lagrange_coeffs_at, np_add, np_mul, quantize)
+
+_P_I = int(P)
+
+
+# ---------------------------------------------------------------------------
+# Shamir secret sharing over GF(p)  (reference BGW_encoding/decoding)
+# ---------------------------------------------------------------------------
+
+def shamir_share(secret: int, n_shares: int, threshold: int,
+                 rng: np.random.RandomState) -> List[Tuple[int, int]]:
+    """Split ``secret`` into ``n_shares`` points of a degree-(threshold-1)
+    polynomial; any ``threshold`` shares reconstruct."""
+    coeffs = [int(secret) % _P_I] + [int(rng.randint(0, _P_I))
+                                     for _ in range(threshold - 1)]
+    shares = []
+    for xi in range(1, n_shares + 1):
+        acc = 0
+        for c in reversed(coeffs):  # Horner
+            acc = (acc * xi + c) % _P_I
+        shares.append((xi, acc))
+    return shares
+
+
+def shamir_reconstruct(shares: Sequence[Tuple[int, int]]) -> int:
+    xs = np.asarray([s[0] for s in shares])
+    ys = np.asarray([s[1] for s in shares], np.uint64)
+    lag = lagrange_coeffs_at(xs, 0)
+    return int(np.sum(np_mul(lag, ys) % np.uint64(_P_I)) % _P_I)
+
+
+# ---------------------------------------------------------------------------
+# PRG mask expansion (counter-based, deterministic per seed)
+# ---------------------------------------------------------------------------
+
+def expand_mask(seed: int, length: int) -> np.ndarray:
+    """Expand a field-element seed into ``length`` field elements. SHA-256
+    counter mode — deterministic across hosts, no RNG-state coupling."""
+    out = np.empty(length, np.uint32)
+    n_blocks = -(-length // 8)  # 8 uint32 per 32-byte digest
+    buf = np.empty(n_blocks * 8, np.uint32)
+    sbytes = int(seed).to_bytes(8, "little")
+    for b in range(n_blocks):
+        d = hashlib.sha256(sbytes + b.to_bytes(4, "little")).digest()
+        buf[b * 8:(b + 1) * 8] = np.frombuffer(d, np.uint32)
+    out[:] = buf[:length] % np.uint32(_P_I)
+    return out
+
+
+def salt_seed(seed: int, round_idx: int) -> int:
+    """Derive a per-round seed so masks differ across FL rounds while the
+    shared/Shamir-protected base seed is exchanged once."""
+    d = hashlib.sha256(f"{int(seed)}@{int(round_idx)}".encode()).digest()
+    return int.from_bytes(d[:8], "little") % _P_I
+
+
+def pairwise_seed(secret_i: int, public_j: int) -> int:
+    """Symmetric pairwise seed derived from i's secret and j's public key.
+    Stand-in for the ECDH agreement of full SecAgg (no crypto backend in
+    this environment); the *protocol* shape is identical."""
+    lo, hi = sorted((int(secret_i), int(public_j)))
+    d = hashlib.sha256(f"{lo}:{hi}".encode()).digest()
+    return int.from_bytes(d[:8], "little") % _P_I
+
+
+# ---------------------------------------------------------------------------
+# jit-able masking data path
+# ---------------------------------------------------------------------------
+
+def mask_vector(quantized: jnp.ndarray, self_mask: jnp.ndarray,
+                pair_masks_add: jnp.ndarray,
+                pair_masks_sub: jnp.ndarray) -> jnp.ndarray:
+    """masked = q + b_i + sum_{j>i} s_ij - sum_{j<i} s_ji  (mod p)."""
+    return ff_add(ff_add(quantized, self_mask),
+                  ff_sub(pair_masks_add, pair_masks_sub))
+
+
+def sum_mod_p(masked: jnp.ndarray) -> jnp.ndarray:
+    """Sum a [K, D] uint32 matrix mod p without overflow: split into 16-bit
+    limbs, sum in uint32 (safe for K < 2^16), recombine with the Mersenne
+    identity 2^31 ≡ 1 -> 2^16*hi_sum folds into (hi_sum >> 15) + ((hi_sum &
+    0x7fff) << 16)."""
+    lo = jnp.sum(masked & 0xFFFF, axis=0, dtype=jnp.uint32)
+    hi = jnp.sum(masked >> 16, axis=0, dtype=jnp.uint32)
+
+    def fold(x):
+        y = (x >> 31) + (x & _P_I)
+        return jnp.where(y >= _P_I, y - _P_I, y)
+
+    hi16 = ff_add(hi >> 15, (hi & 0x7FFF) << 16)
+    return ff_add(fold(lo), hi16)
+
+
+# ---------------------------------------------------------------------------
+# whole-protocol simulation helpers (used by tests and the in-process
+# cross-silo SecAgg runtime)
+# ---------------------------------------------------------------------------
+
+class SecAggClient:
+    """One client's SecAgg state across the four protocol rounds."""
+
+    def __init__(self, cid: int, n_clients: int, threshold: int, seed: int):
+        self.cid = cid
+        self.n = n_clients
+        self.t = threshold
+        rng = np.random.RandomState(seed)
+        self.secret_key = int(rng.randint(0, _P_I))
+        self.public_key = self.secret_key  # stand-in DH (see pairwise_seed)
+        self.self_seed = int(rng.randint(0, _P_I))
+        self._rng = rng
+        self.peer_publics: Dict[int, int] = {}
+
+    # round 1: advertise keys -> server broadcasts
+    def receive_publics(self, publics: Dict[int, int]) -> None:
+        self.peer_publics = dict(publics)
+
+    # round 2: share self_seed and secret_key via Shamir
+    def make_shares(self) -> Dict[int, Tuple[Tuple[int, int], Tuple[int, int]]]:
+        seed_shares = shamir_share(self.self_seed, self.n, self.t, self._rng)
+        key_shares = shamir_share(self.secret_key, self.n, self.t, self._rng)
+        return {j: (seed_shares[j], key_shares[j]) for j in range(self.n)}
+
+    # round 3: masked input
+    def masked_update(self, vec: np.ndarray) -> np.ndarray:
+        d = len(vec)
+        q = np.asarray(quantize(jnp.asarray(vec)))
+        total = expand_mask(self.self_seed, d).astype(np.uint64)
+        for j, pub in self.peer_publics.items():
+            if j == self.cid:
+                continue
+            s = expand_mask(pairwise_seed(self.secret_key, pub), d).astype(np.uint64)
+            if self.cid < j:
+                total = (total + s) % _P_I
+            else:
+                total = (total + _P_I - s) % _P_I
+        return ((q.astype(np.uint64) + total) % _P_I).astype(np.uint32)
+
+
+def secagg_unmask(
+    masked_sum: np.ndarray,
+    surviving: Sequence[int],
+    dropped: Sequence[int],
+    self_seed_shares: Dict[int, List[Tuple[int, int]]],
+    secret_key_shares: Dict[int, List[Tuple[int, int]]],
+    publics: Dict[int, int],
+    length: int,
+) -> np.ndarray:
+    """Server-side unmasking: subtract surviving clients' self masks
+    (reconstructed from their seed shares) and cancel dropped clients'
+    pairwise masks (reconstructed from their key shares)."""
+    total = masked_sum.astype(np.uint64)
+    for i in surviving:
+        seed = shamir_reconstruct(self_seed_shares[i])
+        total = (total + _P_I - expand_mask(seed, length).astype(np.uint64)) % _P_I
+    for i in dropped:
+        sk = shamir_reconstruct(secret_key_shares[i])
+        for j in surviving:
+            s = expand_mask(pairwise_seed(sk, publics[j]), length).astype(np.uint64)
+            if i < j:   # i added +s_ij into its (lost) contribution — but i
+                # dropped, so the *surviving* j subtracted/added the
+                # counterpart; cancel j's leftover term
+                total = (total + s) % _P_I
+            else:
+                total = (total + _P_I - s) % _P_I
+    return total.astype(np.uint32)

@@ -1,0 +1,11 @@
+"""Trust & robustness cross-cuts (reference ``core/security/``): attack zoo,
+defense dispatch, gradient-inversion demo. Engines consult the
+``FedMLAttacker`` / ``FedMLDefender`` singletons exactly where the reference
+consults them from the ClientTrainer/ServerAggregator hooks."""
+
+from .attack import FedMLAttacker, ATTACK_TYPES
+from .defense import FedMLDefender, DEFENSE_TYPES, stack_to_matrix
+from .defense import robust_agg
+
+__all__ = ["FedMLAttacker", "FedMLDefender", "ATTACK_TYPES",
+           "DEFENSE_TYPES", "stack_to_matrix", "robust_agg"]

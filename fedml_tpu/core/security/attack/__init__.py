@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ....utils.confval import get_float, get_int
+
 PyTree = Any
 
 ATTACK_TYPES = ("byzantine_random", "byzantine_zero", "byzantine_flip",
@@ -82,9 +84,8 @@ class FedMLAttacker:
         self.attack_type = str(getattr(args, "attack_type", None) or "").lower()
         self.enabled = bool(getattr(args, "enable_attack", False)) and \
             self.attack_type in ATTACK_TYPES
-        self.byzantine_client_num = int(
-            getattr(args, "byzantine_client_num", 0) or 0)
-        self.attack_scale = float(getattr(args, "attack_scale", 1.0) or 1.0)
+        self.byzantine_client_num = get_int(args, "byzantine_client_num", 0)
+        self.attack_scale = get_float(args, "attack_scale", 1.0)
 
     @classmethod
     def get_instance(cls, args=None) -> "FedMLAttacker":

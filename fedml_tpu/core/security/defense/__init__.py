@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...collectives import tree_flatten_to_vector, vector_to_tree_like
+from ...collectives import vector_to_tree_like
+from ....utils.confval import get_float, get_int
 from . import robust_agg
 
 PyTree = Any
@@ -52,13 +53,13 @@ class FedMLDefender:
         self.defense_type = str(getattr(args, "defense_type", None) or "").lower()
         self.enabled = bool(getattr(args, "enable_defense", False)) and \
             self.defense_type in DEFENSE_TYPES
-        self.byzantine_count = int(getattr(args, "byzantine_client_num", 0) or 0)
-        self.krum_param_m = int(getattr(args, "krum_param_m", 1) or 1)
-        self.trim_fraction = float(getattr(args, "beta", 0.1) or 0.1)
-        self.norm_bound = float(getattr(args, "norm_bound", 5.0) or 5.0)
-        self.cclip_tau = float(getattr(args, "tau", 10.0) or 10.0)
-        self.dp_stddev = float(getattr(args, "stddev", 0.002) or 0.002)
-        self.alpha = float(getattr(args, "alpha", 1.0) or 1.0)
+        self.byzantine_count = get_int(args, "byzantine_client_num", 0)
+        self.krum_param_m = get_int(args, "krum_param_m", 1)
+        self.trim_fraction = get_float(args, "beta", 0.1)
+        self.norm_bound = get_float(args, "norm_bound", 5.0)
+        self.cclip_tau = get_float(args, "tau", 10.0)
+        self.dp_stddev = get_float(args, "stddev", 0.002)
+        self.alpha = get_float(args, "alpha", 1.0)
         # host-side cross-round state
         self._fg_history: Optional[np.ndarray] = None
         self._cclip_momentum = None
@@ -76,6 +77,23 @@ class FedMLDefender:
         return self.enabled
 
     # -----------------------------------------------------------------------
+    def defend_matrix(
+        self,
+        mat: jnp.ndarray,
+        weights: jnp.ndarray,
+        rng: Optional[jax.Array] = None,
+        client_ids: Optional[np.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, Dict]:
+        """[K, D] update matrix -> defended aggregate vector [D]. The entry
+        point engines use (both simulators flatten their stacked updates to
+        the same matrix layout, which keeps SP/TPU parity a property of one
+        code path)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(self._round)
+        vec, info = self._dispatch(mat, jnp.asarray(weights, jnp.float32), rng,
+                                   client_ids)
+        self._round += 1
+        return vec, info
+
     def defend(
         self,
         stacked_update: PyTree,
@@ -86,10 +104,7 @@ class FedMLDefender:
         """Stacked client updates -> defended aggregate update (pytree)."""
         template = jax.tree_util.tree_map(lambda l: l[0], stacked_update)
         mat = stack_to_matrix(stacked_update)
-        rng = rng if rng is not None else jax.random.PRNGKey(self._round)
-        vec, info = self._dispatch(mat, jnp.asarray(weights, jnp.float32), rng,
-                                   client_ids)
-        self._round += 1
+        vec, info = self.defend_matrix(mat, weights, rng, client_ids)
         return vector_to_tree_like(vec, template), info
 
     def _dispatch(self, mat, weights, rng, client_ids):

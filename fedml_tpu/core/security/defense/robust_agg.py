@@ -200,10 +200,12 @@ def three_sigma(updates: Arr, weights: Arr, sigma_factor: float = 3.0
                 ) -> Tuple[Arr, Dict]:
     """3-sigma outlier rejection (reference ``defense/three_sigma_defense.py``
     family): score = distance to the coordinate median vector; drop clients
-    more than ``sigma_factor`` std above the mean score."""
+    more than ``sigma_factor`` robust-sigma above the median score (median +
+    MAD statistics, so the byzantine scores cannot inflate the threshold)."""
     med = jnp.median(updates, axis=0)
     scores = jnp.linalg.norm(updates - med[None], axis=1)
-    mu, sd = jnp.mean(scores), jnp.std(scores) + 1e-12
+    mu = jnp.median(scores)
+    sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
     keep = (scores <= mu + sigma_factor * sd).astype(updates.dtype)
     w = weights * keep
     return weighted_mean(updates, w), {"scores": scores, "kept": keep}
@@ -211,9 +213,12 @@ def three_sigma(updates: Arr, weights: Arr, sigma_factor: float = 3.0
 
 def outlier_detection(updates: Arr, weights: Arr, z_threshold: float = 2.5
                       ) -> Tuple[Arr, Dict]:
-    """Norm-based z-score filter (reference ``defense/outlier_detection.py``)."""
+    """Norm-based robust z-score filter (reference
+    ``defense/outlier_detection.py``); median/MAD statistics so outliers
+    cannot inflate their own acceptance threshold."""
     norms = jnp.linalg.norm(updates, axis=1)
-    mu, sd = jnp.mean(norms), jnp.std(norms) + 1e-12
+    mu = jnp.median(norms)
+    sd = 1.4826 * jnp.median(jnp.abs(norms - mu)) + 1e-12
     keep = (jnp.abs(norms - mu) <= z_threshold * sd).astype(updates.dtype)
     return weighted_mean(updates, weights * keep), {"kept": keep}
 

@@ -1,0 +1,23 @@
+"""Data-poisoning application shared by simulation engines — the engine-side
+counterpart of the reference's ``ClientTrainer.update_dataset`` poisoning
+hook (``core/alg_frame/client_trainer.py:38``)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.security import FedMLAttacker
+
+
+def poison_dataset(fed, attacker: FedMLAttacker):
+    """Apply label-flipping to the byzantine clients' training shards."""
+    mask = attacker.byzantine_mask(np.arange(fed.num_clients))  # [K]
+    y = np.asarray(fed.train.y)
+    flipped = attacker.poison_labels(y, fed.num_classes)
+    sel = mask.reshape((-1,) + (1,) * (y.ndim - 1)) > 0
+    new_y = np.where(sel, flipped, y)
+    new_train = fed.train.replace(y=jnp.asarray(new_y))
+    return dataclasses.replace(fed, train=new_train)

@@ -20,8 +20,15 @@ import numpy as np
 
 from ...core.algframe.types import ClientData, TrainHyper
 from ...core.algframe.local_training import evaluate
-from ...core.collectives import tree_weighted_average
+from ...core.collectives import tree_weighted_average, vector_to_tree_like
+from ...core.dp import FedMLDifferentialPrivacy
+from ...core import mlops
+from ...core.checkpoint import RoundCheckpointer
+from ...core.contribution import ContributionAssessorManager
+from ...core.security import FedMLAttacker, FedMLDefender, stack_to_matrix
 from ..sampling import client_sampling
+from ..tpu.engine import (ATTACK_FOLD, DEFENSE_FOLD, DP_CDP_FOLD,
+                          DP_LDP_FOLD)
 
 logger = logging.getLogger(__name__)
 
@@ -45,10 +52,78 @@ class SPSimulator:
         self._local_train = jax.jit(self.opt.local_train)
         self._server_update = jax.jit(self.opt.server_update)
         self._evaluate = jax.jit(lambda p, x, y, m: evaluate(spec, p, x, y, m))
+        self.attacker = FedMLAttacker(args)
+        self.defender = FedMLDefender(args)
+        self.dp = FedMLDifferentialPrivacy(args)
+        if self.attacker.is_data_attack():
+            from ..poisoning import poison_dataset
+            self.fed = poison_dataset(self.fed, self.attacker)
+        from ..tpu.engine import _check_extras_compat
+        _check_extras_compat(
+            self.opt, self.params, self.dp,
+            self.attacker.is_model_attack()
+            or self.defender.is_defense_enabled())
+        self.contribution = ContributionAssessorManager(args)
+        self.ckpt = RoundCheckpointer(
+            getattr(args, "checkpoint_dir", None),
+            int(getattr(args, "checkpoint_every_rounds", 0) or 0))
         self.history: List[Dict[str, Any]] = []
+
+    def _ckpt_state(self):
+        return {"params": self.params, "server_state": self.server_state,
+                "client_states": self.client_states, "rng": self.rng,
+                "dp": self.dp.state_dict()}
+
+    def _load_ckpt_state(self, st):
+        self.params = st["params"]
+        self.server_state = st["server_state"]
+        self.client_states = st["client_states"]
+        self.rng = st["rng"]
+        self.dp.load_state_dict(st["dp"])
 
     def _client_data(self, cid: int) -> ClientData:
         return jax.tree_util.tree_map(lambda a: a[cid], self.fed.train)
+
+    def _aggregate_robust(self, stacked, w, sampled, round_key, round_idx):
+        """FedAvg weighted average, or the attack->defense->contribution
+        pipeline when enabled (reference ServerAggregator
+        on_before_aggregation / aggregate hooks,
+        ``core/alg_frame/server_aggregator.py:44-103``). Contribution is
+        assessed on the post-attack matrix — the server can only ever see
+        what clients actually sent — matching the TPU path row-for-row."""
+        if not (self.attacker.is_model_attack()
+                or self.defender.is_defense_enabled()
+                or self.contribution.enabled):
+            return tree_weighted_average(stacked, w)
+        ids = np.asarray(sampled)
+        template = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        mat = stack_to_matrix(stacked)
+        if self.attacker.is_model_attack():
+            mat = self.attacker.poison_updates(
+                mat, ids, jax.random.fold_in(round_key, ATTACK_FOLD))
+        if self.contribution.enabled:
+            self._assess_contribution(mat, w, sampled, round_idx)
+        if self.defender.is_defense_enabled():
+            vec, _ = self.defender.defend_matrix(
+                mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+        else:
+            from ...core.security.defense.robust_agg import weighted_mean
+            vec = weighted_mean(mat, jnp.asarray(w, jnp.float32))
+        return vector_to_tree_like(vec, template)
+
+    def _assess_contribution(self, mat, w, sampled, round_idx):
+        from ...core.collectives import tree_flatten_to_vector
+        spec, fed, params = self.spec, self.fed, self.params
+        pvec = tree_flatten_to_vector(params)
+
+        def eval_fn(p):
+            cand = vector_to_tree_like(p["v"], params)
+            stats = evaluate(spec, cand, fed.test["x"], fed.test["y"],
+                             fed.test["mask"])
+            return stats["correct"] / jnp.maximum(stats["count"], 1.0)
+
+        self.contribution.assess({"v": pvec}, {"v": mat}, w, eval_fn,
+                                 client_ids=sampled, round_idx=round_idx)
 
     def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
         args = self.args
@@ -56,7 +131,14 @@ class SPSimulator:
         hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
                            epochs=int(args.epochs))
         t0 = time.time()
-        for round_idx in range(rounds):
+        start_round = 0
+        restored = self.ckpt.latest(self._ckpt_state())
+        if restored is not None:
+            step, st = restored
+            self._load_ckpt_state(st)
+            start_round = step + 1
+            logger.info("resumed from checkpoint at round %d", step)
+        for round_idx in range(start_round, rounds):
             sampled = client_sampling(round_idx, self.fed.num_clients,
                                       int(args.client_num_per_round))
             round_key = jax.random.fold_in(self.rng, round_idx)
@@ -67,7 +149,13 @@ class SPSimulator:
                     self.params, self.server_state, self.client_states[cid],
                     self._client_data(cid), key,
                     hyper.replace(round_idx=jnp.int32(round_idx)))
-                updates.append(out.update)
+                upd = out.update
+                if self.dp.is_local_dp_enabled():
+                    upd = self.dp.add_local_noise(
+                        upd, jax.random.fold_in(key, DP_LDP_FOLD))
+                elif self.dp.is_global_dp_enabled():
+                    upd = self.dp.clip_update(upd)
+                updates.append(upd)
                 weights.append(out.weight)
                 extras_list.append(out.extras)
                 metrics.append(out.metrics)
@@ -75,7 +163,12 @@ class SPSimulator:
                     self.client_states[cid] = out.client_state
             w = jnp.stack(weights)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *updates)
-            agg_update = tree_weighted_average(stacked, w)
+            agg_update = self._aggregate_robust(stacked, w, sampled,
+                                                round_key, round_idx)
+            if self.dp.is_global_dp_enabled():
+                agg_update = self.dp.add_global_noise(
+                    agg_update, jax.random.fold_in(round_key, DP_CDP_FOLD))
+            self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
             if extras_list[0]:
                 stacked_ex = jax.tree_util.tree_map(
                     lambda *xs: jnp.stack(xs), *extras_list)
@@ -100,8 +193,23 @@ class SPSimulator:
                 logger.info("round %d: test_acc=%.4f test_loss=%.4f",
                             round_idx, rec["test_acc"], rec["test_loss"])
             self.history.append(rec)
+            self.ckpt.maybe_save(round_idx, self._ckpt_state())
+            mlops.log_round_info(rounds, round_idx)
+            mlops.log({k: v for k, v in rec.items() if k != "round"},
+                      step=round_idx)
         wall = time.time() - t0
-        last_eval = next(r for r in reversed(self.history) if "test_acc" in r)
-        return {"params": self.params, "history": self.history,
-                "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
-                "rounds": rounds}
+        last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
+                         None)
+        if last_eval is None:
+            # resumed past the final round: evaluate the restored params
+            stats = self._evaluate(self.params, self.fed.test["x"],
+                                   self.fed.test["y"], self.fed.test["mask"])
+            n = max(float(stats["count"]), 1.0)
+            last_eval = {"test_acc": float(stats["correct"]) / n,
+                         "test_loss": float(stats["loss_sum"]) / n}
+        result = {"params": self.params, "history": self.history,
+                  "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
+                  "rounds": rounds}
+        if self.dp.is_dp_enabled():
+            result["dp_epsilon_spent"] = self.dp.get_epsilon_spent()
+        return result

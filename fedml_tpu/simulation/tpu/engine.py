@@ -27,9 +27,21 @@ from ...constants import AXIS_CLIENT
 from ...core.algframe.types import ClientData, TrainHyper
 from ...core.algframe.local_training import evaluate
 from ...core.collectives import (
-    psum_tree, tree_scale, tree_zeros_like)
+    psum_tree, tree_scale, tree_zeros_like, vector_to_tree_like)
+from ...core.dp import FedMLDifferentialPrivacy
+from ...core import mlops
+from ...core.checkpoint import RoundCheckpointer
+from ...core.contribution import ContributionAssessorManager
 from ...core.mesh import build_mesh
+from ...core.security import FedMLAttacker, FedMLDefender
 from ..sampling import client_sampling, build_schedule
+
+# PRNG fold tags reserved for the DP noise streams (shared with the SP
+# golden loop so LDP/CDP runs stay backend-parity-testable)
+DP_LDP_FOLD = 999983
+DP_CDP_FOLD = 999979
+ATTACK_FOLD = 1000003
+DEFENSE_FOLD = 1000033
 
 logger = logging.getLogger(__name__)
 PyTree = Any
@@ -47,6 +59,28 @@ def _pad_clients(fed_train: ClientData, num_clients: int, n_devices: int):
             return jnp.pad(a, pads)
         fed_train = jax.tree_util.tree_map(padleaf, fed_train)
     return fed_train, cpd, total
+
+
+def _check_extras_compat(opt, params, dp, robust_mode: bool) -> None:
+    """Optimizers whose extras ride the aggregation (SCAFFOLD delta_c, Mime
+    full-batch grads, FedNova a_i) leak through side channels that LDP noise
+    and robust defenses do not cover — combining them would silently void
+    the privacy/robustness guarantee, so refuse loudly."""
+    has_extras = bool(jax.tree_util.tree_leaves(opt.server_extras_zero(params)))
+    if not has_extras:
+        return
+    if dp.is_dp_enabled():
+        raise ValueError(
+            f"{opt.name}: DP cannot cover this optimizer's extras (they "
+            "would be aggregated un-noised and leak client data); use a "
+            "stateless-extras optimizer (FedAvg/FedProx/FedOpt/FedDyn) "
+            "with DP.")
+    if robust_mode:
+        raise ValueError(
+            f"{opt.name}: robust aggregation defends only model updates; "
+            "this optimizer's extras would bypass the defense. Use a "
+            "stateless-extras optimizer (FedAvg/FedProx/FedOpt/FedDyn) "
+            "with attacks/defenses.")
 
 
 class TPUSimulator:
@@ -72,6 +106,15 @@ class TPUSimulator:
         self.client_sharding = NamedSharding(self.mesh, P(AXIS_CLIENT))
         self.repl_sharding = NamedSharding(self.mesh, P())
 
+        self.attacker = FedMLAttacker(args)
+        self.defender = FedMLDefender(args)
+        self.dp = FedMLDifferentialPrivacy(args)
+        if self.attacker.is_data_attack():
+            from ..poisoning import poison_dataset
+            poisoned = poison_dataset(self.fed, self.attacker)
+            train = _pad_clients(poisoned.train, fed_dataset.num_clients,
+                                 self.n_devices)[0]
+
         def shard_clients(a):
             a = a.reshape((self.n_devices, self.cpd) + a.shape[1:])
             return jax.device_put(a, self.client_sharding)
@@ -88,14 +131,39 @@ class TPUSimulator:
             cstate0)
         self.client_states = jax.tree_util.tree_map(shard_clients, stacked_states)
 
-        self._round_fn = self._build_round_fn()
+        self.contribution = ContributionAssessorManager(args)
+        defended_mode = (self.attacker.is_model_attack()
+                         or self.defender.is_defense_enabled())
+        self.robust_mode = defended_mode or self.contribution.enabled
+        _check_extras_compat(self.opt, self.params, self.dp, defended_mode)
+        self._round_fn = (self._build_collect_fn() if self.robust_mode
+                          else self._build_round_fn())
+        self._server_update = jax.jit(self.opt.server_update)
         self._evaluate = jax.jit(lambda p, x, y, m: evaluate(spec, p, x, y, m))
+        self.ckpt = RoundCheckpointer(
+            getattr(args, "checkpoint_dir", None),
+            int(getattr(args, "checkpoint_every_rounds", 0) or 0))
         self.history: List[Dict[str, Any]] = []
+
+    def _ckpt_state(self):
+        return {"params": self.params, "server_state": self.server_state,
+                "client_states": self.client_states, "rng": self.rng,
+                "dp": self.dp.state_dict()}
+
+    def _load_ckpt_state(self, st):
+        self.params = jax.device_put(st["params"], self.repl_sharding)
+        self.server_state = jax.device_put(st["server_state"],
+                                           self.repl_sharding)
+        self.client_states = jax.device_put(st["client_states"],
+                                            self.client_sharding)
+        self.rng = jnp.asarray(st["rng"])
+        self.dp.load_state_dict(st["dp"])
 
     # ------------------------------------------------------------------
     def _build_round_fn(self):
         opt = self.opt
         cpd = self.cpd
+        dp = self.dp
 
         def round_body(params, server_state, local_data, local_states,
                        sched_idx, sched_active, round_key, hyper):
@@ -123,9 +191,17 @@ class TPUSimulator:
                 key = jax.random.fold_in(round_key, gcid)
                 out = opt.local_train(params, server_state, cstate, cdata,
                                       key, hyper)
+                upd = out.update
+                if dp.is_local_dp_enabled():
+                    upd = dp.add_local_noise(
+                        upd, jax.random.fold_in(key, DP_LDP_FOLD))
+                elif dp.is_global_dp_enabled():
+                    # CDP soundness: the per-client sensitivity bound must
+                    # hold before aggregation even though noise is central
+                    upd = dp.clip_update(upd)
                 w = out.weight * active
                 acc_u = jax.tree_util.tree_map(
-                    lambda acc, u: acc + u * w.astype(u.dtype), acc_u, out.update)
+                    lambda acc, u: acc + u * w.astype(u.dtype), acc_u, upd)
                 acc_ex = jax.tree_util.tree_map(
                     lambda acc, e: acc + e * w.astype(e.dtype), acc_ex, out.extras)
                 acc_w = acc_w + w
@@ -150,6 +226,9 @@ class TPUSimulator:
                 lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
             metrics = psum_tree(acc_m)
 
+            if dp.is_global_dp_enabled():
+                agg_update = dp.add_global_noise(
+                    agg_update, jax.random.fold_in(round_key, DP_CDP_FOLD))
             new_params, new_server_state = opt.server_update(
                 params, server_state, agg_update, agg_extras, hyper.round_idx)
             states = jax.tree_util.tree_map(lambda a: a[None], states)
@@ -166,6 +245,130 @@ class TPUSimulator:
         return jax.jit(shard_fn)
 
     # ------------------------------------------------------------------
+    def _build_collect_fn(self):
+        """Robust-mode round: instead of the psum fast path, emit every
+        scheduled client's raw update (sharded [D, S, ...]) so the host can
+        run the attack->defense pipeline on the full update matrix — the
+        mesh equivalent of the reference ServerAggregator receiving the
+        individual client models (``fedml_aggregator.py:58-78``)."""
+        opt = self.opt
+        cpd = self.cpd
+        dp = self.dp
+
+        def round_body(params, server_state, local_data, local_states,
+                       sched_idx, sched_active, round_key, hyper):
+            dev = jax.lax.axis_index(AXIS_CLIENT)
+            local_data = jax.tree_util.tree_map(lambda a: a[0], local_data)
+            local_states = jax.tree_util.tree_map(lambda a: a[0], local_states)
+            sched_idx = sched_idx[0]
+            sched_active = sched_active[0]
+            zero_extras = opt.server_extras_zero(params)
+            zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
+                            "count": jnp.float32(0)}
+
+            def slot(carry, s):
+                states, acc_ex, acc_w, acc_m = carry
+                li = sched_idx[s]
+                active = sched_active[s]
+                cdata = jax.tree_util.tree_map(lambda a: a[li], local_data)
+                cstate = jax.tree_util.tree_map(lambda a: a[li], states)
+                gcid = dev * cpd + li
+                key = jax.random.fold_in(round_key, gcid)
+                out = opt.local_train(params, server_state, cstate, cdata,
+                                      key, hyper)
+                upd = out.update
+                if dp.is_local_dp_enabled():
+                    upd = dp.add_local_noise(
+                        upd, jax.random.fold_in(key, DP_LDP_FOLD))
+                elif dp.is_global_dp_enabled():
+                    # CDP soundness: the per-client sensitivity bound must
+                    # hold before aggregation even though noise is central
+                    upd = dp.clip_update(upd)
+                w = out.weight * active
+                acc_ex = jax.tree_util.tree_map(
+                    lambda acc, e: acc + e * w.astype(e.dtype), acc_ex, out.extras)
+                acc_w = acc_w + w
+                acc_m = jax.tree_util.tree_map(
+                    lambda acc, m: acc + m * active, acc_m, out.metrics)
+                states = jax.tree_util.tree_map(
+                    lambda a, n: a.at[li].set(
+                        jnp.where(active > 0, n, a[li])), states, out.client_state)
+                return (states, acc_ex, acc_w, acc_m), (upd, w)
+
+            init = (local_states, zero_extras, jnp.float32(0), zero_metrics)
+            (states, acc_ex, acc_w, acc_m), (upd_stack, w_stack) = jax.lax.scan(
+                slot, init, jnp.arange(sched_idx.shape[0]))
+
+            total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
+            denom = jnp.maximum(total_w, 1e-12)
+            agg_extras = jax.tree_util.tree_map(
+                lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
+            metrics = psum_tree(acc_m)
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            upd_stack = jax.tree_util.tree_map(lambda a: a[None], upd_stack)
+            return upd_stack, w_stack[None], agg_extras, states, metrics
+
+        shard_fn = jax.shard_map(
+            round_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P()),
+            out_specs=(P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(AXIS_CLIENT), P()),
+            check_vma=False,
+        )
+        return jax.jit(shard_fn)
+
+    def _robust_aggregate(self, upd_stack, w_stack, sampled, n_slots,
+                          round_key, round_idx):
+        """Order the [D, S] update grid into sampled-client order, run
+        attacker/defender, return the aggregate update pytree (matches the
+        SP golden path client-for-client)."""
+        from ...core.security.defense import stack_to_matrix
+        from ...core.security.defense.robust_agg import weighted_mean
+        counts = [0] * self.n_devices
+        rows = []
+        for cid in sampled:
+            d = cid // self.cpd
+            rows.append(d * n_slots + counts[d])
+            counts[d] += 1
+        rows = jnp.asarray(np.asarray(rows, np.int32))
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), upd_stack)
+        mat = stack_to_matrix(flat)[rows]
+        w = w_stack.reshape(-1)[rows]
+        ids = np.asarray(sampled)
+        if self.attacker.is_model_attack():
+            mat = self.attacker.poison_updates(
+                mat, ids, jax.random.fold_in(round_key, ATTACK_FOLD))
+        if self.defender.is_defense_enabled():
+            vec, _ = self.defender.defend_matrix(
+                mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+        else:
+            vec = weighted_mean(mat, jnp.asarray(w, jnp.float32))
+        if self.contribution.enabled:
+            self._assess_contribution(mat, w, sampled, round_idx)
+        agg = vector_to_tree_like(vec, self.params)
+        if self.dp.is_global_dp_enabled():
+            agg = self.dp.add_global_noise(
+                agg, jax.random.fold_in(round_key, DP_CDP_FOLD))
+        return agg
+
+    def _assess_contribution(self, mat, w, sampled, round_idx):
+        """Shapley/LOO over the flattened update matrix — the subset-value
+        function works in vector space and unflattens per evaluation."""
+        from ...core.collectives import tree_flatten_to_vector
+        spec, fed, params = self.spec, self.fed, self.params
+        pvec = tree_flatten_to_vector(params)
+
+        def eval_fn(p):
+            cand = vector_to_tree_like(p["v"], params)
+            stats = evaluate(spec, cand, fed.test["x"], fed.test["y"],
+                             fed.test["mask"])
+            return stats["correct"] / jnp.maximum(stats["count"], 1.0)
+
+        self.contribution.assess({"v": pvec}, {"v": mat}, w, eval_fn,
+                                 client_ids=sampled, round_idx=round_idx)
+
     def run_round(self, round_idx: int, hyper: TrainHyper) -> Dict[str, float]:
         sampled = client_sampling(round_idx, self.fed.num_clients,
                                   int(self.args.client_num_per_round))
@@ -175,11 +378,25 @@ class TPUSimulator:
         idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
         active = jax.device_put(jnp.asarray(active), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, round_idx)
+        hyper_r = hyper.replace(round_idx=jnp.int32(round_idx))
+        if self.robust_mode:
+            (upd_stack, w_stack, agg_extras, self.client_states,
+             metrics) = self._round_fn(
+                self.params, self.server_state, self.train_data,
+                self.client_states, idx, active, round_key, hyper_r)
+            agg_update = self._robust_aggregate(
+                upd_stack, w_stack, sampled, int(idx.shape[1]),
+                round_key, round_idx)
+            self.params, self.server_state = self._server_update(
+                self.params, self.server_state, agg_update, agg_extras,
+                jnp.int32(round_idx))
+            self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
+            return metrics
         (self.params, self.server_state, self.client_states,
          metrics) = self._round_fn(
             self.params, self.server_state, self.train_data,
-            self.client_states, idx, active, round_key,
-            hyper.replace(round_idx=jnp.int32(round_idx)))
+            self.client_states, idx, active, round_key, hyper_r)
+        self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
         return metrics
 
     def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
@@ -188,7 +405,14 @@ class TPUSimulator:
         hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
                            epochs=int(args.epochs))
         t0 = time.time()
-        for round_idx in range(rounds):
+        start_round = 0
+        restored = self.ckpt.latest(self._ckpt_state())
+        if restored is not None:
+            step, st = restored
+            self._load_ckpt_state(st)
+            start_round = step + 1
+            logger.info("resumed from checkpoint at round %d", step)
+        for round_idx in range(start_round, rounds):
             metrics = self.run_round(round_idx, hyper)
             rec: Dict[str, Any] = {"round": round_idx}
             cnt = max(float(metrics["count"]), 1.0)
@@ -203,8 +427,22 @@ class TPUSimulator:
                 rec["test_loss"] = float(stats["loss_sum"]) / n
                 logger.info("round %d: test_acc=%.4f", round_idx, rec["test_acc"])
             self.history.append(rec)
+            self.ckpt.maybe_save(round_idx, self._ckpt_state())
+            mlops.log_round_info(rounds, round_idx)
+            mlops.log({k: v for k, v in rec.items() if k != "round"},
+                      step=round_idx)
         wall = time.time() - t0
-        last_eval = next(r for r in reversed(self.history) if "test_acc" in r)
-        return {"params": self.params, "history": self.history,
-                "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
-                "rounds": rounds}
+        last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
+                         None)
+        if last_eval is None:
+            stats = self._evaluate(self.params, self.fed.test["x"],
+                                   self.fed.test["y"], self.fed.test["mask"])
+            n = max(float(stats["count"]), 1.0)
+            last_eval = {"test_acc": float(stats["correct"]) / n,
+                         "test_loss": float(stats["loss_sum"]) / n}
+        result = {"params": self.params, "history": self.history,
+                  "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
+                  "rounds": rounds}
+        if self.dp.is_dp_enabled():
+            result["dp_epsilon_spent"] = self.dp.get_epsilon_spent()
+        return result

@@ -1,0 +1,144 @@
+"""MPC: finite-field ops (vs python bignum ground truth), quantization
+round-trip, Shamir sharing, full SecAgg protocol with dropout, LightSecAgg
+one-shot reconstruction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.mpc import (P, SecAggClient, aggregate_encoded,
+                                decode_aggregate_mask, dequantize, expand_mask,
+                                ff_add, ff_mul, ff_sub, mask_encoding,
+                                pairwise_seed, quantize, secagg_unmask,
+                                shamir_reconstruct, shamir_share, sum_mod_p)
+
+_P = int(P)
+
+
+class TestFieldOps:
+    def test_add_sub_vs_bignum(self):
+        rng = np.random.RandomState(0)
+        a = rng.randint(0, _P, 1000).astype(np.uint32)
+        b = rng.randint(0, _P, 1000).astype(np.uint32)
+        got = np.asarray(ff_add(jnp.asarray(a), jnp.asarray(b)))
+        want = (a.astype(object) + b.astype(object)) % _P
+        np.testing.assert_array_equal(got.astype(object), want)
+        got = np.asarray(ff_sub(jnp.asarray(a), jnp.asarray(b)))
+        want = (a.astype(object) - b.astype(object)) % _P
+        np.testing.assert_array_equal(got.astype(object), want)
+
+    def test_mul_vs_bignum(self):
+        rng = np.random.RandomState(1)
+        # include edge values
+        edge = np.asarray([0, 1, 2, _P - 1, _P - 2, 2**16, 2**16 - 1,
+                           2**30, 2**30 + 1], np.uint32)
+        a = np.concatenate([edge, rng.randint(0, _P, 2000).astype(np.uint32)])
+        b = np.concatenate([edge[::-1], rng.randint(0, _P, 2000).astype(np.uint32)])
+        got = np.asarray(ff_mul(jnp.asarray(a), jnp.asarray(b)))
+        want = (a.astype(object) * b.astype(object)) % _P
+        np.testing.assert_array_equal(got.astype(object), want)
+
+    def test_quantize_roundtrip(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(1000).astype(np.float32))
+        q = quantize(x)
+        back = dequantize(q)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=2e-5)
+
+    def test_sum_mod_p_matches_bignum(self):
+        rng = np.random.RandomState(3)
+        m = rng.randint(0, _P, size=(50, 200)).astype(np.uint32)
+        got = np.asarray(sum_mod_p(jnp.asarray(m)))
+        want = np.sum(m.astype(object), axis=0) % _P
+        np.testing.assert_array_equal(got.astype(object), want)
+
+
+class TestShamir:
+    def test_share_reconstruct(self):
+        rng = np.random.RandomState(0)
+        secret = 123456789
+        shares = shamir_share(secret, n_shares=7, threshold=4, rng=rng)
+        assert shamir_reconstruct(shares[:4]) == secret
+        assert shamir_reconstruct(shares[3:]) == secret  # any 4 work
+
+    def test_below_threshold_wrong(self):
+        rng = np.random.RandomState(0)
+        shares = shamir_share(42, n_shares=5, threshold=3, rng=rng)
+        assert shamir_reconstruct(shares[:2]) != 42  # w.h.p.
+
+
+class TestSecAggProtocol:
+    def _run(self, n=5, t=3, drop=()):
+        d = 64
+        rng = np.random.RandomState(0)
+        vecs = [rng.randn(d).astype(np.float32) * 0.5 for _ in range(n)]
+        clients = [SecAggClient(i, n, t, seed=100 + i) for i in range(n)]
+        publics = {c.cid: c.public_key for c in clients}
+        for c in clients:
+            c.receive_publics(publics)
+        # round 2: everyone shares seeds/keys; server stores per-owner shares
+        seed_shares = {i: [] for i in range(n)}
+        key_shares = {i: [] for i in range(n)}
+        for c in clients:
+            sh = c.make_shares()
+            for j, (ss, ks) in sh.items():
+                seed_shares[c.cid].append(ss)
+                key_shares[c.cid].append(ks)
+        surviving = [i for i in range(n) if i not in drop]
+        masked = {i: clients[i].masked_update(vecs[i]) for i in surviving}
+        masked_sum = np.zeros(d, np.uint64)
+        for m in masked.values():
+            masked_sum = (masked_sum + m) % _P
+        unmasked = secagg_unmask(
+            masked_sum.astype(np.uint32), surviving, list(drop),
+            {i: seed_shares[i][:t] for i in surviving},
+            {i: key_shares[i][:t] for i in drop},
+            publics, d)
+        got = np.asarray(dequantize(jnp.asarray(unmasked)))
+        want = np.sum([vecs[i] for i in surviving], axis=0)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_no_dropout(self):
+        self._run()
+
+    def test_one_dropout(self):
+        self._run(drop=(2,))
+
+    def test_two_dropouts(self):
+        self._run(n=6, t=3, drop=(1, 4))
+
+    def test_mask_hides_input(self):
+        """A single masked update must look uniform — no correlation with
+        the plaintext quantization."""
+        n, t, d = 4, 2, 256
+        clients = [SecAggClient(i, n, t, seed=7 + i) for i in range(n)]
+        publics = {c.cid: c.public_key for c in clients}
+        for c in clients:
+            c.receive_publics(publics)
+        vec = np.ones(d, np.float32)
+        masked = clients[0].masked_update(vec)
+        q = np.asarray(quantize(jnp.asarray(vec)))
+        diffs = (masked.astype(np.int64) - q.astype(np.int64)) % _P
+        # the mask should spread over the field, not cluster near 0
+        assert np.std(diffs.astype(np.float64)) > _P / 10
+
+
+class TestLightSecAgg:
+    def test_aggregate_mask_reconstruction(self):
+        n, t_priv, t_split, d = 6, 2, 2, 32
+        rng = np.random.RandomState(0)
+        masks = [rng.randint(0, _P, d).astype(np.uint64) for _ in range(n)]
+        # each client encodes its mask; client j holds the j-th coded row
+        coded = [mask_encoding(masks[i], n, t_priv, t_split,
+                               np.random.RandomState(50 + i))
+                 for i in range(n)]
+        # client 3 drops before sending its masked model: surviving clients
+        # sum the coded sub-masks of the surviving owners only
+        surviving = [0, 1, 2, 4, 5]
+        responses = [aggregate_encoded([coded[i][j] for i in surviving])
+                     for j in surviving]
+        agg_mask = decode_aggregate_mask(
+            responses, surviving, n, t_priv, t_split, d)
+        want = np.zeros(d, np.uint64)
+        for i in surviving:
+            want = (want + masks[i]) % _P
+        np.testing.assert_array_equal(agg_mask % _P, want)

@@ -1,0 +1,46 @@
+"""Scheduler: LPT balance, exact 2-worker DP, runtime regression."""
+
+import numpy as np
+
+from fedml_tpu.core.schedule import (RuntimeEstimator, SeqTrainScheduler,
+                                     balanced_schedule)
+
+
+def test_lpt_beats_round_robin():
+    costs = [10, 1, 1, 1, 10, 1, 1, 1]
+    sched, makespan = SeqTrainScheduler(costs, 2).schedule()
+    assert makespan == 13  # optimal: {10,1,1,1} per worker
+    rr = max(sum(costs[0::2]), sum(costs[1::2]))  # round-robin: 22 vs 4
+    assert makespan < rr
+
+
+def test_dp_two_workers_exact():
+    costs = [3, 1, 4, 2, 2]
+    sched, makespan = SeqTrainScheduler(costs, 2, mode="dp").schedule()
+    assert makespan == 6  # perfect split of 12
+    got = {frozenset(sched[0]), frozenset(sched[1])}
+    all_items = sched[0] + sched[1]
+    assert sorted(all_items) == [0, 1, 2, 3, 4]
+
+
+def test_all_clients_assigned():
+    sched, _ = SeqTrainScheduler([5, 4, 3, 2, 1], 3).schedule()
+    assert sorted(i for dev in sched for i in dev) == [0, 1, 2, 3, 4]
+
+
+def test_runtime_estimator_fits_linear():
+    est = RuntimeEstimator()
+    for n in [10, 20, 40, 80]:
+        est.record(0, n, 0.5 * n + 2.0)
+    assert abs(est.predict(0, 100) - 52.0) < 1e-6
+
+
+def test_balanced_schedule_maps_ids():
+    sampled = [7, 3, 9]
+    costs = {3: 1.0, 7: 5.0, 9: 1.0}
+    costs_arr = [costs.get(i, 0.0) for i in range(10)]
+    out = balanced_schedule(sampled, costs_arr, 2)
+    flat = sorted(i for dev in out for i in dev)
+    assert flat == [3, 7, 9]
+    loads = [sum(costs[i] for i in dev) for dev in out]
+    assert max(loads) == 5.0  # the heavy client is alone
