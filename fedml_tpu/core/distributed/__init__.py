@@ -1,0 +1,11 @@
+"""Distributed runtime & communication (reference ``core/distributed/``):
+Message envelope, transport backends (in-proc / TCP / gRPC), the
+FedMLCommManager event-loop base, decentralized topologies, and the
+algorithm Flow DAG."""
+
+from .communication.message import Message
+from .communication.base_com_manager import BaseCommunicationManager, Observer
+from .fedml_comm_manager import FedMLCommManager
+
+__all__ = ["Message", "BaseCommunicationManager", "Observer",
+           "FedMLCommManager"]

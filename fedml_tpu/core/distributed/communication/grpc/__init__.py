@@ -1,0 +1,90 @@
+"""gRPC transport.
+
+Parity target: reference ``communication/grpc/grpc_comm_manager.py:30``
+(per-rank server on ``base_port + rank``, 1 GB max message, csv ip table).
+Differences by design: the wire payload is the msgpack ``Message`` encoding
+(not pickle — reference streams pickled objects, which is unsafe), and the
+service is registered with grpcio's generic handler API so no protoc-
+generated stubs are needed (the reference ships ``*_pb2.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent import futures
+from typing import Dict, Optional
+
+import grpc
+
+from ..base_com_manager import BaseCommunicationManager
+from ..message import Message
+
+logger = logging.getLogger(__name__)
+
+GRPC_BASE_PORT = 29790
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "SendMessage"
+MAX_MSG = 1024 * 1024 * 1024  # 1 GB, matching reference constants.py:55-57
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    def __init__(self, rank: int, ip_config: Optional[Dict[int, str]] = None,
+                 base_port: int = GRPC_BASE_PORT, host: str = "127.0.0.1"):
+        super().__init__()
+        self.rank = int(rank)
+        self.ip_config = ip_config or {}
+        self.base_port = int(base_port)
+        self._q: "queue.Queue[bytes]" = queue.Queue()
+        self._running = False
+        self._channels: Dict[int, grpc.Channel] = {}
+
+        def handler(request: bytes, context) -> bytes:
+            self._q.put(request)
+            return b"ok"
+
+        rpc = grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b)
+        generic = grpc.method_handlers_generic_handler(
+            _SERVICE, {_METHOD: rpc})
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.max_send_message_length", MAX_MSG),
+                     ("grpc.max_receive_message_length", MAX_MSG)])
+        self._server.add_generic_rpc_handlers((generic,))
+        self._server.add_insecure_port(f"{host}:{self.base_port + self.rank}")
+        self._server.start()
+
+    def _stub(self, rank: int):
+        rank = int(rank)
+        if rank not in self._channels:
+            addr = (f"{self.ip_config.get(rank, '127.0.0.1')}:"
+                    f"{self.base_port + rank}")
+            self._channels[rank] = grpc.insecure_channel(
+                addr, options=[("grpc.max_send_message_length", MAX_MSG),
+                               ("grpc.max_receive_message_length", MAX_MSG)])
+        ch = self._channels[rank]
+        return ch.unary_unary(f"/{_SERVICE}/{_METHOD}",
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+
+    def send_message(self, msg: Message) -> None:
+        self._stub(msg.get_receiver_id())(msg.encode(), timeout=60.0)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            try:
+                blob = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self.notify(Message.decode(blob))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self._server.stop(grace=0.5)
+        for ch in self._channels.values():
+            ch.close()

@@ -1,0 +1,126 @@
+"""Message envelope for the WAN FSM.
+
+Parity target: reference ``core/distributed/communication/message.py:6-83``
+(dict with ``msg_type``, ``sender``, ``receiver`` + payload; model params as
+a field). The reference pickles torch state-dicts; here payloads are
+msgpack-serialized with an explicit numpy-array extension — no pickle on the
+wire (pickle is both unsafe and torch-coupled), and jax arrays cross as
+numpy + dtype + shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import msgpack
+import numpy as np
+
+
+class Message:
+    # canonical keys (reference message.py constants)
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+
+    def __init__(self, msg_type: Any = 0, sender_id: int = 0,
+                 receiver_id: int = 0):
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: msg_type,
+            Message.MSG_ARG_KEY_SENDER: sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    # --- reference-compatible accessors ------------------------------------
+    def get_sender_id(self) -> int:
+        return self.msg_params[Message.MSG_ARG_KEY_SENDER]
+
+    def get_receiver_id(self) -> int:
+        return self.msg_params[Message.MSG_ARG_KEY_RECEIVER]
+
+    def get_type(self):
+        return self.msg_params[Message.MSG_ARG_KEY_TYPE]
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    add = add_params
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def __repr__(self) -> str:
+        keys = ", ".join(sorted(self.msg_params))
+        return (f"Message(type={self.get_type()!r}, "
+                f"{self.get_sender_id()}->{self.get_receiver_id()}, "
+                f"keys=[{keys}])")
+
+    # --- wire format --------------------------------------------------------
+    def encode(self) -> bytes:
+        return msgpack.packb(self.msg_params, default=_pack_np,
+                             use_bin_type=True)
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "Message":
+        params = msgpack.unpackb(blob, ext_hook=_unpack_np, raw=False,
+                                 strict_map_key=False)
+        msg = cls()
+        msg.msg_params = params
+        return msg
+
+
+_NP_EXT = 42
+
+
+def _pack_np(obj):
+    """msgpack hook: numpy/jax arrays -> ext(dtype, shape, bytes)."""
+    if hasattr(obj, "__array__"):  # numpy array or jax array
+        arr = np.ascontiguousarray(np.asarray(obj))
+        head = msgpack.packb((arr.dtype.str, list(arr.shape)))
+        return msgpack.ExtType(_NP_EXT, head + arr.tobytes())
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"cannot serialize {type(obj)}")
+
+
+def _unpack_np(code, data):
+    if code != _NP_EXT:
+        return msgpack.ExtType(code, data)
+    unpacker = msgpack.Unpacker(use_list=True, raw=False)
+    unpacker.feed(data)
+    dtype_str, shape = unpacker.unpack()
+    off = unpacker.tell()
+    arr = np.frombuffer(data[off:], dtype=np.dtype(dtype_str))
+    return arr.reshape(shape)
+
+
+def tree_to_wire(tree) -> Dict[str, Any]:
+    """Flatten a pytree of arrays into {path: np.ndarray} for a Message
+    payload (the analogue of shipping a state-dict)."""
+    import jax
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def wire_to_tree(flat: Dict[str, Any], template):
+    """Inverse of :func:`tree_to_wire` given a structural template."""
+    import jax
+    paths_leaves = jax.tree_util.tree_flatten_with_path(template)
+    keys = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path)
+            for path, _ in paths_leaves[0]]
+    leaves = [np.asarray(flat[k]) for k in keys]
+    return jax.tree_util.tree_unflatten(paths_leaves[1], leaves)

@@ -1,0 +1,15 @@
+"""Topology managers for decentralized FL (reference
+``core/distributed/topology/``: ``base_topology_manager.py:4``,
+``symmetric_topology_manager.py:7``, ``asymmetric_topology_manager.py:7``).
+
+A topology is an [n, n] row-stochastic mixing matrix; neighbor lists derive
+from its sparsity. The TPU engine consumes topologies as ``ppermute``
+source-target pairs / weighted neighbor psums (``collectives.ppermute_tree``).
+"""
+
+from .base_topology_manager import BaseTopologyManager
+from .symmetric_topology_manager import SymmetricTopologyManager
+from .asymmetric_topology_manager import AsymmetricTopologyManager
+
+__all__ = ["BaseTopologyManager", "SymmetricTopologyManager",
+           "AsymmetricTopologyManager"]

@@ -1,0 +1,83 @@
+"""Cross-silo FL client FSM.
+
+Parity target: reference ``cross_silo/client/fedml_client_master_manager.py:22``
+— send ONLINE on start, handle S2C_INIT (:100), train, C2S model (:164),
+S2C_SYNC loop, S2C_FINISH. Local training runs on this silo's accelerator
+slice (the whole silo step is one jitted program; intra-silo data parallelism
+is a pjit sharding, not a process group — the TrainerDistAdapter/DDP
+machinery of the reference collapses into the trainer's mesh).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+from ...core import mlops
+from ...core.distributed.communication.message import (Message, tree_to_wire,
+                                                       wire_to_tree)
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, args, trainer, comm=None, rank: int = 1,
+                 size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer = trainer
+        self.round_idx = 0
+        self.server_rank = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            self.handle_message_receive_model_from_server)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def run(self) -> None:
+        # announce (reference: CONNECTION_READY -> ONLINE status)
+        self.send_client_status(self.server_rank,
+                                MyMessage.MSG_CLIENT_STATUS_ONLINE)
+        mlops.log_training_status("ONLINE")
+        super().run()
+
+    def send_client_status(self, receiver_id: int, status: str) -> None:
+        msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank,
+                      receiver_id)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, status)
+        self.send_message(msg)
+
+    def handle_message_init(self, msg: Message) -> None:
+        self._train_and_report(msg)
+
+    def handle_message_receive_model_from_server(self, msg: Message) -> None:
+        self._train_and_report(msg)
+
+    def _train_and_report(self, msg: Message) -> None:
+        wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, 0))
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        params = wire_to_tree(wire, self.trainer.params_template)
+        with mlops.event("train", round_idx=self.round_idx):
+            new_params, n_samples, metrics = self.trainer.train(
+                params, client_idx, self.round_idx)
+        out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+                      self.server_rank)
+        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                       tree_to_wire(new_params))
+        out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n_samples))
+        out.add_params(MyMessage.MSG_ARG_KEY_CLIENT_METRICS,
+                       {k: float(v) for k, v in (metrics or {}).items()})
+        self.send_message(out)
+
+    def handle_message_finish(self, msg: Message) -> None:
+        logger.info("client rank %d: finish", self.rank)
+        mlops.log_training_status("FINISHED")
+        self.finish()

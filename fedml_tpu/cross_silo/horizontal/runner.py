@@ -1,12 +1,120 @@
-"""Horizontal cross-silo runner — full WAN FSM runtime lands with the
-cross-silo milestone; until then the entrypoint fails with a clear message."""
+"""Horizontal cross-silo runner (Octopus parity).
+
+Builds the server or client side per ``args.role``/``args.rank`` over the
+chosen WAN backend (reference ``cross_silo/fedml_client.py`` /
+``fedml_server.py`` facades), plus :func:`run_cross_silo_inproc` — the
+"multi-node without a cluster" mode (SURVEY §4): server + N silo clients as
+threads over the in-proc broker, exercising the exact Message FSM of a real
+deployment.
+"""
 
 from __future__ import annotations
 
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.algframe.client_trainer import (ClassificationTrainer,
+                                             SequenceTrainer)
+from ...core.algframe.local_training import evaluate
+from ...optimizers.registry import create_optimizer
+from ..client.fedml_client_master_manager import ClientMasterManager
+from ..client.trainer import SiloTrainer
+from ..server.fedml_aggregator import FedMLAggregator
+from ..server.fedml_server_manager import FedMLServerManager
+
+logger = logging.getLogger(__name__)
+
+
+def _build_spec(fed, bundle, client_trainer):
+    if client_trainer is not None:
+        return client_trainer
+    if fed.train.y.ndim >= 4:
+        return SequenceTrainer(bundle.apply)
+    return ClassificationTrainer(bundle.apply)
+
+
+def _make_eval_fn(spec, fed):
+    ev = jax.jit(lambda p: evaluate(spec, p, fed.test["x"], fed.test["y"],
+                                    fed.test["mask"]))
+
+    def eval_fn(params):
+        stats = ev(params)
+        n = max(float(stats["count"]), 1.0)
+        return {"test_acc": float(stats["correct"]) / n,
+                "test_loss": float(stats["loss_sum"]) / n}
+
+    return eval_fn
+
+
+def build_server(args, fed, bundle, spec=None, backend: Optional[str] = None,
+                 comm=None):
+    spec = _build_spec(fed, bundle, spec)
+    rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+    init_rng, _ = jax.random.split(rng)
+    global_params = bundle.init(init_rng, fed.train.x[0, 0])
+    aggregator = FedMLAggregator(args, global_params,
+                                 eval_fn=_make_eval_fn(spec, fed))
+    size = int(getattr(args, "client_num_per_round", 1)) + 1
+    return FedMLServerManager(
+        args, aggregator, comm=comm, rank=0, size=size,
+        backend=backend or _wan_backend(args))
+
+
+def build_client(args, fed, bundle, rank: int, spec=None,
+                 backend: Optional[str] = None, comm=None):
+    spec = _build_spec(fed, bundle, spec)
+    optimizer = create_optimizer(args, spec)
+    trainer = SiloTrainer(args, fed, bundle, spec, optimizer)
+    size = int(getattr(args, "client_num_per_round", 1)) + 1
+    return ClientMasterManager(
+        args, trainer, comm=comm, rank=rank, size=size,
+        backend=backend or _wan_backend(args))
+
+
+def _wan_backend(args) -> str:
+    b = str(getattr(args, "backend", "") or "").upper()
+    return b if b in ("INPROC", "TCP", "GRPC") else "GRPC"
+
 
 class CrossSiloRunner:
+    """Single-role entry (reference FedMLRunner path): ``args.role`` decides
+    server vs client; ``run()`` blocks until the FL session finishes."""
+
     def __init__(self, args, dataset, model, client_trainer=None,
                  server_aggregator=None):
-        raise NotImplementedError(
-            "cross-silo runtime is not built yet in this checkout; "
-            "use training_type='simulation' (backends: 'sp', 'tpu')")
+        self.args = args
+        self.fed = dataset
+        self.bundle = model
+        role = str(getattr(args, "role", "client")).lower()
+        rank = int(getattr(args, "rank", 1) or 1)
+        if role == "server":
+            self.manager = build_server(args, dataset, model, client_trainer)
+        else:
+            self.manager = build_client(args, dataset, model,
+                                        max(rank, 1), client_trainer)
+
+    def run(self, comm_round=None) -> Any:
+        self.manager.run()
+        return getattr(self.manager, "result", None)
+
+
+def run_cross_silo_inproc(args, fed, bundle, spec=None) -> Dict[str, Any]:
+    """Server + N silo clients as threads over the in-proc broker."""
+    from ...core.distributed.communication.inproc import InProcBroker
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    n = int(getattr(args, "client_num_per_round", 2))
+    server = build_server(args, fed, bundle, spec, backend="INPROC")
+    clients = [build_client(args, fed, bundle, rank=r, spec=spec,
+                            backend="INPROC") for r in range(1, n + 1)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()  # blocks until FINISH
+    for t in threads:
+        t.join(timeout=30.0)
+    return server.result

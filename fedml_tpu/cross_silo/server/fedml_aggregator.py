@@ -1,0 +1,129 @@
+"""Server-side aggregation state for the WAN FSM.
+
+Parity target: reference ``cross_silo/server/fedml_aggregator.py:13``
+(``add_local_trained_result`` :58, all-received barrier :69, ``aggregate``
+:78 with defense/DP hooks, ``data_silo_selection`` :113,
+``client_selection`` :139). The all-received barrier additionally supports a
+timeout with re-weighted aggregation over the silos that did report —
+SURVEY §5.3 flags the reference's training loop as having no elasticity (a
+dead client stalls the round forever); round-timeout + renormalize is the
+capability add.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.collectives import tree_weighted_average
+from ...core.dp import FedMLDifferentialPrivacy
+from ...core.security import FedMLDefender, stack_to_matrix
+from ...core.collectives import vector_to_tree_like
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLAggregator:
+    def __init__(self, args, global_params, eval_fn=None):
+        self.args = args
+        self.global_params = global_params
+        self.eval_fn = eval_fn
+        self.client_num = int(getattr(args, "client_num_per_round", 1))
+        self.defender = FedMLDefender(args)
+        self.dp = FedMLDifferentialPrivacy(args)
+        self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
+        self._lock = threading.Condition()
+        self._reset_round()
+
+    def _reset_round(self) -> None:
+        self.model_dict: Dict[int, Any] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded_dict: Dict[int, bool] = {}
+        self._round_start = time.time()
+
+    def add_local_trained_result(self, index: int, model_params,
+                                 sample_num: float) -> None:
+        with self._lock:
+            self.model_dict[index] = model_params
+            self.sample_num_dict[index] = float(sample_num)
+            self.flag_client_model_uploaded_dict[index] = True
+            self._lock.notify_all()
+
+    def check_whether_all_receive(self) -> bool:
+        with self._lock:
+            return len(self.model_dict) >= self.client_num
+
+    def wait_all_or_timeout(self) -> bool:
+        """Block until every expected silo reported, or the round timeout
+        elapsed with at least one report. Returns True if aggregation can
+        proceed."""
+        with self._lock:
+            while True:
+                if len(self.model_dict) >= self.client_num:
+                    return True
+                remaining = None
+                if self.round_timeout_s > 0:
+                    remaining = self.round_timeout_s - (time.time()
+                                                       - self._round_start)
+                    if remaining <= 0:
+                        return len(self.model_dict) > 0
+                self._lock.wait(timeout=min(remaining or 1.0, 1.0))
+
+    def aggregate(self, round_key=None):
+        """Weighted average of received silo models (hook chain: defense ->
+        aggregate -> DP noise, reference ``server_aggregator.py:44-103``)."""
+        with self._lock:
+            idxs = sorted(self.model_dict)
+            models = [self.model_dict[i] for i in idxs]
+            weights = jnp.asarray([self.sample_num_dict[i] for i in idxs],
+                                  jnp.float32)
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(
+            [jnp.asarray(x) for x in xs]), *models)
+        if self.defender.is_defense_enabled():
+            # defenses act on deltas from the current global model
+            deltas = jax.tree_util.tree_map(
+                lambda s, g: s - jnp.asarray(g)[None], stacked,
+                self.global_params)
+            agg_delta, _ = self.defender.defend(deltas, weights, round_key,
+                                                np.asarray(idxs))
+            new_global = jax.tree_util.tree_map(
+                lambda g, d: jnp.asarray(g) + d, self.global_params, agg_delta)
+        else:
+            new_global = tree_weighted_average(stacked, weights)
+        if self.dp.is_global_dp_enabled() and round_key is not None:
+            delta = jax.tree_util.tree_map(
+                lambda n, g: n - jnp.asarray(g), new_global, self.global_params)
+            delta = self.dp.add_global_noise(delta, round_key)
+            new_global = jax.tree_util.tree_map(
+                lambda g, d: jnp.asarray(g) + d, self.global_params, delta)
+        self.global_params = new_global
+        self._reset_round()
+        return new_global
+
+    def test_on_server(self) -> Optional[Dict[str, float]]:
+        if self.eval_fn is None:
+            return None
+        return self.eval_fn(self.global_params)
+
+    # --- selection (reference :113,:139) ------------------------------------
+    def client_selection(self, round_idx: int, client_num_in_total: int,
+                         client_num_per_round: int) -> List[int]:
+        if client_num_in_total == client_num_per_round:
+            return list(range(client_num_in_total))
+        np.random.seed(round_idx)
+        return list(np.random.choice(range(client_num_in_total),
+                                     client_num_per_round, replace=False))
+
+    def data_silo_selection(self, round_idx: int, data_silo_num: int,
+                            client_num_in_total: int) -> List[int]:
+        if data_silo_num <= client_num_in_total:
+            return list(range(client_num_in_total))
+        np.random.seed(round_idx)
+        return list(np.random.choice(range(data_silo_num),
+                                     client_num_in_total, replace=False))

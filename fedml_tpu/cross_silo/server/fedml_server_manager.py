@@ -1,0 +1,164 @@
+"""Cross-silo FL server FSM.
+
+Parity target: reference ``cross_silo/server/fedml_server_manager.py:15`` —
+client ONLINE handshake before round 0 (:101-146), ``send_init_msg`` :48,
+collect models -> aggregate -> re-sample -> sync (:174), FINISH broadcast.
+Runs over any transport backend (in-proc for tests, TCP/gRPC for real WANs).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ...core import mlops
+from ...core.distributed.communication.message import (Message, tree_to_wire,
+                                                       wire_to_tree)
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+
+logger = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    """Rank 0. Client ranks are 1..N."""
+
+    def __init__(self, args, aggregator, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.client_num = int(getattr(args, "client_num_per_round", size - 1))
+        self.client_online_status: Dict[int, bool] = {}
+        self.is_initialized = False
+        import jax.random as jrandom
+        self._root_key = jrandom.PRNGKey(
+            int(getattr(args, "random_seed", 0)) + 17)
+        self.result: Optional[dict] = None
+        self.history = []
+        self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
+        self._round_lock = threading.Lock()
+        self._round_timer: Optional[threading.Timer] = None
+
+    # --- FSM wiring ---------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+            self.handle_message_client_status_update)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client)
+
+    def handle_message_client_status_update(self, msg: Message) -> None:
+        status = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        if status == MyMessage.MSG_CLIENT_STATUS_ONLINE:
+            self.client_online_status[msg.get_sender_id()] = True
+        all_online = len(self.client_online_status) >= self.client_num
+        logger.info("server: %d/%d clients online",
+                    len(self.client_online_status), self.client_num)
+        if all_online and not self.is_initialized:
+            self.is_initialized = True
+            mlops.log_aggregation_status("RUNNING")
+            self.send_init_msg()
+
+    def send_init_msg(self) -> None:
+        """(reference :48-86) ship round-0 model + data-silo index."""
+        client_indexes = self.aggregator.client_selection(
+            self.round_idx, int(self.args.client_num_in_total),
+            self.client_num)
+        wire = tree_to_wire(self.aggregator.global_params)
+        for i, rank in enumerate(sorted(self.client_online_status)):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            self.send_message(msg)
+
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        params = wire_to_tree(wire, self.aggregator.global_params)
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        self.aggregator.add_local_trained_result(sender, params, n)
+        if not self.aggregator.check_whether_all_receive():
+            # elastic rounds (capability beyond the reference, SURVEY §5.3):
+            # a dead silo must not stall the barrier forever — arm a
+            # timeout that aggregates whatever arrived
+            if self.round_timeout_s > 0 and self._round_timer is None:
+                this_round = self.round_idx
+                self._round_timer = threading.Timer(
+                    self.round_timeout_s,
+                    lambda: self._on_round_timeout(this_round))
+                self._round_timer.daemon = True
+                self._round_timer.start()
+            return
+        self._complete_round()
+
+    def _on_round_timeout(self, round_when_armed: int) -> None:
+        with self._round_lock:
+            if self.round_idx != round_when_armed:
+                return  # round already completed normally
+            if not self.aggregator.model_dict:
+                return  # nothing to aggregate; keep waiting
+            logger.warning(
+                "server round %d: timeout with %d/%d models — aggregating "
+                "the silos that reported", self.round_idx,
+                len(self.aggregator.model_dict), self.aggregator.client_num)
+        self._complete_round()
+
+    def _complete_round(self) -> None:
+        with self._round_lock:
+            if self._round_timer is not None:
+                self._round_timer.cancel()
+                self._round_timer = None
+            if not self.aggregator.model_dict:
+                return  # already aggregated by a racing path
+            import jax.random as jrandom
+            round_key = jrandom.fold_in(self._root_key, self.round_idx)
+            self.aggregator.aggregate(round_key)
+        stats = self.aggregator.test_on_server()
+        rec = {"round": self.round_idx}
+        if stats:
+            rec.update(stats)
+            logger.info("server round %d: %s", self.round_idx, stats)
+        self.history.append(rec)
+        mlops.log_round_info(self.round_num, self.round_idx)
+        with self._round_lock:
+            self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            self.finish_session()
+            return
+        self.sync_model_to_clients()
+
+    def sync_model_to_clients(self) -> None:
+        client_indexes = self.aggregator.client_selection(
+            self.round_idx, int(self.args.client_num_in_total),
+            self.client_num)
+        wire = tree_to_wire(self.aggregator.global_params)
+        for i, rank in enumerate(sorted(self.client_online_status)):
+            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                          self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+            self.send_message(msg)
+
+    def finish_session(self) -> None:
+        for rank in sorted(self.client_online_status):
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH,
+                                      self.rank, rank))
+        last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
+                         {})
+        self.result = {"params": self.aggregator.global_params,
+                       "history": self.history,
+                       "final_test_acc": last_eval.get("test_acc"),
+                       "rounds": self.round_num}
+        mlops.log_aggregation_status("FINISHED")
+        self.finish()

@@ -1,0 +1,93 @@
+"""Cross-silo WAN runtime: full Message-FSM FL session (server + N silo
+clients in threads), learning + parity against the golden SP loop on the
+same per-silo data."""
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import data as data_mod
+from fedml_tpu import model as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.cross_silo.horizontal.runner import run_cross_silo_inproc
+
+
+def make_args(**kw):
+    base = dict(dataset="synthetic_mnist", model="lr",
+                client_num_in_total=4, client_num_per_round=4,
+                comm_round=4, epochs=1, batch_size=32, learning_rate=0.1,
+                frequency_of_the_test=1, random_seed=9,
+                training_type="cross_silo")
+    base.update(kw)
+    return Arguments(**base)
+
+
+def test_inproc_session_learns():
+    args = make_args()
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    result = run_cross_silo_inproc(args, fed, bundle)
+    assert result is not None
+    assert result["final_test_acc"] > 0.6, result["history"]
+    assert len(result["history"]) == 4
+
+
+def test_round_timeout_with_dead_silo():
+    """A silo that never comes up must not stall the round forever: the
+    server aggregates the silos that did report once the timeout fires
+    (capability the reference lacks, SURVEY §5.3)."""
+    import threading
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_silo.horizontal.runner import (build_client,
+                                                        build_server)
+
+    # round_timeout_s must exceed the per-client jit-compile skew (threads
+    # compile concurrently but finish tens of seconds apart on CPU)
+    args = make_args(comm_round=2, round_timeout_s=20.0)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    server = build_server(args, fed, bundle, backend="INPROC")
+    # only 3 of the 4 expected silos start; the server's online handshake
+    # expects client_num_per_round, so mark expectation accordingly
+    server.client_num = 3
+    server.aggregator.client_num = 4  # 4 expected models -> timeout path
+    clients = [build_client(args, fed, bundle, rank=r, backend="INPROC")
+               for r in (1, 2, 3)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=180.0)
+    assert done.get("ok"), "server stalled on a dead silo"
+    assert server.result is not None and len(server.result["history"]) == 2
+
+
+def test_cross_silo_matches_sp_golden():
+    """Same data, full participation, plain SGD: the WAN FSM must produce
+    the same global model as the SP golden loop (weighted averaging of
+    locally-trained full models == averaging of deltas when all start from
+    the same params)."""
+    kw = dict(comm_round=2)
+    args = make_args(**kw)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    result = run_cross_silo_inproc(args, fed, bundle)
+
+    sim_args = make_args(**kw)
+    sim_args.training_type = "simulation"
+    r_sp = fedml_tpu.run_simulation(backend="sp", args=sim_args)
+    for a, b in zip(jax.tree_util.tree_leaves(r_sp["params"]),
+                    jax.tree_util.tree_leaves(result["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
