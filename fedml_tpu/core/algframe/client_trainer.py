@@ -97,6 +97,39 @@ class SequenceTrainer(TrainerSpec):
                 "count": tok_count}
 
 
+class MultiLabelTrainer(TrainerSpec):
+    """Sigmoid-BCE tag prediction (reference
+    ``my_model_trainer_tag_prediction.py`` — stackoverflow_lr). ``y`` is a
+    multi-hot [bs, n_tags] matrix; accuracy is exact-match-free micro-F1-ish:
+    we report per-tag correctness so curves stay informative."""
+
+    def loss(self, params, batch, rng):
+        logits = self.apply_fn(params, batch["x"], rng=rng, train=True)
+        labels = batch["y"].astype(logits.dtype)
+        per_tag = optax.sigmoid_binary_cross_entropy(logits, labels)
+        per_ex = jnp.mean(per_tag, axis=-1)
+        mask = batch["mask"].astype(per_ex.dtype)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        loss = jnp.sum(per_ex * mask) / denom
+        pred = (logits > 0).astype(labels.dtype)
+        correct = jnp.sum(jnp.mean((pred == labels).astype(jnp.float32), -1)
+                          * mask)
+        return loss, {"loss_sum": jnp.sum(per_ex * mask),
+                      "correct": correct, "count": jnp.sum(mask)}
+
+    def eval_stats(self, params, batch):
+        logits = self.apply_fn(params, batch["x"], train=False)
+        labels = batch["y"].astype(logits.dtype)
+        per_tag = optax.sigmoid_binary_cross_entropy(logits, labels)
+        per_ex = jnp.mean(per_tag, axis=-1)
+        mask = batch["mask"].astype(per_ex.dtype)
+        pred = (logits > 0).astype(labels.dtype)
+        correct = jnp.sum(jnp.mean((pred == labels).astype(jnp.float32), -1)
+                          * mask)
+        return {"loss_sum": jnp.sum(per_ex * mask), "correct": correct,
+                "count": jnp.sum(mask)}
+
+
 class RegressionTrainer(TrainerSpec):
     """MSE regression (covers the reference's tag-prediction style trainers,
     ``my_model_trainer_tag_prediction.py``)."""
@@ -122,6 +155,26 @@ class RegressionTrainer(TrainerSpec):
         mask = batch["mask"].astype(per_ex.dtype)
         return {"loss_sum": jnp.sum(per_ex * mask),
                 "correct": jnp.zeros(()), "count": jnp.sum(mask)}
+
+
+def make_trainer_spec(fed, bundle) -> TrainerSpec:
+    """Pick the TrainerSpec from the dataset's declared task (reference
+    ``ml/trainer/trainer_creator.py`` chooses per-dataset trainers)."""
+    task = getattr(fed, "task", "classification")
+    if task == "classification" and fed.train.y.ndim >= 4:
+        # caller built the dataset without declaring a task: a trailing axis
+        # on y means per-token ints (sequence) or multi-hot floats
+        import jax.numpy as _jnp
+        task = ("multilabel" if _jnp.issubdtype(fed.train.y.dtype,
+                                                _jnp.floating)
+                else "sequence")
+    if task == "sequence":
+        return SequenceTrainer(bundle.apply)
+    if task == "multilabel":
+        return MultiLabelTrainer(bundle.apply)
+    if task == "regression":
+        return RegressionTrainer(bundle.apply)
+    return ClassificationTrainer(bundle.apply)
 
 
 def make_inner_optimizer(name: str, learning_rate, momentum: float = 0.0,

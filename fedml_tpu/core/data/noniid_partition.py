@@ -64,6 +64,30 @@ def hetero_dirichlet_partition(
     return out
 
 
+def shard_partition(
+    labels: np.ndarray,
+    num_clients: int,
+    shards_per_client: int = 2,
+    rng: Optional[np.random.RandomState] = None,
+) -> Dict[int, np.ndarray]:
+    """Pathological label-shard partition (McMahan et al. FedAvg paper; the
+    reference's MNIST loader uses this shape): sort by label, cut into
+    ``num_clients * shards_per_client`` shards, deal each client
+    ``shards_per_client`` random shards — most clients see ~2 classes."""
+    rng = rng or np.random.RandomState(0)
+    order = np.argsort(labels, kind="stable")
+    n_shards = num_clients * shards_per_client
+    shards = np.array_split(order, n_shards)
+    assignment = rng.permutation(n_shards)
+    out = {}
+    for i in range(num_clients):
+        mine = assignment[i * shards_per_client:(i + 1) * shards_per_client]
+        arr = np.concatenate([shards[s] for s in mine])
+        rng.shuffle(arr)
+        out[i] = arr
+    return out
+
+
 def partition(
     labels: np.ndarray,
     num_clients: int,
@@ -76,4 +100,6 @@ def partition(
         return homo_partition(labels.shape[0], num_clients, rng)
     if method in ("hetero", "dirichlet", "noniid"):
         return hetero_dirichlet_partition(labels, num_clients, alpha, rng)
+    if method in ("shards", "pathological"):
+        return shard_partition(labels, num_clients, rng=rng)
     raise ValueError(f"unknown partition_method {method!r}")

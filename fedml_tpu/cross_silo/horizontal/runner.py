@@ -17,8 +17,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ...core.algframe.client_trainer import (ClassificationTrainer,
-                                             SequenceTrainer)
+from ...core.algframe.client_trainer import make_trainer_spec
 from ...core.algframe.local_training import evaluate
 from ...optimizers.registry import create_optimizer
 from ..client.fedml_client_master_manager import ClientMasterManager
@@ -30,11 +29,8 @@ logger = logging.getLogger(__name__)
 
 
 def _build_spec(fed, bundle, client_trainer):
-    if client_trainer is not None:
-        return client_trainer
-    if fed.train.y.ndim >= 4:
-        return SequenceTrainer(bundle.apply)
-    return ClassificationTrainer(bundle.apply)
+    return (client_trainer if client_trainer is not None
+            else make_trainer_spec(fed, bundle))
 
 
 def _make_eval_fn(spec, fed):

@@ -34,6 +34,10 @@ class FederatedDataset:
     input_shape: Tuple[int, ...]
     num_clients: int
     client_num_samples: np.ndarray  # [num_clients] int — true n_k
+    # task selects the TrainerSpec: classification | sequence | multilabel |
+    # regression (reference encodes this in per-dataset trainer choices,
+    # ml/trainer/trainer_creator.py)
+    task: str = "classification"
 
     @property
     def total_train_samples(self) -> int:
@@ -68,6 +72,7 @@ def build_federated_dataset(
     num_classes: int,
     eval_batch_size: Optional[int] = None,
     dtype=np.float32,
+    task: str = "classification",
 ) -> FederatedDataset:
     """Stack per-client arrays into one padded ClientData."""
     num_clients = len(client_xs)
@@ -91,7 +96,7 @@ def build_federated_dataset(
     return FederatedDataset(
         train=train, test=test, num_classes=num_classes,
         input_shape=tuple(np.asarray(client_xs[0]).shape[1:]),
-        num_clients=num_clients, client_num_samples=counts)
+        num_clients=num_clients, client_num_samples=counts, task=task)
 
 
 def from_central_arrays(
@@ -105,6 +110,7 @@ def from_central_arrays(
     partition_method: str = "hetero",
     partition_alpha: float = 0.5,
     seed: int = 0,
+    task: str = "classification",
 ) -> FederatedDataset:
     """Central arrays + partitioner → FederatedDataset (the common loader
     tail shared by MNIST/CIFAR-style datasets)."""
@@ -115,4 +121,4 @@ def from_central_arrays(
     cxs = [x[parts[i]] for i in range(num_clients)]
     cys = [y[parts[i]] for i in range(num_clients)]
     return build_federated_dataset(cxs, cys, test_x, test_y, batch_size,
-                                   num_classes)
+                                   num_classes, task=task)

@@ -41,8 +41,8 @@ _IMAGE_DATASETS = {
 
 
 def load(args) -> Tuple[FederatedDataset, int]:
-    name = str(getattr(args, "dataset", "synthetic_mnist")).lower()
-    name = name.removeprefix("synthetic_")
+    raw_name = str(getattr(args, "dataset", "synthetic_mnist")).lower()
+    name = raw_name.removeprefix("synthetic_")
     num_clients = int(args.client_num_in_total)
     bs = int(args.batch_size)
     seed = int(getattr(args, "random_seed", 0))
@@ -50,6 +50,44 @@ def load(args) -> Tuple[FederatedDataset, int]:
     alpha = float(getattr(args, "partition_alpha", 0.5))
     # model decides whether images stay 2D: linear models take flat input
     flat = str(getattr(args, "model", "lr")).lower() in ("lr", "logistic_regression", "mlp")
+
+    cache_dir = os.path.expanduser(getattr(args, "data_cache_dir", None)
+                                   or ".")
+    # LEAF-format natural partitions take precedence when present on disk
+    if name in ("femnist", "shakespeare", "fed_shakespeare", "celeba",
+                "sent140", "reddit"):
+        from .leaf import load_leaf_dataset
+        n_classes = {"femnist": 62, "celeba": 2, "sent140": 2}.get(name, 90)
+        task = ("sequence" if name in ("shakespeare", "fed_shakespeare",
+                                       "reddit") else "classification")
+        leaf = load_leaf_dataset(os.path.join(cache_dir, name), bs,
+                                 n_classes, max_clients=num_clients,
+                                 task=task)
+        if leaf is not None:
+            return leaf, n_classes
+
+    if raw_name in ("synthetic", "synthetic_1_1", "synthetic_0_0",
+                    "synthetic_0.5_0.5", "synthetic_iid"):
+        from .containers import build_federated_dataset
+        ab = {"synthetic_1_1": (1.0, 1.0), "synthetic_0_0": (0.0, 0.0),
+              "synthetic_0.5_0.5": (0.5, 0.5), "synthetic_iid": (0.0, 0.0)}
+        alpha_s, beta_s = ab.get(raw_name, (1.0, 1.0))
+        cxs, cys, tx, ty = synthetic.synthetic_federated(
+            alpha_s, beta_s, num_clients=num_clients, seed=seed)
+        fed = build_federated_dataset(cxs, cys, tx, ty, bs, 10)
+        return fed, 10
+
+    if name in ("stackoverflow_lr", "multilabel"):
+        from .containers import build_federated_dataset
+        (xtr, ytr), (xte, yte) = synthetic.synthetic_multilabel(
+            n_train=max(num_clients * 2 * bs, 2000), seed=seed)
+        # multilabel labels cannot drive a label partitioner: homo split
+        idxs = np.array_split(np.random.RandomState(seed).permutation(
+            len(xtr)), num_clients)
+        fed = build_federated_dataset(
+            [xtr[i] for i in idxs], [ytr[i] for i in idxs], xte, yte, bs,
+            ytr.shape[1], task="multilabel")
+        return fed, ytr.shape[1]
 
     cached = _try_npz(getattr(args, "data_cache_dir", None), name)
     if name in _IMAGE_DATASETS:
@@ -76,12 +114,13 @@ def load(args) -> Tuple[FederatedDataset, int]:
         fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
                                   n_classes, method, alpha, seed)
         return fed, n_classes
-    if name in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp", "sequences"):
+    if name in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp",
+                "sequences", "reddit"):
         (xtr, ytr), (xte, yte) = synthetic.synthetic_sequences(
             n_train=max(num_clients * 2 * bs, 2000), seed=seed)
         vocab = 64
         fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
-                                  vocab, "homo", alpha, seed)
+                                  vocab, "homo", alpha, seed, task="sequence")
         return fed, vocab
     # default: mnist-shaped synthetic
     (xtr, ytr), (xte, yte) = synthetic.synthetic_mnist(

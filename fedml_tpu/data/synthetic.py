@@ -52,6 +52,65 @@ def synthetic_cifar10(n_train: int = 5000, n_test: int = 1000, seed: int = 0):
     return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
 
 
+def synthetic_federated(
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    num_clients: int = 30,
+    n_features: int = 60,
+    n_classes: int = 10,
+    seed: int = 0,
+):
+    """The reference's ``synthetic_1_1``-style generator (Li et al.,
+    "Federated Optimization in Heterogeneous Networks"): per-client logistic
+    models W_k ~ N(u_k, 1) with u_k ~ N(0, alpha), and per-client feature
+    distributions x ~ N(v_k, diag(j^-1.2)) with v_k ~ N(B_k, 1),
+    B_k ~ N(0, beta). alpha controls model heterogeneity, beta data
+    heterogeneity. Returns (client_xs, client_ys, test_x, test_y) with the
+    natural per-client partition."""
+    rng = np.random.RandomState(seed)
+    diag = np.array([(j + 1) ** -1.2 for j in range(n_features)])
+    samples_per = rng.lognormal(4, 1, num_clients).astype(int) + 50
+    u = rng.normal(0, alpha, num_clients)
+    b_loc = rng.normal(0, beta, num_clients)
+    cxs, cys = [], []
+    for k in range(num_clients):
+        v_k = rng.normal(b_loc[k], 1.0, n_features)
+        w_k = rng.normal(u[k], 1.0, (n_features, n_classes))
+        bias_k = rng.normal(u[k], 1.0, n_classes)
+        x = rng.multivariate_normal(v_k, np.diag(diag), samples_per[k]
+                                    ).astype(np.float32)
+        logits = x @ w_k + bias_k
+        y = np.argmax(logits, axis=1).astype(np.int32)
+        cxs.append(x)
+        cys.append(y)
+    # global test set: held-out 10% of each client's data (disjoint)
+    txs, tys, new_cxs, new_cys = [], [], [], []
+    for x, y in zip(cxs, cys):
+        cut = max(len(x) // 10, 5)
+        txs.append(x[:cut])
+        tys.append(y[:cut])
+        new_cxs.append(x[cut:])
+        new_cys.append(y[cut:])
+    return new_cxs, new_cys, np.concatenate(txs), np.concatenate(tys)
+
+
+def synthetic_multilabel(
+    n_train: int = 4000, n_test: int = 500, n_features: int = 1000,
+    n_tags: int = 50, seed: int = 0,
+):
+    """Stackoverflow-LR stand-in: sparse bag-of-words features, multi-hot
+    tag labels from a sparse linear model (the reference's task is 10k
+    features / 500 tags tag-prediction with BCE)."""
+    rng = np.random.RandomState(seed)
+    n = n_train + n_test
+    x = (rng.rand(n, n_features) < 0.02).astype(np.float32)
+    w = rng.randn(n_features, n_tags) * (rng.rand(n_features, n_tags) < 0.05)
+    scores = x @ w
+    thresh = np.percentile(scores, 90, axis=0, keepdims=True)
+    y = (scores > thresh).astype(np.float32)
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
 def synthetic_sequences(n_train: int = 2000, n_test: int = 400,
                         seq_len: int = 32, vocab: int = 64, seed: int = 0):
     """Next-token-predictable integer sequences (Shakespeare-NWP stand-in):

@@ -1,0 +1,106 @@
+"""EfficientNet-lite — MBConv backbone (reference ``model/cv/efficientnet/``).
+
+GroupNorm replaces BatchNorm (functional purity for jitted FL rounds; the
+squeeze-excite block is kept). Width/depth multipliers follow the B0/B1
+scaling; the "lite" simplification (no SE in stem/head, ReLU6 instead of
+SiLU) mirrors the variants used on edge devices — the role this model plays
+in the reference's zoo.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SqueezeExcite(nn.Module):
+    filters: int
+    se_ratio: float = 0.25
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.relu(nn.Conv(max(1, int(self.filters * self.se_ratio)),
+                            (1, 1))(s))
+        s = nn.sigmoid(nn.Conv(self.filters, (1, 1))(s))
+        return x * s
+
+
+class MBConv(nn.Module):
+    filters_out: int
+    expand: int
+    kernel: int = 3
+    strides: int = 1
+    use_se: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        filters_in = x.shape[-1]
+        h = x
+        if self.expand != 1:
+            h = nn.Conv(filters_in * self.expand, (1, 1), use_bias=False)(h)
+            h = nn.GroupNorm(num_groups=8)(h)
+            h = nn.relu6(h)
+        h = nn.Conv(h.shape[-1], (self.kernel, self.kernel),
+                    strides=(self.strides, self.strides),
+                    feature_group_count=h.shape[-1], use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=8)(h)
+        h = nn.relu6(h)
+        if self.use_se:
+            h = SqueezeExcite(h.shape[-1])(h)
+        h = nn.Conv(self.filters_out, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=min(8, self.filters_out))(h)
+        if self.strides == 1 and filters_in == self.filters_out:
+            h = h + x
+        return h
+
+
+# (expand, filters, blocks, strides, kernel) per stage — B0 layout
+_B0_STAGES: Sequence[Tuple[int, int, int, int, int]] = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+
+class EfficientNetLite(nn.Module):
+    num_classes: int
+    width_mult: float = 1.0
+    depth_mult: float = 1.0
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def w(f):
+            return max(8, int(f * self.width_mult + 4) // 8 * 8)
+
+        def d(n):
+            return max(1, round(n * self.depth_mult))
+
+        x = nn.Conv(w(32), (3, 3), strides=(2, 2), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu6(x)
+        for expand, filters, blocks, strides, kernel in _B0_STAGES:
+            for b in range(d(blocks)):
+                x = MBConv(w(filters), expand, kernel,
+                           strides if b == 0 else 1)(x)
+        x = nn.Conv(w(1280), (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = nn.relu6(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def create_efficientnet(name: str, num_classes: int) -> EfficientNetLite:
+    name = name.lower()
+    scale = {"efficientnet": (1.0, 1.0), "efficientnet-b0": (1.0, 1.0),
+             "efficientnet-b1": (1.0, 1.1), "efficientnet-b2": (1.1, 1.2)}
+    wm, dm = scale.get(name, (1.0, 1.0))
+    return EfficientNetLite(num_classes, width_mult=wm, depth_mult=dm)

@@ -1,0 +1,30 @@
+"""MNIST GAN generator/discriminator (reference ``model/cv/mnist_gan.py``,
+used by the FedGAN optimizer)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    latent_dim: int = 100
+    img_dim: int = 784
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        h = nn.relu(nn.Dense(256)(z))
+        h = nn.relu(nn.Dense(512)(h))
+        h = nn.relu(nn.Dense(1024)(h))
+        return nn.tanh(nn.Dense(self.img_dim)(h))
+
+
+class Discriminator(nn.Module):
+    img_dim: int = 784
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        h = nn.leaky_relu(nn.Dense(512)(x), 0.2)
+        h = nn.leaky_relu(nn.Dense(256)(h), 0.2)
+        return nn.Dense(1)(h)

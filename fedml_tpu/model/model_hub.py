@@ -34,7 +34,9 @@ class ModelBundle:
         return self.module.apply({"params": params}, x, train=train, rngs=rngs)
 
 
-def create(args, output_dim: int) -> ModelBundle:
+def create(args, output_dim: int):
+    """Returns a ModelBundle, or a (generator, discriminator) bundle pair
+    for model='gan' (consumed by custom FedGAN trainers)."""
     name = str(getattr(args, "model", "lr")).lower()
     from .linear import LogisticRegression, MLP
     from .cv.cnn import CNNFemnist, SimpleCNN
@@ -51,9 +53,26 @@ def create(args, output_dim: int) -> ModelBundle:
         from .cv.resnet import create_resnet
         return ModelBundle(create_resnet(name, output_dim), name)
     if name in ("rnn", "lstm", "rnn_shakespeare", "stacked_lstm"):
+        dataset = str(getattr(args, "dataset", "")).lower()
+        if "stackoverflow" in dataset:
+            from .nlp.rnn import RNNStackOverflow
+            return ModelBundle(RNNStackOverflow(vocab_size=output_dim), name)
         from .nlp.rnn import RNNShakespeare
         return ModelBundle(RNNShakespeare(vocab_size=output_dim), name)
     if name.startswith("mobilenet"):
         from .cv.mobilenet import MobileNetV3Small
         return ModelBundle(MobileNetV3Small(output_dim), name)
+    if name.startswith("efficientnet"):
+        from .cv.efficientnet import create_efficientnet
+        return ModelBundle(create_efficientnet(name, output_dim), name,
+                           _has_dropout=True)
+    if name.startswith("vgg"):
+        from .cv.vgg import create_vgg
+        return ModelBundle(create_vgg(name, output_dim), name,
+                           _has_dropout=True)
+    if name in ("gan", "mnist_gan"):
+        from .cv.gan import Discriminator, Generator
+        # FedGAN trains (generator, discriminator) pairs; return both
+        return (ModelBundle(Generator(), "generator"),
+                ModelBundle(Discriminator(), "discriminator"))
     raise ValueError(f"unknown model {name!r}")

@@ -17,6 +17,14 @@ from .constants import (
 )
 
 
+def _with_fedavg(args, create_optimizer, spec):
+    """Protocol simulators drive plain FedAvg client steps internally."""
+    import copy
+    inner_args = copy.copy(args)
+    inner_args.federated_optimizer = "FedAvg"
+    return create_optimizer(inner_args, spec)
+
+
 class FedMLRunner:
     """Dispatch to the right scenario runner based on
     ``args.training_type`` × ``args.backend`` (reference ``runner.py:34-53``)."""
@@ -46,16 +54,36 @@ class FedMLRunner:
         raise ValueError(f"unknown training_type {ttype!r}")
 
     def _build_simulator(self, args):
-        from .core.algframe.client_trainer import (ClassificationTrainer,
-                                                   SequenceTrainer)
+        from .core.algframe.client_trainer import make_trainer_spec
         from .optimizers.registry import create_optimizer
         fed, bundle = self.dataset, self.model
-        if self.client_trainer is not None:
-            spec = self.client_trainer
-        elif fed.train.y.ndim >= 4:  # [clients, nb, bs, L] — per-token task
-            spec = SequenceTrainer(bundle.apply)
-        else:
-            spec = ClassificationTrainer(bundle.apply)
+        spec = (self.client_trainer if self.client_trainer is not None
+                else make_trainer_spec(fed, bundle))
+        fo = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        # protocol-level optimizers get dedicated simulators (reference
+        # simulator.py:27-216 dispatches these to their own API stacks)
+        if fo == "hierarchicalfl":
+            from .simulation.sp.hierarchical import HierarchicalSimulator
+            inner = _with_fedavg(args, create_optimizer, spec)
+            return HierarchicalSimulator(args, fed, bundle, inner, spec)
+        if fo in ("async_fedavg", "asyncfedavg"):
+            from .simulation.sp.async_fedavg import AsyncFedAvgSimulator
+            inner = _with_fedavg(args, create_optimizer, spec)
+            return AsyncFedAvgSimulator(args, fed, bundle, inner, spec)
+        if fo == "decentralized_fl":
+            from .simulation.sp.decentralized import DecentralizedSimulator
+            inner = _with_fedavg(args, create_optimizer, spec)
+            return DecentralizedSimulator(args, fed, bundle, inner, spec)
+        if fo == "split_nn":
+            from .simulation.sp.split_nn import SplitNNSimulator
+            return SplitNNSimulator(args, fed, bundle)
+        if fo in ("classical_vertical", "vertical_fl", "vfl"):
+            from .simulation.sp.vertical_fl import VerticalFLSimulator
+            return VerticalFLSimulator(args, fed, bundle)
+        if fo == "fedgan" or isinstance(bundle, tuple):
+            raise NotImplementedError(
+                "FedGAN training is not implemented yet; the gan model pair "
+                "(model='gan') is available for custom trainers only")
         opt = create_optimizer(args, spec)
         backend = getattr(args, "backend", FEDML_SIMULATION_TYPE_TPU)
         if backend == FEDML_SIMULATION_TYPE_SP:

@@ -1,0 +1,108 @@
+"""Decentralized (gossip) FL — no server; neighbors mix via a topology.
+
+Parity target: reference ``simulation/mpi/decentralized_framework/`` (topology
+gossip over MPI) + ``core/distributed/topology/``. TPU-native design: all
+node models are stacked on a leading [K] axis and the ENTIRE gossip round —
+vmapped per-node local SGD followed by the mixing step ``P <- W @ P`` (the
+row-stochastic topology matrix contracted against the stacked params) — is
+one jitted program. On a mesh this mixing is a ``ppermute`` per directed
+edge (``collectives.ppermute_tree``); the einsum form here is the
+single-host equivalent that XLA maps to one matmul per leaf.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.algframe.types import TrainHyper
+from ...core.algframe.local_training import evaluate
+from ...core.distributed.topology import SymmetricTopologyManager
+
+logger = logging.getLogger(__name__)
+
+
+class DecentralizedSimulator:
+    def __init__(self, args, fed_dataset, bundle, optimizer, spec):
+        self.args = args
+        self.fed = fed_dataset
+        self.opt = optimizer
+        self.spec = spec
+        self.n = fed_dataset.num_clients
+        tm = SymmetricTopologyManager(
+            self.n, neighbor_num=int(getattr(args, "topology_neighbors", 2)
+                                     or 2))
+        tm.generate_topology()
+        self.mixing = jnp.asarray(tm.mixing_matrix(), jnp.float32)
+        self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        init_rng, self.rng = jax.random.split(self.rng)
+        p0 = bundle.init(init_rng, fed_dataset.train.x[0, 0])
+        # every node starts from the same init (reference does likewise)
+        self.node_params = jax.tree_util.tree_map(
+            lambda a: jnp.broadcast_to(a[None], (self.n,) + a.shape), p0)
+        self._evaluate = jax.jit(lambda p, x, y, m: evaluate(spec, p, x, y, m))
+        self._round = jax.jit(self._round_impl)
+        self.history: List[Dict[str, Any]] = []
+
+    def _round_impl(self, node_params, round_key, hyper):
+        def one_node(params, cdata, cid):
+            key = jax.random.fold_in(round_key, cid)
+            out = self.opt.local_train(params, {}, {}, cdata, key, hyper)
+            return jax.tree_util.tree_map(jnp.add, params, out.update)
+
+        trained = jax.vmap(one_node)(
+            node_params, self.fed.train, jnp.arange(self.n))
+        # gossip mixing: P <- W @ P per leaf
+        mixed = jax.tree_util.tree_map(
+            lambda leaf: jnp.einsum(
+                "ij,j...->i...", self.mixing, leaf.astype(jnp.float32)
+            ).astype(leaf.dtype), trained)
+        return mixed
+
+    def consensus_distance(self) -> float:
+        """Mean L2 distance of node models to their average — gossip should
+        drive this toward 0."""
+        mean = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0),
+                                      self.node_params)
+        sq = jax.tree_util.tree_map(
+            lambda a, m: jnp.sum((a - m[None]) ** 2, axis=tuple(
+                range(1, a.ndim))), self.node_params, mean)
+        total = sum(jax.tree_util.tree_leaves(sq))
+        return float(jnp.mean(jnp.sqrt(total)))
+
+    def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
+        args = self.args
+        rounds = comm_round if comm_round is not None else int(args.comm_round)
+        hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                           epochs=int(args.epochs))
+        t0 = time.time()
+        for round_idx in range(rounds):
+            round_key = jax.random.fold_in(self.rng, round_idx)
+            self.node_params = self._round(
+                self.node_params, round_key,
+                hyper.replace(round_idx=jnp.int32(round_idx)))
+            rec: Dict[str, Any] = {"round": round_idx}
+            freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
+            if round_idx % freq == 0 or round_idx == rounds - 1:
+                avg = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0),
+                                             self.node_params)
+                stats = self._evaluate(avg, self.fed.test["x"],
+                                       self.fed.test["y"],
+                                       self.fed.test["mask"])
+                n = max(float(stats["count"]), 1.0)
+                rec["test_acc"] = float(stats["correct"]) / n
+                rec["consensus_dist"] = self.consensus_distance()
+                logger.info("gossip round %d: acc=%.4f consensus=%.4f",
+                            round_idx, rec["test_acc"], rec["consensus_dist"])
+            self.history.append(rec)
+        last_eval = next(r for r in reversed(self.history) if "test_acc" in r)
+        avg = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0),
+                                     self.node_params)
+        return {"params": avg, "node_params": self.node_params,
+                "history": self.history, "wall_time_s": time.time() - t0,
+                "final_test_acc": last_eval["test_acc"], "rounds": rounds}

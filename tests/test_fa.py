@@ -1,0 +1,62 @@
+"""Federated analytics: each analyzer/aggregator pair against ground truth
+computed on the pooled data."""
+
+import numpy as np
+
+from fedml_tpu import fa
+
+
+class A:
+    comm_round = 1
+    client_num_per_round = 4
+
+
+def client_values(seed=0, k=4, n=200):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(n) * (i + 1) for i in range(k)]
+
+
+def test_avg():
+    datas = client_values()
+    out = fa.run_fa("avg", datas, A())
+    pooled = np.concatenate(datas)
+    assert abs(out["result"] - pooled.mean()) < 1e-9
+
+
+def test_frequency():
+    datas = [[1, 1, 2], [2, 3], [3, 3, 3]]
+    out = fa.run_fa("frequency_estimation", datas, A())
+    assert out["result"][1] == 2 and out["result"][3] == 4
+
+
+def test_intersection_and_union():
+    datas = [{1, 2, 3}, {2, 3, 4}, {2, 3, 9}, {0, 2, 3}]
+    out = fa.run_fa("intersection", [list(d) for d in datas], A())
+    assert out["result"] == {2, 3}
+    out = fa.run_fa("union", [list(d) for d in datas], A())
+    assert out["result"] == {0, 1, 2, 3, 4, 9}
+
+
+def test_k_percentile_bisection_converges():
+    rng = np.random.RandomState(0)
+    datas = [rng.uniform(0, 100, 500) for _ in range(4)]
+    args = A()
+    args.comm_round = 40
+    args.k_percentile = 50
+    out = fa.run_fa("k_percentile", datas, args,
+                    comm_round=40)
+    pooled = np.concatenate(datas)
+    assert abs(out["result"] - np.median(pooled)) < 2.0
+
+
+def test_triehh_finds_heavy_hitters():
+    # 7 clients; three hold only "the", three only "cat" (votes are then
+    # deterministic), one holds the rare "zebra"
+    datas = [["the"]] * 3 + [["cat"]] * 3 + [["zebra"]]
+    args = A()
+    args.client_num_per_round = 7
+    args.triehh_theta = 3
+    out = fa.run_fa("heavy_hitter_triehh", datas, args, comm_round=8)
+    found = set(out["result"])
+    assert "the" in found and "cat" in found
+    assert "zebra" not in found

@@ -18,6 +18,8 @@ from fedml_tpu.model import create
     ("resnet56", (2, 32, 32, 3), 10),
     ("resnet18", (2, 32, 32, 3), 10),
     ("mobilenet_v3", (2, 32, 32, 3), 62),
+    ("efficientnet-b0", (2, 32, 32, 3), 10),
+    ("vgg11", (2, 32, 32, 3), 10),
 ])
 def test_model_forward_shapes(name, shape, classes):
     args = Arguments(model=name)
@@ -35,6 +37,25 @@ def test_rnn_per_token_logits():
     params = bundle.init(jax.random.PRNGKey(0), x)
     out = bundle.apply(params, x)
     assert out.shape == (2, 16, 64)
+
+
+def test_gan_pair():
+    gen, disc = create(Arguments(model="gan"), 10)
+    z = jnp.zeros((2, 100))
+    gp = gen.init(jax.random.PRNGKey(0), z)
+    img = gen.apply(gp, z)
+    assert img.shape == (2, 784)
+    dp = disc.init(jax.random.PRNGKey(1), img)
+    score = disc.apply(dp, img)
+    assert score.shape == (2, 1)
+
+
+def test_stackoverflow_rnn_selected_by_dataset():
+    args = Arguments(model="rnn", dataset="stackoverflow_nwp")
+    bundle = create(args, 64)
+    x = jnp.zeros((2, 10), jnp.int32)
+    params = bundle.init(jax.random.PRNGKey(0), x)
+    assert bundle.apply(params, x).shape == (2, 10, 64)
 
 
 def test_unknown_model_raises():
