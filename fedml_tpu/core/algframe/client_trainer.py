@@ -168,6 +168,9 @@ def make_trainer_spec(fed, bundle) -> TrainerSpec:
         task = ("multilabel" if _jnp.issubdtype(fed.train.y.dtype,
                                                 _jnp.floating)
                 else "sequence")
+    if task in ("llm", "causal_lm"):
+        from ...llm.trainer import CausalLMTrainer
+        return CausalLMTrainer(bundle.apply)
     if task == "sequence":
         return SequenceTrainer(bundle.apply)
     if task == "multilabel":

@@ -1,0 +1,28 @@
+"""FedLLM — the LLM fine-tuning pillar (reference ``train/llm/`` +
+``spotlight_prj/unitedllm/``), rebuilt TPU-first:
+
+- ``model``: flax Llama-style decoder (RMSNorm/rotary/SwiGLU), bf16
+  compute, MXU-shaped matmuls.
+- ``attention``: dense golden + Pallas flash kernel + ring attention over
+  the ``sp`` mesh axis for long context.
+- ``lora``: adapters as a pure pytree transform; federated rounds ship
+  adapters only.
+- ``sharding``: FSDP/TP partition specs (XLA-FSDP, the DeepSpeed ZeRO
+  analogue) + sequence-parallel forward.
+- ``trainer``: completion-only causal-LM TrainerSpec that composes with the
+  whole algorithm frame.
+- ``federated``: ``build_llm`` / ``run_federated_llm`` — UnitedLLM parity.
+- ``hf``: local HF/Llama torch-checkpoint import.
+"""
+
+from .model import CausalLM, LLMConfig, init_llm
+from .lora import lora_init, lora_merge, make_lora_apply, lora_param_count
+from .trainer import CausalLMTrainer
+from .federated import LLMBundle, build_llm, llm_config_from_args, run_federated_llm
+
+__all__ = [
+    "CausalLM", "LLMConfig", "init_llm",
+    "lora_init", "lora_merge", "make_lora_apply", "lora_param_count",
+    "CausalLMTrainer",
+    "LLMBundle", "build_llm", "llm_config_from_args", "run_federated_llm",
+]

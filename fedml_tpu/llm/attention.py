@@ -1,0 +1,226 @@
+"""Attention implementations for the LLM path.
+
+The reference's only long-context machinery is a CUDA flash-attn
+monkey-patch (``train/llm/models/attention.py:30``). The TPU-native
+counterparts here are first-class:
+
+- ``dense``: plain causal attention — XLA fuses this well for short
+  sequences; the numerical golden for the other two.
+- ``flash``: a Pallas online-softmax kernel, blocked over the KV axis so
+  the [s, s] score matrix never materializes in HBM (the flash-attn
+  analogue on the MXU). Backward currently recomputes through the dense
+  path (documented trade-off; fine at the fine-tune lengths the reference
+  targets, ``DEFAULT_MAX_SEQ_LENGTH=1024``).
+- ``ring``: ring attention over the ``sp`` mesh axis — sequence shards
+  rotate K/V via ``ppermute`` while accumulating online-softmax state, so
+  context length scales with the number of chips (capability beyond the
+  reference; SURVEY §5.7 flags this as the TPU equivalent to build).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+# (axis_name, axis_size) for ring attention; set by the sequence-parallel
+# wrapper (sharding.py) around the shard_map'd forward.
+_RING_AXIS: contextvars.ContextVar[Optional[Tuple[str, int]]] = \
+    contextvars.ContextVar("fedml_tpu_ring_axis", default=None)
+
+
+@contextlib.contextmanager
+def ring_axis(name: str, size: int):
+    token = _RING_AXIS.set((name, size))
+    try:
+        yield
+    finally:
+        _RING_AXIS.reset(token)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     impl: str = "dense",
+                     attn_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Dispatch. q/k/v: [b, s, h, d] → [b, s, h, d]."""
+    if impl in ("ring", "flash") and attn_mask is not None:
+        raise NotImplementedError(
+            f"attention_impl={impl!r} does not support key-padding masks "
+            "yet — use impl='dense', or pack sequences without padding")
+    if impl == "ring":
+        ax = _RING_AXIS.get()
+        if ax is None:
+            raise RuntimeError(
+                "attention_impl='ring' requires the sequence-parallel "
+                "context (fedml_tpu.llm.attention.ring_axis) — wrap the "
+                "forward in shard_map over the 'sp' axis")
+        return ring_causal_attention(q, k, v, axis_name=ax[0],
+                                     axis_size=ax[1])
+    if impl == "flash":
+        return flash_causal_attention(q, k, v)
+    return dense_causal_attention(q, k, v, attn_mask=attn_mask)
+
+
+def dense_causal_attention(q, k, v, attn_mask=None):
+    """[b, s, h, d] — reference semantics, scores in f32."""
+    _, s, _, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    mask = causal[None, None]
+    if attn_mask is not None:  # [b, s] key padding
+        mask = mask & attn_mask[:, None, None, :].astype(bool)
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------- flash ----
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      seq_len: int, scale: float):
+    """One (batch*head, q-block) program: online softmax over KV blocks.
+
+    q_ref: [block_q, d]; k_ref/v_ref: [s, d]; o_ref: [block_q, d].
+    """
+    import jax.experimental.pallas as pl
+
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q_blk_idx = pl.program_id(1)
+    q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    def body(i, carry):
+        o_acc, m, l = carry
+        k_blk = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s_blk = jnp.dot(q, k_blk.T,
+                        preferred_element_type=jnp.float32)  # [bq, bk]
+        k_pos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_blk, -1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, -1, keepdims=True)
+        o_new = o_acc * alpha + jnp.dot(p, v_blk,
+                                        preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    n_k = pl.cdiv(seq_len, block_k)
+    # causal: kv blocks strictly after this q block contribute nothing;
+    # the last live block is the one containing this q block's final query
+    n_live = jnp.minimum(
+        n_k, ((q_blk_idx + 1) * block_q + block_k - 1) // block_k)
+    o_acc = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o_acc, m, l = jax.lax.fori_loop(0, n_live, body, (o_acc, m0, l0))
+    o_ref[:] = (o_acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, block_q: int, block_k: int):
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    grid = (b * h, pl.cdiv(s, block_q))
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k, seq_len=s,
+                          scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_causal_attention(q, k, v, block_q: int = 128, block_k: int = 128):
+    """Pallas flash-attention forward; backward recomputes via the dense
+    path (activation-memory trade documented in the module docstring)."""
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    return _flash_fwd(q, k, v, block_q, block_k)
+
+
+def _flash_fwd_rule(q, k, v, block_q, block_k):
+    bq = min(block_q, q.shape[1])
+    bk = min(block_k, k.shape[1])
+    return _flash_fwd(q, k, v, bq, bk), (q, k, v)
+
+
+def _flash_bwd_rule(block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(dense_causal_attention, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv
+
+
+flash_causal_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ----------------------------------------------------------------- ring ----
+
+def ring_causal_attention(q, k, v, axis_name: str = "sp",
+                          axis_size: int = 1) -> jnp.ndarray:
+    """Causal attention with the sequence sharded over ``axis_name``.
+
+    Must be traced inside ``shard_map``: q/k/v are the local shards
+    [b, s_loc, h, d]; K/V rotate around the ring via ``ppermute`` while each
+    device folds the visiting block into its online-softmax accumulator.
+    Communication rides ICI; peak memory per device is O(s_loc² + s_loc·d).
+    """
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    my_idx = jax.lax.axis_index(axis_name)
+    q_pos = my_idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(carry, xs):
+        o_acc, m, l, k_cur, v_cur = carry
+        step = xs
+        kv_idx = (my_idx - step) % axis_size
+        kv_pos = kv_idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+        s_blk = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           k_cur.astype(jnp.float32)) * scale
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s_blk = jnp.where(mask[None, None], s_blk, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_blk, -1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s_blk - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, -1)
+        o_new = (o_acc * alpha[..., None] +
+                 jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), ()
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        fold, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,106 @@
+"""Import HF/torch checkpoints into the flax CausalLM.
+
+Parity target: the reference loads base models from the HF hub
+(``ModelArguments.get_model_kwargs`` → ``AutoModelForCausalLM``,
+``train/llm/configurations.py:271-341``). This environment has no network
+egress, so the importer consumes a *local* checkpoint: a torch state dict
+(``pytorch_model.bin`` / ``.pt``) or a directory containing one, with
+Llama-style parameter naming (``model.layers.N.self_attn.q_proj.weight``).
+
+torch Linear stores weights [out, in]; flax kernels are [in, out] (and
+[in, heads, head_dim] for the fused attention projections) — the transpose
+and reshape happen here, once, at import time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from .model import LLMConfig
+
+PyTree = Any
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def load_torch_state_dict(path: str) -> Mapping[str, Any]:
+    import torch
+
+    if os.path.isdir(path):
+        for name in ("pytorch_model.bin", "model.pt", "checkpoint.pt"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"no torch checkpoint (pytorch_model.bin / model.pt) in {path}")
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]
+    return state
+
+
+def convert_llama_state_dict(state: Mapping[str, Any],
+                             cfg: LLMConfig) -> PyTree:
+    """Llama-naming torch state dict → CausalLM param tree."""
+    h, nh, kvh, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_dim)
+
+    def lin(key: str) -> np.ndarray:          # [out, in] → [in, out]
+        return _to_np(state[key]).T
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": _to_np(state["model.embed_tokens.weight"])},
+        "ln_f": {"scale": _to_np(state["model.norm.weight"])},
+    }
+    if cfg.tie_embeddings and "lm_head.weight" in state:
+        head = _to_np(state["lm_head.weight"])
+        if not np.allclose(head, params["embed"]["embedding"], atol=1e-6):
+            raise ValueError(
+                "checkpoint has an untied lm_head but cfg.tie_embeddings "
+                "is True — importing would silently drop the head; set "
+                "tie_embeddings=False on the LLMConfig")
+    if not cfg.tie_embeddings and "lm_head.weight" in state:
+        params["lm_head"] = {"kernel": lin("lm_head.weight")}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        params[f"layer_{i}"] = {
+            "ln_attn": {"scale": _to_np(state[p + "input_layernorm.weight"])},
+            "ln_mlp": {"scale": _to_np(
+                state[p + "post_attention_layernorm.weight"])},
+            "attn": {
+                "q": {"kernel": lin(p + "self_attn.q_proj.weight")
+                      .reshape(h, nh, hd)},
+                "k": {"kernel": lin(p + "self_attn.k_proj.weight")
+                      .reshape(h, kvh, hd)},
+                "v": {"kernel": lin(p + "self_attn.v_proj.weight")
+                      .reshape(h, kvh, hd)},
+                "o": {"kernel": lin(p + "self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "gate": {"kernel": lin(p + "mlp.gate_proj.weight")},
+                "up": {"kernel": lin(p + "mlp.up_proj.weight")},
+                "down": {"kernel": lin(p + "mlp.down_proj.weight")},
+            },
+        }
+    return _tree_to_jnp(params)
+
+
+def _tree_to_jnp(tree):
+    import jax
+
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def load_hf_llama(path: str, cfg: LLMConfig) -> PyTree:
+    """Local HF-Llama checkpoint → flax params ready for ``CausalLM``."""
+    return convert_llama_state_dict(load_torch_state_dict(path), cfg)

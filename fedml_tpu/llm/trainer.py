@@ -1,0 +1,54 @@
+"""Causal-LM trainer spec — plugs the LLM into the algorithm frame.
+
+Parity target: ``HFTrainer`` (reference ``train/llm/hf_trainer.py:28``) and
+the completion-only collator (``modeling_utils.py:28``): per-token
+cross-entropy where prompt/padding positions are excluded from the loss.
+Here ignored positions are encoded as label ``-1`` inside the standard
+``{"x", "y", "mask"}`` batch, so the spec composes with ``run_local_sgd``
+and therefore with the whole federated-optimizer zoo, the defense/DP hook
+chain, and both simulators — the LLM is not a special case of the runtime,
+just another TrainerSpec.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.algframe.client_trainer import TrainerSpec
+
+PyTree = Any
+
+
+class CausalLMTrainer(TrainerSpec):
+    """Next-token CE. Batch: ``x`` [bs, L] int tokens, ``y`` [bs, L] labels
+    with ``-1`` = ignore (prompt tokens under completion-only masking,
+    right-padding), ``mask`` [bs] per-sample realness."""
+
+    def _stats(self, params, batch, rng, train):
+        kwargs = {"train": train}
+        if rng is not None:
+            kwargs["rng"] = rng
+        logits = self.apply_fn(params, batch["x"], **kwargs)
+        labels = batch["y"].astype(jnp.int32)
+        tok_w = ((labels >= 0).astype(jnp.float32)
+                 * batch["mask"].astype(jnp.float32)[:, None])
+        safe = jnp.maximum(labels, 0)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+        loss_sum = jnp.sum(per_tok * tok_w)
+        correct = jnp.sum((jnp.argmax(logits, -1) == safe) * tok_w)
+        count = jnp.sum(tok_w)
+        return loss_sum, correct, count
+
+    def loss(self, params, batch, rng):
+        loss_sum, correct, count = self._stats(params, batch, rng, True)
+        loss = loss_sum / jnp.maximum(count, 1.0)
+        return loss, {"loss_sum": loss_sum, "correct": correct,
+                      "count": count}
+
+    def eval_stats(self, params, batch):
+        loss_sum, correct, count = self._stats(params, batch, None, False)
+        return {"loss_sum": loss_sum, "correct": correct, "count": count}

@@ -209,6 +209,7 @@ class SPSimulator:
                          "test_loss": float(stats["loss_sum"]) / n}
         result = {"params": self.params, "history": self.history,
                   "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
+                  "final_test_loss": last_eval.get("test_loss"),
                   "rounds": rounds}
         if self.dp.is_dp_enabled():
             result["dp_epsilon_spent"] = self.dp.get_epsilon_spent()

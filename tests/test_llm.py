@@ -1,0 +1,237 @@
+"""LLM pillar tests: model, attention variants, LoRA, sharding, federated
+LoRA parity (VERDICT round-1 item 2; reference ``train/llm/`` +
+``spotlight_prj/unitedllm/``)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.llm import (
+    CausalLM, LLMConfig, init_llm, lora_init, lora_merge, make_lora_apply,
+    lora_param_count, CausalLMTrainer, build_llm, run_federated_llm,
+)
+from fedml_tpu.llm.attention import (
+    dense_causal_attention, flash_causal_attention, ring_causal_attention,
+    ring_axis,
+)
+
+CFG = LLMConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_layers=2, num_heads=4, max_seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def small_lm():
+    return init_llm(CFG, jax.random.PRNGKey(0))
+
+
+def test_forward_shape_and_causality(small_lm):
+    model, params = small_lm
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, 64)
+    assert logits.dtype == jnp.float32
+    # causality: changing a future token must not affect earlier logits
+    tokens2 = tokens.at[:, 10].set((tokens[:, 10] + 1) % 64)
+    logits2 = model.apply({"params": params}, tokens2)
+    np.testing.assert_allclose(logits[:, :10], logits2[:, :10], atol=1e-5)
+    assert not np.allclose(logits[:, 10:], logits2[:, 10:])
+
+
+def test_flash_matches_dense():
+    rng = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (2, 16, 2, 8))
+               for i in range(3))
+    dense = dense_causal_attention(q, k, v)
+    flash = flash_causal_attention(q, k, v, 8, 8)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
+                               atol=1e-5)
+    # gradients flow (backward recomputes via dense path)
+    g = jax.grad(lambda q: flash_causal_attention(q, k, v, 8, 8).sum())(q)
+    gd = jax.grad(lambda q: dense_causal_attention(q, k, v).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gd), atol=1e-4)
+
+
+def test_ring_matches_dense_multidevice():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from fedml_tpu.core.mesh import build_mesh
+
+    mesh = build_mesh({"sp": 4}, devices=jax.devices()[:4])
+    rng = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (2, 32, 2, 8))
+               for i in range(3))
+    dense = dense_causal_attention(q, k, v)
+
+    ring = shard_map(
+        lambda q, k, v: ring_causal_attention(q, k, v, "sp", 4),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False)(q, k, v)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               atol=1e-5)
+
+
+def test_ring_forward_full_model():
+    """Sequence-parallel forward of the whole decoder matches the dense
+    single-device forward (global RoPE positions + causal mask)."""
+    from fedml_tpu.core.mesh import build_mesh
+    from fedml_tpu.llm.sharding import make_ring_forward
+
+    cfg_ring = LLMConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                         num_layers=2, num_heads=4, max_seq_len=32,
+                         attention_impl="ring")
+    model_ring = CausalLM(cfg_ring)
+    model_dense, params = init_llm(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    want = model_dense.apply({"params": params}, tokens)
+
+    mesh = build_mesh({"sp": 4}, devices=jax.devices()[:4])
+    fwd = make_ring_forward(
+        lambda p, t: model_ring.apply({"params": p}, t), mesh)
+    got = fwd(params, tokens)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-4)
+
+
+def test_lora_zero_init_and_delta(small_lm):
+    model, params = small_lm
+    lora = lora_init(jax.random.PRNGKey(2), params, rank=4)
+    assert lora_param_count(lora) > 0
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    base_out = model.apply({"params": params}, tokens)
+    merged = lora_merge(params, lora)
+    merged_out = model.apply({"params": merged}, tokens)
+    np.testing.assert_allclose(np.asarray(base_out), np.asarray(merged_out),
+                               atol=1e-6)  # b=0 → zero effect
+    # non-zero b changes the output
+    bumped = jax.tree_util.tree_map(lambda a: a + 0.1, lora)
+    out2 = model.apply({"params": lora_merge(params, bumped)}, tokens)
+    assert not np.allclose(np.asarray(base_out), np.asarray(out2))
+
+
+def test_lora_training_reduces_loss(small_lm):
+    model, params = small_lm
+    apply_fn = make_lora_apply(
+        lambda p, x, rng=None, train=False: model.apply({"params": p}, x),
+        params)
+    spec = CausalLMTrainer(apply_fn)
+    lora = lora_init(jax.random.PRNGKey(2), params, rank=4)
+    x = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 4, 64)
+    batch = {"x": x, "y": x, "mask": jnp.ones(4)}
+
+    import optax
+    opt = optax.adam(1e-2)
+    state = opt.init(lora)
+    loss0 = None
+
+    @jax.jit
+    def step(lora, state):
+        (loss, _), g = jax.value_and_grad(spec.loss, has_aux=True)(
+            lora, batch, jax.random.PRNGKey(0))
+        up, state = opt.update(g, state, lora)
+        return optax.apply_updates(lora, up), state, loss
+
+    for i in range(20):
+        lora, state, loss = step(lora, state)
+        if loss0 is None:
+            loss0 = float(loss)
+    assert float(loss) < loss0 * 0.9
+
+
+def test_fsdp_tp_sharded_step():
+    """Train step jitted over a fsdp×tensor mesh compiles, executes, and
+    matches the unsharded step numerically."""
+    from fedml_tpu.core.mesh import build_mesh
+    from fedml_tpu.llm.sharding import (
+        llm_param_specs, make_sharded_train_step, shard_llm_params)
+    import optax
+
+    cfg = LLMConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_layers=2, num_heads=4, max_seq_len=16,
+                    tie_embeddings=False)
+    model, params = init_llm(cfg, jax.random.PRNGKey(0))
+    spec = CausalLMTrainer(
+        lambda p, x, rng=None, train=False: model.apply({"params": p}, x))
+    x = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 4, 64)
+    batch = {"x": x, "y": x, "mask": jnp.ones(8)}
+    opt = optax.sgd(0.1)
+
+    # golden: unsharded
+    (l0, _), g = jax.value_and_grad(spec.loss, has_aux=True)(
+        params, batch, jax.random.PRNGKey(0))
+    up, _ = opt.update(g, opt.init(params), params)
+    want = jax.tree_util.tree_map(lambda p, u: p + u, params, up)
+
+    mesh = build_mesh({"data": 2, "fsdp": 2, "tensor": 2},
+                      devices=jax.devices()[:8])
+    specs = llm_param_specs(params, mesh)
+    with mesh:
+        sharded = shard_llm_params(params, mesh)
+        step = make_sharded_train_step(
+            lambda p, b, r: spec.loss(p, b, r), opt, mesh, specs)
+        new_params, _, loss = step(sharded, opt.init(sharded), batch,
+                                   jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(loss), float(l0), atol=1e-5)
+    for wleaf, gleaf in zip(jax.tree_util.tree_leaves(want),
+                            jax.tree_util.tree_leaves(new_params)):
+        np.testing.assert_allclose(np.asarray(wleaf), np.asarray(gleaf),
+                                   atol=1e-4)
+
+
+def test_federated_lora_two_silos_parity():
+    """2 silos with FedAvg over adapters: with full participation and equal
+    shards, the federated run must track single-silo training on the union
+    of the data (UnitedLLM round semantics)."""
+    common = dict(
+        dataset="llm_synth", model="causal_lm", comm_round=3, epochs=1,
+        batch_size=8, learning_rate=5e-3, client_optimizer="adam",
+        llm_corpus_size=64, llm_max_seq_len=48, llm_hidden_size=32,
+        llm_num_layers=1, llm_num_heads=2, llm_intermediate_size=64,
+        lora_rank=4, random_seed=7, frequency_of_the_test=10,
+        training_type="simulation", backend="sp",
+    )
+    r2 = run_federated_llm(Arguments(
+        client_num_in_total=2, client_num_per_round=2, **common))
+    r1 = run_federated_llm(Arguments(
+        client_num_in_total=1, client_num_per_round=1, **common))
+    # both learn (loss drops below initial-ish level) and agree closely
+    assert r2["final_test_loss"] < 6.0
+    assert abs(r2["final_test_loss"] - r1["final_test_loss"]) < 0.35
+
+
+def test_hf_llama_import_roundtrip():
+    """Fabricated Llama-named torch state dict → flax params → forward."""
+    import torch
+    from fedml_tpu.llm.hf import convert_llama_state_dict
+
+    cfg = LLMConfig(vocab_size=32, hidden_size=16, intermediate_size=32,
+                    num_layers=1, num_heads=2, max_seq_len=8)
+    h, i, v = 16, 32, 32
+    sd = {
+        "model.embed_tokens.weight": torch.randn(v, h),
+        "model.norm.weight": torch.ones(h),
+        "model.layers.0.input_layernorm.weight": torch.ones(h),
+        "model.layers.0.post_attention_layernorm.weight": torch.ones(h),
+        "model.layers.0.self_attn.q_proj.weight": torch.randn(h, h),
+        "model.layers.0.self_attn.k_proj.weight": torch.randn(h, h),
+        "model.layers.0.self_attn.v_proj.weight": torch.randn(h, h),
+        "model.layers.0.self_attn.o_proj.weight": torch.randn(h, h),
+        "model.layers.0.mlp.gate_proj.weight": torch.randn(i, h),
+        "model.layers.0.mlp.up_proj.weight": torch.randn(i, h),
+        "model.layers.0.mlp.down_proj.weight": torch.randn(h, i),
+    }
+    params = convert_llama_state_dict(sd, cfg)
+    model = CausalLM(cfg)
+    ref_init = model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 4), jnp.int32))["params"]
+    # identical treedef + shapes as a fresh init
+    got = {tuple(p): l.shape for p, l in
+           jax.tree_util.tree_flatten_with_path(params)[0]}
+    want = {tuple(p): l.shape for p, l in
+            jax.tree_util.tree_flatten_with_path(ref_init)[0]}
+    assert {str(k): v for k, v in got.items()} == \
+        {str(k): v for k, v in want.items()}
+    logits = model.apply({"params": params},
+                         jnp.zeros((1, 4), jnp.int32))
+    assert logits.shape == (1, 4, 32)
+    assert np.isfinite(np.asarray(logits)).all()
