@@ -10,8 +10,9 @@ A trainer here is a *spec*: ``loss(params, batch, rng) -> (loss, aux)`` and
 in ``local_training.py`` and is shared by every federated optimizer; get/set
 of model params is replaced by pytrees flowing through function arguments.
 The reference's before/after-training attack/DP hooks
-(``client_trainer.py:61,80``) map to the engine-level hook chain in
-``server_aggregator.py`` and ``trust/``.
+(``client_trainer.py:61,80``) map to the engine-level defense -> aggregate ->
+DP pipeline in ``simulation/tpu/engine.py`` (built from ``core/security`` and
+``core/dp``).
 """
 
 from __future__ import annotations

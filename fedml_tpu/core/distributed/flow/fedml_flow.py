@@ -86,7 +86,18 @@ class FedMLAlgorithmFlow:
 
     def _run_step(self, step: _FlowStep, value: Any) -> Any:
         logger.info("flow step: %s", step.name)
-        try:
-            return step.method(value) if value is not None else step.method()
-        except TypeError:
+        # Decide by signature whether the step accepts the chained value —
+        # catching TypeError instead would swallow genuine TypeErrors raised
+        # inside the step body and double-execute its side effects.
+        if value is None:
             return step.method()
+        import inspect
+        try:
+            sig = inspect.signature(step.method)
+            accepts_arg = any(
+                p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                           p.VAR_POSITIONAL)
+                for p in sig.parameters.values())
+        except (TypeError, ValueError):  # builtins without signatures
+            accepts_arg = True
+        return step.method(value) if accepts_arg else step.method()

@@ -10,9 +10,19 @@ note) lives in ``core/mpc``; this module is the FSM.
 Per FL round r:
   masked_k = quantize(n_k * delta_k) + PRG(salt(b_k, r))
              + sum_{j>k} PRG(salt(s_kj, r)) - sum_{j<k} PRG(salt(s_jk, r))
-The server only ever sees masked vectors; dropout recovery reconstructs
-dropped clients' pairwise seeds (and surviving clients' self-mask seeds)
-from Shamir shares held by the surviving clients.
+Dropout recovery: if a client fails to submit within the round timeout, the
+server proceeds with the >= threshold survivors, reconstructs the dropped
+clients' secret keys (and survivors' self-mask seeds) from Shamir shares
+held by the survivors, and cancels the residual pairwise masks.
+
+SECURITY SCOPE: this runtime provides *protocol-shape parity only* — it is
+NOT confidential against the server. The environment has no crypto backend,
+so (a) "public keys" are the secret keys themselves (no real DH agreement),
+and (b) Shamir shares are routed through the server in plaintext rather
+than encrypted peer-to-peer. An honest-but-curious server could therefore
+reconstruct any individual update. The message flow, field math, masking
+algebra, and dropout-recovery logic match Bonawitz et al.; swap in real
+ECDH + authenticated encryption for the privacy property.
 """
 
 from __future__ import annotations
@@ -176,6 +186,7 @@ class SecAggServerManager(FedMLCommManager):
         self.threshold = int(getattr(args, "secagg_threshold", 0) or
                              max(2, self.n_clients // 2 + 1))
         self.round_num = int(getattr(args, "comm_round", 1))
+        self.round_timeout = float(getattr(args, "round_timeout_s", 0) or 0)
         self.round_idx = 0
         self.publics: Dict[int, int] = {}
         self.share_matrix: Dict[int, Dict[str, Any]] = {}
@@ -186,6 +197,11 @@ class SecAggServerManager(FedMLCommManager):
         self.result: Optional[dict] = None
         self._template_vec = np.asarray(
             tree_flatten_to_vector(global_params))
+        self._lock = threading.Lock()
+        self._phase = "setup"  # setup -> collect -> unmask -> done
+        self._surviving: List[int] = []
+        self._dropped: List[int] = []
+        self._timer: Optional[threading.Timer] = None
 
     def register_message_receive_handlers(self) -> None:
         h = self.register_message_receive_handler
@@ -217,6 +233,12 @@ class SecAggServerManager(FedMLCommManager):
             self._start_round()
 
     def _start_round(self) -> None:
+        # NOTE: the dropout timer is armed on the FIRST masked arrival (see
+        # on_masked_model), not here — arming at round start would race long
+        # first-compile times; counting from the first report only measures
+        # straggler skew.
+        with self._lock:
+            self._phase = "collect"
         wire = tree_to_wire(self.global_params)
         for rank in range(1, self.n_clients + 1):
             out = Message(SAMessage.S2C_TRAIN, 0, rank)
@@ -224,46 +246,116 @@ class SecAggServerManager(FedMLCommManager):
             out.add_params(SAMessage.KEY_ROUND, self.round_idx)
             self.send_message(out)
 
+    def _on_collect_timeout(self, armed_round: int) -> None:
+        """Proceed with >= threshold survivors if stragglers never reported."""
+        with self._lock:
+            if self._phase != "collect" or self.round_idx != armed_round:
+                return
+            if len(self.masked) < self.threshold:
+                logger.error(
+                    "secagg round %d: only %d/%d masked inputs (< threshold "
+                    "%d) at timeout — aborting session", self.round_idx,
+                    len(self.masked), self.n_clients, self.threshold)
+                self._phase = "done"
+                self.result = {"error": "secagg_below_threshold",
+                               "round": self.round_idx}
+                abort = True
+            else:
+                self._begin_unmask_locked()
+                abort = False
+        if abort:
+            for rank in range(1, self.n_clients + 1):
+                self.send_message(Message(SAMessage.S2C_FINISH, 0, rank))
+            self.finish()
+
     def on_masked_model(self, msg: Message) -> None:
         idx = msg.get_sender_id() - 1
-        self.masked[idx] = np.asarray(msg.get(SAMessage.KEY_MASKED),
-                                      np.uint32)
-        self.weights[idx] = float(msg.get(SAMessage.KEY_N))
-        if len(self.masked) == self.n_clients:
-            surviving = sorted(self.masked)
-            dropped = [i for i in range(self.n_clients) if i not in self.masked]
-            self.unmask_responses = []
-            for rank in [i + 1 for i in surviving]:
-                out = Message(SAMessage.S2C_UNMASK_REQUEST, 0, rank)
-                out.add_params(SAMessage.KEY_SURVIVING, surviving)
-                out.add_params(SAMessage.KEY_DROPPED, dropped)
-                self.send_message(out)
+        with self._lock:
+            if self._phase != "collect":
+                logger.warning("secagg: late masked input from client %d "
+                               "ignored (phase=%s)", idx, self._phase)
+                return
+            self.masked[idx] = np.asarray(msg.get(SAMessage.KEY_MASKED),
+                                          np.uint32)
+            self.weights[idx] = float(msg.get(SAMessage.KEY_N))
+            if len(self.masked) == self.n_clients:
+                self._begin_unmask_locked()
+            elif self.round_timeout > 0 and self._timer is None:
+                self._timer = threading.Timer(
+                    self.round_timeout, self._on_collect_timeout,
+                    args=(self.round_idx,))
+                self._timer.daemon = True
+                self._timer.start()
+
+    def _begin_unmask_locked(self) -> None:
+        """Transition collect -> unmask. Caller holds self._lock."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._phase = "unmask"
+        self._surviving = sorted(self.masked)
+        self._dropped = [i for i in range(self.n_clients)
+                         if i not in self.masked]
+        self.unmask_responses = []
+        for rank in [i + 1 for i in self._surviving]:
+            out = Message(SAMessage.S2C_UNMASK_REQUEST, 0, rank)
+            out.add_params(SAMessage.KEY_SURVIVING, self._surviving)
+            out.add_params(SAMessage.KEY_DROPPED, self._dropped)
+            self.send_message(out)
 
     def on_unmask_shares(self, msg: Message) -> None:
-        self.unmask_responses.append(msg)
-        if len(self.unmask_responses) < self.threshold:
-            return
-        if len(self.unmask_responses) < len(self.masked):
-            return  # wait for all surviving (simplest consistent point)
+        with self._lock:
+            if self._phase != "unmask":
+                return
+            self.unmask_responses.append(msg)
+            if len(self.unmask_responses) < self.threshold:
+                return
+            if len(self.unmask_responses) < len(self._surviving):
+                return  # wait for all surviving (simplest consistent point)
+            self._phase = "aggregate"
         self._unmask_and_advance()
 
+    def _reconstruct(self, key: str, idx: int) -> int:
+        """Reconstruct a Shamir secret for client ``idx`` from the first
+        >= threshold unmask responses carrying its share under ``key``."""
+        shares = []
+        for resp in self.unmask_responses:
+            sh = resp.get(key).get(str(idx))
+            if sh is not None:
+                shares.append(tuple(sh))
+            if len(shares) >= self.threshold:
+                break
+        if len(shares) < self.threshold:
+            raise RuntimeError(
+                f"secagg: {len(shares)} shares < threshold {self.threshold} "
+                f"for client {idx} ({key})")
+        return shamir_reconstruct(shares)
+
     def _unmask_and_advance(self) -> None:
-        surviving = sorted(self.masked)
+        surviving = self._surviving
         d = len(self._template_vec)
         total = np.zeros(d, np.uint64)
         for m in self.masked.values():
             total = (total + m.astype(np.uint64)) % _P_I
         # reconstruct each surviving client's self-mask seed and subtract
         for i in surviving:
-            shares = []
-            for resp in self.unmask_responses[:self.threshold]:
-                sh = resp.get(SAMessage.KEY_SEED_SHARES).get(str(i))
-                if sh is not None:
-                    shares.append(tuple(sh))
-            seed = shamir_reconstruct(shares[:self.threshold])
+            seed = self._reconstruct(SAMessage.KEY_SEED_SHARES, i)
             mask = expand_mask(salt_seed(seed, self.round_idx),
                                d).astype(np.uint64)
             total = (total + _P_I - mask) % _P_I
+        # cancel residual pairwise masks between survivors and dropped
+        # clients: reconstruct each dropped j's secret key, re-derive the
+        # symmetric pairwise seeds, and invert what each survivor added.
+        for j in self._dropped:
+            sk_j = self._reconstruct(SAMessage.KEY_KEY_SHARES, j)
+            for i in surviving:
+                s = pairwise_seed(sk_j, self.publics[i])
+                m = expand_mask(salt_seed(s, self.round_idx),
+                                d).astype(np.uint64)
+                if i < j:   # survivor i added +m (i<j) -> subtract
+                    total = (total + _P_I - m) % _P_I
+                else:       # survivor i added -m (i>j) -> add back
+                    total = (total + m) % _P_I
         vec = np.asarray(dequantize(total.astype(np.uint32)))
         wsum = sum(self.weights.values())
         agg_delta_vec = vec / max(wsum, 1e-12)
@@ -277,10 +369,17 @@ class SecAggServerManager(FedMLCommManager):
             rec.update(self.eval_fn(self.global_params))
             logger.info("secagg round %d: %s", self.round_idx, rec)
         self.history.append(rec)
-        self.masked.clear()
-        self.weights.clear()
-        self.round_idx += 1
-        if self.round_idx >= self.round_num:
+        with self._lock:
+            self.masked.clear()
+            self.weights.clear()
+            self.unmask_responses = []
+            self._surviving = []
+            self._dropped = []
+            self.round_idx += 1
+            done = self.round_idx >= self.round_num
+            if done:
+                self._phase = "done"
+        if done:
             for rank in range(1, self.n_clients + 1):
                 self.send_message(Message(SAMessage.S2C_FINISH, 0, rank))
             last = next((r for r in reversed(self.history)
@@ -294,8 +393,12 @@ class SecAggServerManager(FedMLCommManager):
         self._start_round()
 
 
-def run_secagg_inproc(args, fed, bundle, spec=None) -> Dict[str, Any]:
-    """Server + N SecAgg clients as threads over the in-proc broker."""
+def run_secagg_inproc(args, fed, bundle, spec=None,
+                      client_factory=None) -> Dict[str, Any]:
+    """Server + N SecAgg clients as threads over the in-proc broker.
+
+    ``client_factory(rank, args, trainer) -> SecAggClientManager`` lets tests
+    inject faulty clients (dropout / fault injection)."""
     import threading as _threading
     from ...core.distributed.communication.inproc import InProcBroker
     from ..horizontal.runner import _build_spec, _make_eval_fn
@@ -316,8 +419,11 @@ def run_secagg_inproc(args, fed, bundle, spec=None) -> Dict[str, Any]:
     for r in range(1, n + 1):
         optimizer = create_optimizer(args, spec)
         trainer = SiloTrainer(args, fed, bundle, spec, optimizer)
-        clients.append(SecAggClientManager(args, trainer, rank=r, size=n + 1,
-                                           backend="INPROC"))
+        if client_factory is not None:
+            clients.append(client_factory(r, args, trainer))
+        else:
+            clients.append(SecAggClientManager(args, trainer, rank=r,
+                                               size=n + 1, backend="INPROC"))
     threads = [_threading.Thread(target=c.run, daemon=True) for c in clients]
     for t in threads:
         t.start()

@@ -101,24 +101,29 @@ class FedMLServerManager(FedMLCommManager):
         self._complete_round()
 
     def _on_round_timeout(self, round_when_armed: int) -> None:
+        # round-validity is re-checked inside _complete_round under the SAME
+        # lock acquisition that aggregates — checking here and aggregating in
+        # a second acquisition would race a normal completion in the gap and
+        # prematurely aggregate the next round's early arrivals.
+        self._complete_round(expected_round=round_when_armed,
+                             from_timeout=True)
+
+    def _complete_round(self, expected_round: Optional[int] = None,
+                        from_timeout: bool = False) -> None:
         with self._round_lock:
-            if self.round_idx != round_when_armed:
+            if expected_round is not None and self.round_idx != expected_round:
                 return  # round already completed normally
             if not self.aggregator.model_dict:
-                return  # nothing to aggregate; keep waiting
-            logger.warning(
-                "server round %d: timeout with %d/%d models — aggregating "
-                "the silos that reported", self.round_idx,
-                len(self.aggregator.model_dict), self.aggregator.client_num)
-        self._complete_round()
-
-    def _complete_round(self) -> None:
-        with self._round_lock:
+                return  # already aggregated by a racing path
             if self._round_timer is not None:
                 self._round_timer.cancel()
                 self._round_timer = None
-            if not self.aggregator.model_dict:
-                return  # already aggregated by a racing path
+            if from_timeout:
+                logger.warning(
+                    "server round %d: timeout with %d/%d models — "
+                    "aggregating the silos that reported", self.round_idx,
+                    len(self.aggregator.model_dict),
+                    self.aggregator.client_num)
             import jax.random as jrandom
             round_key = jrandom.fold_in(self._root_key, self.round_idx)
             self.aggregator.aggregate(round_key)

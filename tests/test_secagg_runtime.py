@@ -38,3 +38,64 @@ def test_secagg_session_learns_and_matches_plain():
                     jax.tree_util.tree_leaves(result["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-3)
+
+
+def test_secagg_dropout_recovery():
+    """One silo dies after key setup and never submits a masked model. The
+    server must time out, proceed with the >= threshold survivors,
+    reconstruct the dropped client's pairwise masks from Shamir shares, and
+    produce EXACTLY the survivors-only weighted aggregate (up to
+    quantization) — a wrongly-unmasked sum would be garbage, not close."""
+    from fedml_tpu.cross_silo.secagg import (SecAggClientManager,
+                                             run_secagg_inproc)
+    from fedml_tpu.cross_silo.horizontal.runner import _build_spec
+    from fedml_tpu.cross_silo.client.trainer import SiloTrainer
+    from fedml_tpu.optimizers.registry import create_optimizer
+
+    DROP_RANK = 4  # client idx 3
+
+    class DroppingClient(SecAggClientManager):
+        def on_train(self, msg):
+            return  # dead silo: participated in setup, never trains
+
+    args = make_args(comm_round=2, round_timeout_s=3.0)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+
+    def factory(rank, a, trainer):
+        cls = DroppingClient if rank == DROP_RANK else SecAggClientManager
+        return cls(a, trainer, rank=rank, size=5, backend="INPROC")
+
+    result = run_secagg_inproc(args, fed, bundle, client_factory=factory)
+    assert result is not None and "error" not in result, result
+    assert len(result["history"]) == 2
+
+    # expected: plain weighted FedAvg over survivors 0..2 only
+    args2 = make_args(comm_round=2)
+    fed2, output_dim2 = data_mod.load(args2)
+    bundle2 = model_mod.create(args2, output_dim2)
+    spec = _build_spec(fed2, bundle2, None)
+    rng = jax.random.PRNGKey(int(args2.random_seed))
+    init_rng, _ = jax.random.split(rng)
+    params = bundle2.init(init_rng, fed2.train.x[0, 0])
+    trainers = []
+    for _ in range(3):
+        opt = create_optimizer(args2, spec)
+        trainers.append(SiloTrainer(args2, fed2, bundle2, spec, opt))
+    for r in range(2):
+        deltas, ws = [], []
+        for idx in range(3):
+            new_p, n, _ = trainers[idx].train(params, idx, r)
+            deltas.append(jax.tree_util.tree_map(
+                lambda a, b: np.asarray(a) - np.asarray(b), new_p, params))
+            ws.append(n)
+        wsum = sum(ws)
+        agg = jax.tree_util.tree_map(
+            lambda *ds: sum(w * d for w, d in zip(ws, ds)) / wsum, *deltas)
+        params = jax.tree_util.tree_map(
+            lambda p, u: np.asarray(p) + u, params, agg)
+
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(result["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
