@@ -1,6 +1,6 @@
 """Benchmark: FL round throughput of the jitted mesh engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 The reference publishes no benchmark numbers (BASELINE.md), so the baseline
 here is the reference's own *architecture* on identical hardware: the
@@ -8,14 +8,36 @@ single-process golden loop (per-client dispatch + host-side aggregation —
 the shape of ``sp/fedavg/fedavg_api.py``) vs our fused whole-round SPMD
 program. ``vs_baseline`` = mesh rounds/hour ÷ golden-loop rounds/hour.
 
-Workload: FedAvg ResNet-20/CIFAR-10-shaped, 8 clients/round, 1 local epoch —
-a scaled-down sibling of the BASELINE.md north-star (ResNet-56, 128 clients).
+Workload: the BASELINE.md north-star *shape* — FedAvg ResNet-56, 64 clients
+per round (multi-client-per-chip scan), bf16 compute. Real CIFAR-10 is used
+when it is cached or downloadable; otherwise the run falls back (loudly,
+and labeled in the output) to a synthetic stand-in of identical shape —
+throughput is shape-determined either way.
+
+Besides rounds/hour the line reports ``step_time_s``, achieved ``tflops``
+and ``mfu`` (vs the chip's bf16 peak), computed from XLA's own
+cost-analysis FLOP count for the compiled round program.
 """
 
 from __future__ import annotations
 
 import json
 import time
+
+
+# bf16 peak TFLOP/s per chip, by device-kind substring (public specs)
+_PEAK_TFLOPS = (
+    ("v6", 918.0), ("v5p", 459.0), ("v5e", 197.0), ("v5", 197.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0), ("cpu", 0.5),
+)
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return 197.0
 
 
 def run():
@@ -31,13 +53,17 @@ def run():
     from fedml_tpu.simulation.sp.simulator import SPSimulator
     from fedml_tpu.simulation.tpu.engine import TPUSimulator
 
+    n_clients = 64
     args = Arguments(
-        dataset="cifar10", model="resnet20",
-        client_num_in_total=8, client_num_per_round=8,
+        dataset="cifar10", model="resnet56", precision="bfloat16",
+        client_num_in_total=n_clients, client_num_per_round=n_clients,
         comm_round=1, epochs=1, batch_size=32, learning_rate=0.1,
         frequency_of_the_test=10_000, random_seed=0,
+        allow_synthetic=True,  # loud, labeled fallback when no net/cache
+        synthetic_size=50_000,  # stand-in matches real CIFAR-10's workload
     )
     fed, output_dim = load(args)
+    provenance = getattr(fed, "provenance", "real")
     bundle = create(args, output_dim)
     spec = ClassificationTrainer(bundle.apply)
     hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate), epochs=1)
@@ -68,21 +94,51 @@ def run():
 
     tpu_round_s = time_rounds(tpu_round, lambda: tpu_sim.params)
 
-    # --- baseline: golden per-client loop (reference SP architecture)
-    sp_sim = SPSimulator(args, fed, bundle, create_optimizer(args, spec), spec)
+    # FLOPs of the compiled round program (XLA cost analysis), for MFU
+    flops = tpu_sim.round_cost_flops(hyper)
+    n_dev = tpu_sim.n_devices
+    achieved_tflops = (flops / tpu_round_s) / 1e12 if flops else 0.0
+    peak = _peak_tflops(jax.devices()[0]) * n_dev
+    mfu = achieved_tflops / peak if peak else 0.0
+
+    # --- baseline: golden per-client loop (reference SP architecture),
+    # scaled down (8 of 64 clients) then normalized — the full 64-client
+    # python loop would dominate bench wall-clock for no extra information.
+    base_clients = 8
+    bargs = Arguments(
+        dataset="cifar10", model="resnet56", precision="bfloat16",
+        client_num_in_total=base_clients, client_num_per_round=base_clients,
+        comm_round=1, epochs=1, batch_size=32, learning_rate=0.1,
+        frequency_of_the_test=10_000, random_seed=0, allow_synthetic=True,
+        synthetic_size=6_250,  # same per-client workload as the 64-client run
+    )
+    bfed, _ = load(bargs)
+    sp_sim = SPSimulator(bargs, bfed, bundle, create_optimizer(bargs, spec),
+                         spec)
 
     def sp_round():
         sp_sim.run(comm_round=1)
 
-    sp_round_s = time_rounds(sp_round, lambda: sp_sim.params)
-
+    sp_round_s = time_rounds(sp_round, lambda: sp_sim.params,
+                             warmup=1, iters=2)
+    # normalize per *training sample* so the comparison is fair whether the
+    # loader produced real data (both runs see the full dataset) or the
+    # per-client-matched synthetic stand-ins
+    tpu_samples = float(fed.total_train_samples)
+    sp_samples = float(bfed.total_train_samples)
     rounds_per_hour = 3600.0 / tpu_round_s
-    vs_baseline = sp_round_s / tpu_round_s
+    vs_baseline = (sp_round_s / sp_samples) / (tpu_round_s / tpu_samples)
     print(json.dumps({
-        "metric": "fedavg_resnet20_cifar10_rounds_per_hour",
+        "metric": "fedavg_resnet56_cifar10_rounds_per_hour",
         "value": round(rounds_per_hour, 1),
-        "unit": "rounds/hour (8 clients/round, 1 local epoch)",
+        "unit": f"rounds/hour (64 clients/round, 1 local epoch, bf16, "
+                f"{provenance} data)",
         "vs_baseline": round(vs_baseline, 3),
+        "step_time_s": round(tpu_round_s, 4),
+        "tflops": round(achieved_tflops, 2),
+        "mfu": round(mfu, 4),
+        "n_devices": n_dev,
+        "data_provenance": provenance,
     }))
 
 

@@ -41,6 +41,7 @@ _SCHEMA: Dict[str, Any] = {
     "data_cache_dir": "~/.cache/fedml_tpu/data",
     "partition_method": "hetero",
     "partition_alpha": 0.5,
+    "allow_synthetic": False,    # opt-in gate for synthetic stand-ins
     # model_args
     "model": "lr",
     # train_args
@@ -76,6 +77,7 @@ _SCHEMA: Dict[str, Any] = {
     # tracking_args
     "enable_wandb": False,
     "log_file_dir": "~/.cache/fedml_tpu/logs",
+    "save_model_path": None,     # persist final params (serving artifact)
     "checkpoint_dir": None,
     "checkpoint_every_rounds": 0,  # 0 = off
     # security/privacy (consulted by hook chain; parity with L4 singletons)

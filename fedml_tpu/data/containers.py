@@ -38,6 +38,9 @@ class FederatedDataset:
     # regression (reference encodes this in per-dataset trainer choices,
     # ml/trainer/trainer_creator.py)
     task: str = "classification"
+    # "real" | "synthetic" — set by the loader so reporting can never
+    # present a generated stand-in as the real task
+    provenance: str = "real"
 
     @property
     def total_train_samples(self) -> int:

@@ -23,6 +23,7 @@ class ModelBundle:
     module: nn.Module
     name: str
     _has_dropout: bool = False
+    compute_dtype: Any = jnp.float32
 
     def init(self, rng: jax.Array, sample_input: jnp.ndarray) -> PyTree:
         variables = self.module.init(rng, sample_input, train=False)
@@ -31,12 +32,45 @@ class ModelBundle:
     def apply(self, params: PyTree, x: jnp.ndarray, rng: Optional[jax.Array] = None,
               train: bool = False) -> jnp.ndarray:
         rngs = {"dropout": rng} if (rng is not None and self._has_dropout) else None
-        return self.module.apply({"params": params}, x, train=train, rngs=rngs)
+        if self.compute_dtype != jnp.float32:
+            # Mixed precision, TPU-standard recipe: master params stay f32
+            # (the optimizer and the FedAvg psum aggregate in f32); the
+            # forward/backward compute path — where the MXU matmuls are —
+            # runs in bf16 via a cast at the boundary. Gradients flow back
+            # through the cast and land in f32 on the master leaves.
+            dt = self.compute_dtype
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(dt) if a.dtype == jnp.float32 else a, params)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(dt)
+        out = self.module.apply({"params": params}, x, train=train, rngs=rngs)
+        return out.astype(jnp.float32)
+
+
+def _compute_dtype(args):
+    p = str(getattr(args, "precision", "float32") or "float32").lower()
+    if p in ("bf16", "bfloat16", "mixed", "mixed_bfloat16"):
+        return jnp.bfloat16
+    if p in ("fp16", "float16", "half"):
+        return jnp.float16
+    return jnp.float32
 
 
 def create(args, output_dim: int):
     """Returns a ModelBundle, or a (generator, discriminator) bundle pair
-    for model='gan' (consumed by custom FedGAN trainers)."""
+    for model='gan' (consumed by custom FedGAN trainers). ``args.precision``
+    (bfloat16/float32) selects the compute dtype of the bundle's apply path."""
+    out = _create(args, output_dim)
+    dt = _compute_dtype(args)
+    if dt != jnp.float32:
+        if isinstance(out, tuple):
+            out = tuple(dataclasses.replace(b, compute_dtype=dt) for b in out)
+        else:
+            out = dataclasses.replace(out, compute_dtype=dt)
+    return out
+
+
+def _create(args, output_dim: int):
     name = str(getattr(args, "model", "lr")).lower()
     from .linear import LogisticRegression, MLP
     from .cv.cnn import CNNFemnist, SimpleCNN

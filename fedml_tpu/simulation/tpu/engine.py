@@ -369,6 +369,39 @@ class TPUSimulator:
         self.contribution.assess({"v": pvec}, {"v": mat}, w, eval_fn,
                                  client_ids=sampled, round_idx=round_idx)
 
+    def round_cost_flops(self, hyper: TrainHyper) -> float:
+        """FLOPs one round of this workload executes (all devices), for the
+        bench's MFU metric. XLA's cost analysis counts a ``lax.scan`` body
+        ONCE regardless of trip count, so instead of lowering the whole
+        round program we cost a single loop-free fwd+bwd batch step and
+        multiply by the number of real local steps a round runs:
+        ``sampled_clients x epochs x batches_per_client``."""
+        try:
+            batch = {
+                "x": jnp.zeros_like(self.fed.train.x[0, 0]),
+                "y": jnp.zeros_like(self.fed.train.y[0, 0]),
+                "mask": jnp.zeros_like(self.fed.train.mask[0, 0]),
+            }
+            rng = jax.random.PRNGKey(0)
+
+            def one_step(params, batch, rng):
+                (_, aux), grads = jax.value_and_grad(
+                    self.spec.loss, has_aux=True)(params, batch, rng)
+                return grads
+
+            compiled = jax.jit(one_step).lower(
+                self.params, batch, rng).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0] if cost else {}
+            per_batch = float(cost.get("flops", 0.0) or 0.0)
+            n_sampled = int(self.args.client_num_per_round)
+            n_batches = int(self.fed.train.x.shape[1])
+            steps = n_sampled * int(hyper.epochs) * n_batches
+            return per_batch * steps
+        except Exception:
+            return 0.0
+
     def run_round(self, round_idx: int, hyper: TrainHyper) -> Dict[str, float]:
         sampled = client_sampling(round_idx, self.fed.num_clients,
                                   int(self.args.client_num_per_round))

@@ -57,7 +57,7 @@ class TestLoaders:
         assert fed.client_num_samples.std() > 0
 
     def test_stackoverflow_lr_multilabel(self):
-        args = Arguments(dataset="stackoverflow_lr", client_num_in_total=4,
+        args = Arguments(dataset="stackoverflow_lr", allow_synthetic=True, client_num_in_total=4,
                          batch_size=16)
         fed, out_dim = data_mod.load(args)
         assert fed.task == "multilabel"
@@ -83,3 +83,54 @@ class TestLoaders:
         assert out_dim == 62
         assert fed.num_clients == 2
         assert fed.client_num_samples.tolist() == [27, 18]  # 10% held out
+
+
+class TestRealDataPolicy:
+    """Strict real-data policy: synthetic stand-ins are opt-in and labeled."""
+
+    def test_bundled_real_digits(self, tmp_path):
+        # digits ships inside scikit-learn: real data with zero egress
+        args = Arguments(dataset="digits", model="cnn",
+                         client_num_in_total=4, batch_size=16,
+                         data_cache_dir=str(tmp_path))
+        fed, out_dim = data_mod.load(args)
+        assert fed.provenance == "real"
+        assert out_dim == 10
+        assert fed.input_shape == (8, 8, 1)
+        assert fed.total_train_samples > 1000
+        # second load hits the npz cache
+        assert (tmp_path / "digits.npz").exists()
+
+    def test_bundled_real_tabular(self, tmp_path):
+        args = Arguments(dataset="wine", client_num_in_total=3, batch_size=8,
+                         data_cache_dir=str(tmp_path))
+        fed, out_dim = data_mod.load(args)
+        assert fed.provenance == "real"
+        assert out_dim == 3
+
+    def test_missing_real_dataset_raises(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("FEDML_TPU_ALLOW_SYNTHETIC", raising=False)
+        # keep the test hermetic on network-connected machines
+        from fedml_tpu.data import acquire as acquire_mod
+        monkeypatch.setattr(acquire_mod, "acquire", lambda *a, **k: None)
+        args = Arguments(dataset="cifar10", data_cache_dir=str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            data_mod.load(args)
+
+    def test_synthetic_optin_is_labeled(self, tmp_path):
+        args = Arguments(dataset="cifar10", data_cache_dir=str(tmp_path),
+                         allow_synthetic=True, model="simple_cnn")
+        fed, _ = data_mod.load(args)
+        assert fed.provenance == "synthetic"
+
+    def test_real_digits_learns(self, tmp_path):
+        """Honest real-data accuracy: federated LR on UCI digits beats 80%
+        within a few rounds (10-class task, 10% chance level)."""
+        import fedml_tpu
+        args = Arguments(dataset="digits", model="lr",
+                         client_num_in_total=8, client_num_per_round=8,
+                         comm_round=10, epochs=2, batch_size=32,
+                         learning_rate=0.3, frequency_of_the_test=9,
+                         data_cache_dir=str(tmp_path), random_seed=0)
+        r = fedml_tpu.run_simulation(backend="tpu", args=args)
+        assert r["final_test_acc"] > 0.8
