@@ -22,6 +22,7 @@ or the one-liner ``fedml_tpu.run_simulation(backend="tpu")``.
 from __future__ import annotations
 
 import logging
+import os
 import random
 from typing import Any, Optional
 
@@ -81,7 +82,12 @@ def run_simulation(backend: str = "tpu", args: Optional[Arguments] = None,
     fed, output_dim = data_mod.load(args)
     bundle = model_mod.create(args, output_dim)
     runner = FedMLRunner(args, dataset=fed, model=bundle)
-    return runner.run()
+    result = runner.run()
+    save_path = getattr(args, "save_model_path", None)
+    if save_path and isinstance(result, dict) and "params" in result:
+        from .serving import save_model
+        save_model(result["params"], os.path.expanduser(str(save_path)))
+    return result
 
 
 def run_cross_silo_server(args: Optional[Arguments] = None, **overrides: Any):

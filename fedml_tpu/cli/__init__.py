@@ -1,0 +1,11 @@
+"""CLI package: ``python -m fedml_tpu.cli <command>`` (or console-script
+``fedml_tpu`` when installed).
+
+Parity target: the reference's click command group ``cli/cli.py:11-77``
+(``fedml login/launch/run/build/logs/env/version/diagnosis/...``). Commands
+here wrap :mod:`fedml_tpu.api` — the same local-first platform the Python
+API exposes — plus ``train`` (run a training config in-process) and
+``serve`` (serve a saved model artifact).
+"""
+
+from .main import cli  # noqa: F401

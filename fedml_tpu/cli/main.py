@@ -1,0 +1,157 @@
+"""The click command group (reference ``cli/cli.py:11-77``)."""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import click
+
+
+@click.group()
+@click.help_option("--help", "-h")
+def cli():
+    """fedml_tpu — TPU-native federated & distributed ML."""
+
+
+@cli.command("login", help="Record a local platform profile (local-first; "
+                           "no network)")
+@click.argument("api_key", required=False)
+def login(api_key):
+    from .. import api
+    rc = api.fedml_login(api_key)
+    click.echo("login OK" if rc == 0 else f"login failed ({rc})")
+    sys.exit(rc)
+
+
+@cli.command("launch", help="Launch a job yaml (task job or training "
+                            "config) as a local run")
+@click.argument("yaml_file")
+@click.option("--blocking", is_flag=True, default=False,
+              help="wait for the job instead of detaching")
+def launch(yaml_file, blocking):
+    from .. import api
+    res = api.launch_job(yaml_file, detach=not blocking)
+    if res.result_code != 0:
+        click.echo(f"launch failed: {res.result_message}", err=True)
+        sys.exit(1)
+    click.echo(res.run_id)
+
+
+@cli.group("run", help="Inspect and control runs")
+def run_group():
+    pass
+
+
+@run_group.command("list")
+def run_list_cmd():
+    from .. import api
+    for meta in api.run_list():
+        click.echo(f"{meta['run_id']}  {meta.get('status'):<9} "
+                   f"{meta.get('kind', '?'):<6} {meta.get('yaml', '')}")
+
+
+@run_group.command("status")
+@click.argument("run_id")
+def run_status_cmd(run_id):
+    from .. import api
+    status = api.run_status(run_id)
+    if status is None:
+        click.echo("unknown run", err=True)
+        sys.exit(1)
+    click.echo(status)
+
+
+@run_group.command("logs")
+@click.argument("run_id")
+@click.option("--tail", type=int, default=None, help="last N lines only")
+def run_logs_cmd(run_id, tail):
+    from .. import api
+    for line in api.run_logs(run_id, tail=tail):
+        click.echo(line)
+
+
+@run_group.command("stop")
+@click.argument("run_id")
+def run_stop_cmd(run_id):
+    from .. import api
+    ok = api.run_stop(run_id)
+    click.echo("stopped" if ok else "unknown run")
+    sys.exit(0 if ok else 1)
+
+
+@cli.command("build", help="Package a job workspace into a zip")
+@click.argument("source_dir")
+@click.option("--dest", default=None, help="output zip path")
+@click.option("--config", default=None, help="config yaml to embed")
+def build_cmd(source_dir, dest, config):
+    from .. import api
+    click.echo(api.build(source_dir, dest, config))
+
+
+@cli.command("train", help="Run a training config yaml in-process")
+@click.option("--cf", "yaml_file", required=True, help="config yaml")
+@click.option("--rank", type=int, default=0)
+@click.option("--role", default=None)
+def train_cmd(yaml_file, rank, role):
+    import fedml_tpu
+    from ..arguments import load_arguments
+    from ..constants import (FEDML_TRAINING_PLATFORM_CROSS_DEVICE,
+                             FEDML_TRAINING_PLATFORM_CROSS_SILO,
+                             FEDML_TRAINING_PLATFORM_CROSS_CLOUD)
+    args = load_arguments(yaml_file, rank=rank,
+                          **({"role": role} if role else {}))
+    ttype = str(getattr(args, "training_type", "simulation"))
+    if ttype in (FEDML_TRAINING_PLATFORM_CROSS_SILO,
+                 FEDML_TRAINING_PLATFORM_CROSS_CLOUD):
+        if str(getattr(args, "role", "client")) == "server":
+            result = fedml_tpu.run_cross_silo_server(args)
+        else:
+            result = fedml_tpu.run_cross_silo_client(args)
+    else:
+        result = fedml_tpu.run_simulation(
+            backend=str(getattr(args, "backend", "tpu")), args=args)
+    if isinstance(result, dict):
+        summary = {k: result[k] for k in
+                   ("final_test_acc", "final_test_loss", "rounds",
+                    "wall_time_s") if k in result}
+        click.echo(json.dumps(summary))
+
+
+@cli.command("serve", help="Serve a saved model artifact over HTTP")
+@click.argument("params_path")
+@click.option("--model", required=True, help="model name (e.g. resnet20)")
+@click.option("--output-dim", type=int, required=True)
+@click.option("--port", type=int, default=8890)
+@click.option("--dataset", default="", help="dataset name (shapes some "
+                                            "model variants)")
+def serve_cmd(params_path, model, output_dim, port, dataset):
+    from .. import api
+    click.echo(f"serving {params_path} on :{port} (POST /predict)")
+    api.model_serve(params_path, model, output_dim, port=port,
+                    dataset=dataset, block=True)
+
+
+@cli.command("env", help="Print environment info (versions, devices)")
+def env_cmd():
+    from ..utils.collect_env import collect_env
+    click.echo(collect_env())
+
+
+@cli.command("diagnosis", help="Check local comm backends end-to-end")
+def diagnosis_cmd():
+    from ..utils.diagnosis import run_diagnosis
+    report = run_diagnosis()
+    for name, (ok, detail) in report.items():
+        click.echo(f"{name:<10} {'OK' if ok else 'FAIL'}  {detail}")
+    sys.exit(0 if all(ok for ok, _ in report.values()) else 1)
+
+
+@cli.command("version", help="Display fedml_tpu version")
+def version_cmd():
+    import fedml_tpu
+    click.echo(f"fedml_tpu version: {fedml_tpu.__version__}")
+
+
+if __name__ == "__main__":
+    cli()

@@ -100,7 +100,7 @@ def _mnist_like(urls: Dict[str, Tuple[str, Optional[str]]]) -> Arrays:
             (parts["test_x"], parts["test_y"].astype(np.int64)))
 
 
-def _cifar(url: Tuple[str, Optional[str]], coarse100: bool = False) -> Arrays:
+def _cifar(url: Tuple[str, Optional[str]]) -> Arrays:
     blob = _fetch(*url)
     label_key = b"fine_labels" if "100" in url[0] else b"labels"
     xs_tr: List[np.ndarray] = []

@@ -42,7 +42,8 @@ def _synthetic_allowed(args, raw_name: str) -> bool:
         return True
     if getattr(args, "allow_synthetic", False):
         return True
-    return bool(os.environ.get("FEDML_TPU_ALLOW_SYNTHETIC"))
+    env = os.environ.get("FEDML_TPU_ALLOW_SYNTHETIC", "").strip().lower()
+    return env not in ("", "0", "false", "no", "off")
 
 
 def _synthetic_fallback(args, raw_name: str, name: str):
@@ -60,6 +61,16 @@ def _synthetic_fallback(args, raw_name: str, name: str):
         "SYNTHETIC STAND-IN: dataset %r is not available; training on "
         "generated data shaped like it. Metrics do NOT reflect the real "
         "task.", name)
+
+
+def _cap_train(xtr, ytr, args, seed: int):
+    """Deterministically subsample the training set when the caller bounds
+    total samples (quick runs, bench baselines)."""
+    cap = int(getattr(args, "max_total_samples", 0) or 0)
+    if cap and len(xtr) > cap:
+        idx = np.random.RandomState(seed ^ 0x5EED).permutation(len(xtr))[:cap]
+        return xtr[idx], ytr[idx]
+    return xtr, ytr
 
 
 def _try_npz(cache_dir: str, name: str):
@@ -176,6 +187,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
             n_test = 1000
             xtr, ytr, xte, yte = x[:-n_test], y[:-n_test], x[-n_test:], y[-n_test:]
             provenance = "synthetic"
+        xtr, ytr = _cap_train(xtr, ytr, args, seed)
         fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
                                   n_classes, method, alpha, seed)
         fed.provenance = provenance

@@ -1,0 +1,142 @@
+"""Serving SDK: ``FedMLPredictor`` + ``FedMLInferenceRunner``.
+
+Parity target: the reference's user-facing serving SDK —
+``serving/fedml_predictor.py:4`` (ABC with ``predict``) and
+``serving/fedml_inference_runner.py:8`` (FastAPI wrapper exposing
+``/predict`` and ``/ready``). TPU-first redesign choices:
+
+* the HTTP layer is the stdlib ``ThreadingHTTPServer`` (no FastAPI/uvicorn
+  dependency) — the contract (POST ``/predict`` with a JSON body, GET
+  ``/ready``) is what matters for parity, not the web framework;
+* :class:`CheckpointPredictor` jits the model's forward once and serves
+  batched JAX inference from a saved training checkpoint, so the path from
+  ``run_simulation`` to a live endpoint is two lines;
+* model artifacts are a single pickled numpy pytree (``save_model`` /
+  ``load_model``) — host-independent, no framework-versioned state dicts.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pickle
+import threading
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+PyTree = Any
+
+
+def save_model(params: PyTree, path: str) -> str:
+    """Persist model params as a pickled numpy pytree."""
+    import jax
+    host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
+    with open(path, "wb") as f:
+        pickle.dump(host, f)
+    return path
+
+
+def load_model(path: str) -> PyTree:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class FedMLPredictor(ABC):
+    """User-implemented predictor (reference ``fedml_predictor.py:4``)."""
+
+    @abstractmethod
+    def predict(self, request: Any) -> Any:
+        """Map one JSON-decoded request to a JSON-encodable response."""
+
+    def ready(self) -> bool:
+        return True
+
+
+class CheckpointPredictor(FedMLPredictor):
+    """Serve a trained fedml_tpu model: request ``{"inputs": [[...], ...]}``
+    → response ``{"outputs": logits, "classes": argmax}``."""
+
+    def __init__(self, bundle, params: PyTree):
+        import jax
+        self.bundle = bundle
+        self.params = params
+        self._fwd = jax.jit(lambda p, x: bundle.apply(p, x))
+
+    @classmethod
+    def from_files(cls, args, params_path: str, output_dim: int):
+        from ..model import create
+        bundle = create(args, output_dim)
+        return cls(bundle, load_model(params_path))
+
+    def predict(self, request: Any) -> Any:
+        import jax.numpy as jnp
+        x = jnp.asarray(np.asarray(request["inputs"], np.float32))
+        logits = np.asarray(self._fwd(self.params, x))
+        return {"outputs": logits.tolist(),
+                "classes": logits.argmax(-1).tolist()}
+
+
+class FedMLInferenceRunner:
+    """HTTP wrapper: POST /predict, GET /ready (reference
+    ``fedml_inference_runner.py:8-39``). ``start()`` serves on a background
+    thread and returns the bound port; ``run()`` blocks."""
+
+    def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.predictor = predictor
+        runner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args_):  # quiet by default
+                logger.debug("serving: " + fmt, *args_)
+
+            def _reply(self, code: int, payload: Any) -> None:
+                blob = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    ok = runner.predictor.ready()
+                    self._reply(200 if ok else 503, {"ready": ok})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    request = json.loads(self.rfile.read(n) or b"{}")
+                    self._reply(200, runner.predictor.predict(request))
+                except Exception as e:
+                    logger.exception("predict failed")
+                    self._reply(500, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.info("inference runner listening on :%d", self.port)
+        return self.port
+
+    def run(self) -> None:
+        self.start()
+        self._thread.join()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,50 @@
+"""Environment collection (reference
+``computing/scheduler/env/collect_env.py:11`` — prints OS/python/framework/
+accelerator inventory at init or via ``fedml_tpu env``)."""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+
+def collect_env() -> str:
+    lines = []
+    lines.append("======== fedml_tpu environment ========")
+    import fedml_tpu
+    lines.append(f"fedml_tpu version: {fedml_tpu.__version__}")
+    lines.append(f"python:            {sys.version.split()[0]}")
+    lines.append(f"os:                {platform.platform()}")
+    lines.append(f"cpu count:         {os.cpu_count()}")
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        lines.append(f"memory:            {vm.total / 2**30:.1f} GiB "
+                     f"({vm.percent}% used)")
+    except ImportError:
+        pass
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy"):
+        try:
+            m = __import__(mod)
+            lines.append(f"{mod + ':':<19}{getattr(m, '__version__', '?')}")
+        except ImportError:
+            lines.append(f"{mod + ':':<19}not installed")
+    lines.append("-------- accelerators --------")
+    try:
+        import jax
+        devs = jax.devices()
+        lines.append(f"jax backend:       {jax.default_backend()}")
+        lines.append(f"devices:           {len(devs)}")
+        for d in devs[:8]:
+            lines.append(f"  - {d.platform}:{d.id} {d.device_kind}")
+        if len(devs) > 8:
+            lines.append(f"  ... and {len(devs) - 8} more")
+    except Exception as e:  # noqa: BLE001 — report, never crash env print
+        lines.append(f"jax devices unavailable: {e}")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(collect_env())

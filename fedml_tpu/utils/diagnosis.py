@@ -1,0 +1,82 @@
+"""Client diagnosis: loopback echo checks of the comm backends.
+
+Parity target: reference ``computing/scheduler/slave/client_diagnosis.py:24``
+(connectivity probes to MQTT/S3/platform + client↔server echo test). This
+framework is local-first, so diagnosis probes what actually carries traffic
+here: the gRPC and TCP WAN transports (send → receive round-trip on
+loopback) and the JAX device runtime.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+
+def _echo_backend(make_manager) -> Tuple[bool, str]:
+    import threading
+
+    from ..core.distributed.communication.base_com_manager import Observer
+    from ..core.distributed.communication.message import Message
+
+    class _Sink(Observer):
+        def __init__(self):
+            self.got = threading.Event()
+
+        def receive_message(self, msg_type, msg):
+            self.got.set()
+
+    a = b = None
+    try:
+        a = make_manager(0)
+        b = make_manager(1)
+        sink = _Sink()
+        b.add_observer(sink)
+        threading.Thread(target=b.handle_receive_message,
+                         daemon=True).start()
+        msg = Message("diag_echo", 0, 1)
+        msg.add_params("payload", [1, 2, 3])
+        t0 = time.perf_counter()
+        a.send_message(msg)
+        if not sink.got.wait(timeout=5.0):
+            return False, "no message within 5s"
+        ms = (time.perf_counter() - t0) * 1e3
+        return True, f"echo round-trip {ms:.1f} ms"
+    except Exception as e:  # noqa: BLE001 — diagnosis must report, not die
+        return False, str(e)
+    finally:
+        for m in (a, b):
+            try:
+                if m is not None:
+                    m.stop_receive_message()
+            except Exception:
+                pass
+
+
+def run_diagnosis() -> Dict[str, Tuple[bool, str]]:
+    report: Dict[str, Tuple[bool, str]] = {}
+
+    # device runtime
+    try:
+        import jax
+        import jax.numpy as jnp
+        val = float(jax.jit(lambda x: (x * x).sum())(jnp.arange(8.0)))
+        devs = jax.devices()
+        report["device"] = (val == 140.0,
+                            f"{len(devs)} x {devs[0].device_kind}")
+    except Exception as e:  # noqa: BLE001
+        report["device"] = (False, str(e))
+
+    from ..core.distributed.communication.grpc import GRPCCommManager
+    from ..core.distributed.communication.tcp import TCPCommManager
+
+    report["grpc"] = _echo_backend(
+        lambda rank: GRPCCommManager(rank, base_port=39790))
+    report["tcp"] = _echo_backend(
+        lambda rank: TCPCommManager(rank, base_port=39890))
+    return report
+
+
+if __name__ == "__main__":
+    for name, (ok, detail) in run_diagnosis().items():
+        print(f"{name:<10} {'OK' if ok else 'FAIL'}  {detail}")

@@ -1,0 +1,194 @@
+"""Platform layer: local-first api/, cli/, workflow/, serving/.
+
+Done-criterion from the build plan: the CLI runs a simulation from a YAML
+and the resulting model is served over HTTP (reference ``cli/cli.py:11-77``,
+``api/__init__.py:29-43``, ``workflow/workflow.py:42``,
+``serving/fedml_predictor.py:4``)."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu import api
+from fedml_tpu.arguments import Arguments
+
+
+@pytest.fixture()
+def runs_dir(tmp_path, monkeypatch):
+    d = tmp_path / "runs"
+    monkeypatch.setenv("FEDML_TPU_RUNS_DIR", str(d))
+    return d
+
+
+def _wait_status(run_id, want, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = api.run_status(run_id)
+        if status in want:
+            return status
+        time.sleep(0.3)
+    return api.run_status(run_id)
+
+
+class TestApi:
+    def test_task_job_lifecycle(self, runs_dir, tmp_path):
+        job = tmp_path / "job.yaml"
+        job.write_text("workspace: .\njob: echo hello-from-job; exit 0\n")
+        assert api.fedml_login("k") == 0
+        res = api.launch_job(str(job))
+        assert res.result_code == 0 and res.run_id
+        status = _wait_status(res.run_id, {api.STATUS_FINISHED,
+                                           api.STATUS_FAILED})
+        assert status == api.STATUS_FINISHED
+        assert any("hello-from-job" in l for l in api.run_logs(res.run_id))
+        # stopping a finished run must NOT clobber its record
+        assert api.run_stop(res.run_id)
+        assert api.run_status(res.run_id) == api.STATUS_FINISHED
+        assert any(m["run_id"] == res.run_id for m in api.run_list())
+
+    def test_failed_job_status(self, runs_dir, tmp_path):
+        job = tmp_path / "job.yaml"
+        job.write_text("job: exit 3\n")
+        res = api.launch_job(str(job), detach=False)
+        assert res.result_code == -1
+        assert api.run_status(res.run_id) == api.STATUS_FAILED
+
+    def test_stop_running_job(self, runs_dir, tmp_path):
+        job = tmp_path / "job.yaml"
+        job.write_text("job: sleep 60\n")
+        res = api.launch_job(str(job))
+        assert api.run_status(res.run_id) == api.STATUS_RUNNING
+        assert api.run_stop(res.run_id)
+        assert api.run_status(res.run_id) == api.STATUS_KILLED
+
+    def test_build_packages_workspace(self, tmp_path):
+        src = tmp_path / "ws"
+        src.mkdir()
+        (src / "main.py").write_text("print('hi')\n")
+        cfg = tmp_path / "conf.yaml"
+        cfg.write_text("a: 1\n")
+        dest = api.build(str(src), str(tmp_path / "out.zip"), str(cfg))
+        import zipfile
+        names = zipfile.ZipFile(dest).namelist()
+        assert "main.py" in names
+        assert "conf/conf.yaml" in names
+
+
+class TestCliTrainAndServe:
+    def test_cli_runs_sim_from_yaml_and_model_serves(self, runs_dir,
+                                                     tmp_path):
+        """The full platform slice: yaml -> CLI train subprocess ->
+        checkpointed params -> HTTP serving."""
+        ckpt = tmp_path / "model.pkl"
+        cfg = tmp_path / "fedml_config.yaml"
+        cfg.write_text(f"""
+common_args:
+  training_type: simulation
+  random_seed: 0
+data_args:
+  dataset: synthetic_mnist
+train_args:
+  client_num_in_total: 4
+  client_num_per_round: 4
+  comm_round: 2
+  epochs: 1
+  batch_size: 16
+  learning_rate: 0.1
+model_args:
+  model: lr
+tracking_args:
+  save_model_path: {ckpt}
+""")
+        res = api.launch_job(str(cfg), detach=False)
+        logs = "\n".join(api.run_logs(res.run_id))
+        assert res.result_code == 0, logs
+        assert api.run_status(res.run_id) == api.STATUS_FINISHED
+        assert ckpt.exists(), logs
+
+        runner = api.model_serve(str(ckpt), model="lr", output_dim=10)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{runner.port}/ready") as r:
+                assert json.load(r)["ready"] is True
+            x = np.zeros((2, 784), np.float32).tolist()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{runner.port}/predict",
+                data=json.dumps({"inputs": x}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                out = json.load(r)
+            assert len(out["classes"]) == 2
+            assert len(out["outputs"][0]) == 10
+        finally:
+            runner.stop()
+
+    def test_cli_version_env(self):
+        from click.testing import CliRunner
+
+        from fedml_tpu.cli.main import cli
+        r = CliRunner().invoke(cli, ["version"])
+        assert r.exit_code == 0 and "fedml_tpu version" in r.output
+        r = CliRunner().invoke(cli, ["env"])
+        assert r.exit_code == 0 and "jax backend" in r.output
+
+
+class TestWorkflow:
+    def test_dag_order_and_outputs(self):
+        from fedml_tpu.workflow import CallableJob, Workflow
+        wf = Workflow("t", max_workers=2)
+        a = wf.add_job(CallableJob("a", lambda: 1))
+        b = wf.add_job(CallableJob("b", lambda inp: inp["a"] + 1), [a])
+        c = wf.add_job(CallableJob("c", lambda inp: inp["b"] * 10), [b])
+        out = wf.run()
+        assert out == {"a": 1, "b": 2, "c": 20}
+
+    def test_failure_cancels_dependents(self):
+        from fedml_tpu.workflow import CallableJob, JobStatus, Workflow
+        wf = Workflow("t")
+
+        def boom():
+            raise RuntimeError("boom")
+
+        a = wf.add_job(CallableJob("a", boom))
+        b = wf.add_job(CallableJob("b", lambda inp: 1), [a])
+        with pytest.raises(RuntimeError, match="1 job"):
+            wf.run()
+        assert wf.jobs["a"].status == JobStatus.FAILED
+        assert wf.jobs["b"].status == JobStatus.CANCELLED
+
+    def test_cycle_detection(self):
+        from fedml_tpu.workflow import CallableJob, Workflow
+        wf = Workflow("t")
+        a = wf.add_job(CallableJob("a", lambda: 1))
+        b = wf.add_job(CallableJob("b", lambda: 2), [a])
+        a.dependencies = [b]  # force a cycle
+        with pytest.raises(ValueError, match="cyclic"):
+            wf.run()
+
+    def test_launch_job_in_workflow(self, runs_dir, tmp_path):
+        from fedml_tpu.workflow import CallableJob, LaunchJob, Workflow
+        job = tmp_path / "job.yaml"
+        job.write_text("job: echo wf-step-done\n")
+        wf = Workflow("launcher")
+        a = wf.add_job(LaunchJob("train", str(job)))
+        b = wf.add_job(
+            CallableJob("check",
+                        lambda inp: any("wf-step-done" in l
+                                        for l in inp["train"]["logs"])),
+            [a])
+        out = wf.run()
+        assert out["check"] is True
+
+
+class TestDiagnosis:
+    def test_diagnosis_all_ok(self):
+        from fedml_tpu.utils.diagnosis import run_diagnosis
+        report = run_diagnosis()
+        assert set(report) == {"device", "grpc", "tcp"}
+        for name, (ok, detail) in report.items():
+            assert ok, f"{name}: {detail}"
