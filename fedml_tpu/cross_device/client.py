@@ -1,0 +1,153 @@
+"""Simulated device client (Beehive device analogue).
+
+Parity target: the reference's on-device stack — Android service + MobileNN
+C++ trainers (``android/fedmlsdk/MobileNN``, ``FedMLClientManager``) driven
+over MQTT+S3 file exchange. Here a *device* is a process/thread speaking the
+same registration → train-on-file → upload-file protocol over any
+transport; its training engine is selectable:
+
+* ``jax``   — the shared jitted local-SGD loop (works for every model);
+* ``native`` — the C++ core (:mod:`fedml_tpu.native`, the MobileNN
+  analogue) for linear models, exercising a real native train path with
+  ctypes in place of JNI.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import platform
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.algframe.local_training import run_local_sgd
+from ..core.algframe.types import TrainHyper
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..serving import load_model, save_model
+from .message_define import DeviceMessage
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceClientManager(FedMLCommManager):
+    """One simulated device; ``rank`` doubles as its device id (>= 1)."""
+
+    def __init__(self, args, fed, bundle, spec, optimizer, device_id: int,
+                 comm=None, backend: str = "INPROC",
+                 engine: Optional[str] = None):
+        size = int(getattr(args, "client_num_per_round", 1)) + 1
+        super().__init__(args, comm, device_id, size, backend)
+        self.fed = fed
+        self.bundle = bundle
+        self.spec = spec
+        self.opt = optimizer
+        self.device_id = int(device_id)
+        self.engine = (engine or str(getattr(args, "device_engine", "jax"))
+                       ).lower()
+        self.cache_dir = os.path.expanduser(
+            getattr(args, "model_file_cache_dir", None)
+            or "~/.cache/fedml_tpu/device_models")
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 31),
+            self.device_id)
+        self._train_jit = None
+        self._native = None
+        if self.engine == "native":
+            from .. import native
+            if not native.available():
+                logger.warning("device %d: native core unavailable, "
+                               "falling back to jax engine", self.device_id)
+                self.engine = "jax"
+            else:
+                self._native = native.NativeLinearTrainer()
+
+    # --- FSM ---------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            DeviceMessage.MSG_TYPE_S2D_INIT, self.handle_round)
+        self.register_message_receive_handler(
+            DeviceMessage.MSG_TYPE_S2D_SYNC, self.handle_round)
+        self.register_message_receive_handler(
+            DeviceMessage.MSG_TYPE_S2D_FINISH, self.handle_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.send_register()
+        self.com_manager.handle_receive_message()
+
+    def send_register(self) -> None:
+        msg = Message(DeviceMessage.MSG_TYPE_D2S_REGISTER, self.device_id, 0)
+        msg.add_params(DeviceMessage.ARG_DEVICE_ID, self.device_id)
+        msg.add_params(DeviceMessage.ARG_DEVICE_OS, platform.system())
+        msg.add_params(DeviceMessage.ARG_DEVICE_ENGINE, self.engine)
+        self.send_message(msg)
+
+    def handle_round(self, msg: Message) -> None:
+        round_idx = int(msg.get(DeviceMessage.ARG_ROUND_IDX))
+        silo_idx = int(msg.get(DeviceMessage.ARG_DATA_SILO_IDX,
+                               self.device_id - 1))
+        params = load_model(msg.get(DeviceMessage.ARG_MODEL_FILE))
+        cdata = jax.tree_util.tree_map(
+            lambda a: a[silo_idx % self.fed.num_clients], self.fed.train)
+        if self.engine == "native":
+            new_params, n, loss = self._train_native(params, cdata,
+                                                     round_idx)
+        else:
+            new_params, n, loss = self._train_jax(params, cdata, round_idx)
+        out_path = os.path.join(
+            self.cache_dir,
+            f"device_{self.device_id}_round_{round_idx}.pkl")
+        save_model(new_params, out_path)
+        reply = Message(DeviceMessage.MSG_TYPE_D2S_MODEL, self.device_id, 0)
+        reply.add_params(DeviceMessage.ARG_DEVICE_ID, self.device_id)
+        reply.add_params(DeviceMessage.ARG_MODEL_FILE, out_path)
+        reply.add_params(DeviceMessage.ARG_NUM_SAMPLES, n)
+        reply.add_params(DeviceMessage.ARG_TRAIN_LOSS, loss)
+        self.send_message(reply)
+
+    def handle_finish(self, msg: Message) -> None:
+        logger.info("device %d finished", self.device_id)
+        self.finish()
+
+    # --- engines -----------------------------------------------------------
+    def _train_jax(self, params, cdata, round_idx: int):
+        if self._train_jit is None:
+            def impl(params, cdata, rng, hyper):
+                inner = self.opt.make_inner_opt(hyper)
+                new_params, _, metrics = run_local_sgd(
+                    self.spec, inner, params, cdata, rng, hyper,
+                    grad_transform=self.opt.grad_transform,
+                    ctx={"global_params": params, "server_state": {},
+                         "client_state": {}, "hyper": hyper})
+                return new_params, metrics
+
+            self._train_jit = jax.jit(impl)
+        hyper = TrainHyper(
+            learning_rate=jnp.float32(self.args.learning_rate),
+            epochs=int(self.args.epochs), round_idx=jnp.int32(round_idx))
+        key = jax.random.fold_in(self.rng, round_idx)
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        new_params, metrics = self._train_jit(params, cdata, key, hyper)
+        n = float(cdata.num_samples)
+        cnt = max(float(metrics["count"]), 1.0)
+        return (jax.device_get(new_params), n,
+                float(metrics["loss_sum"]) / cnt)
+
+    def _train_native(self, params, cdata, round_idx: int):
+        # flatten padded batches back to the real sample list
+        x = np.asarray(cdata.x)
+        y = np.asarray(cdata.y)
+        mask = np.asarray(cdata.mask).reshape(-1) > 0
+        x = x.reshape((-1,) + x.shape[2:])[mask]
+        y = y.reshape(-1)[mask].astype(np.int32)
+        new_params, loss = self._native.train(
+            params, x, y, epochs=int(self.args.epochs),
+            batch_size=int(self.args.batch_size),
+            lr=float(self.args.learning_rate),
+            seed=round_idx * 7919 + self.device_id)
+        return new_params, float(len(x)), loss

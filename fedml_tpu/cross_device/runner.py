@@ -1,0 +1,102 @@
+"""Cross-device runner: builds the ServerMNN-analogue side or a simulated
+device per ``args.role``, plus the in-proc session helper used by tests
+(reference ``launch_cross_device.py`` ``run_mnn_server``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+
+from ..core.algframe.client_trainer import make_trainer_spec
+from ..core.algframe.local_training import evaluate
+from ..optimizers.registry import create_optimizer
+from .client import DeviceClientManager
+from .server import DeviceAggregator, DeviceServerManager
+
+
+def _make_eval_fn(spec, fed):
+    import jax.numpy as jnp
+
+    ev = jax.jit(lambda p: evaluate(spec, jax.tree_util.tree_map(
+        jnp.asarray, p), fed.test["x"], fed.test["y"], fed.test["mask"]))
+
+    def eval_fn(params):
+        stats = ev(params)
+        n = max(float(stats["count"]), 1.0)
+        return {"test_acc": float(stats["correct"]) / n,
+                "test_loss": float(stats["loss_sum"]) / n}
+
+    return eval_fn
+
+
+def build_device_server(args, fed, bundle, backend: Optional[str] = None):
+    spec = make_trainer_spec(fed, bundle)
+    rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+    init_rng, _ = jax.random.split(rng)
+    global_params = jax.device_get(bundle.init(init_rng, fed.train.x[0, 0]))
+    aggregator = DeviceAggregator(args, global_params,
+                                  eval_fn=_make_eval_fn(spec, fed))
+    size = int(getattr(args, "client_num_per_round", 1)) + 1
+    return DeviceServerManager(args, aggregator, rank=0, size=size,
+                               backend=backend or _backend(args))
+
+
+def build_device_client(args, fed, bundle, device_id: int,
+                        backend: Optional[str] = None,
+                        engine: Optional[str] = None):
+    spec = make_trainer_spec(fed, bundle)
+    optimizer = create_optimizer(args, spec)
+    return DeviceClientManager(args, fed, bundle, spec, optimizer,
+                               device_id, backend=backend or _backend(args),
+                               engine=engine)
+
+
+def _backend(args) -> str:
+    b = str(getattr(args, "backend", "") or "").upper()
+    return b if b in ("INPROC", "TCP", "GRPC") else "TCP"
+
+
+class CrossDeviceRunner:
+    """``args.role`` == 'server' runs the MNN-server analogue; anything else
+    runs one simulated device (``args.rank`` = device id)."""
+
+    def __init__(self, args, dataset, model):
+        role = str(getattr(args, "role", "server")).lower()
+        if role == "server":
+            self.manager = build_device_server(args, dataset, model)
+        else:
+            self.manager = build_device_client(
+                args, dataset, model, max(int(getattr(args, "rank", 1)), 1))
+
+    def run(self, comm_round=None) -> Any:
+        self.manager.run()
+        return getattr(self.manager, "result", None)
+
+
+def build_cross_device_runner(args, dataset, model):
+    return CrossDeviceRunner(args, dataset, model)
+
+
+def run_cross_device_inproc(args, fed, bundle,
+                            engines: Optional[list] = None
+                            ) -> Dict[str, Any]:
+    """Server + N simulated devices as threads over the in-proc broker —
+    the cross-device 'multi-node without a cluster' test mode."""
+    from ..core.distributed.communication.inproc import InProcBroker
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    n = int(getattr(args, "client_num_per_round", 2))
+    server = build_device_server(args, fed, bundle, backend="INPROC")
+    engines = engines or [None] * n
+    devices = [build_device_client(args, fed, bundle, device_id=i + 1,
+                                   backend="INPROC", engine=engines[i])
+               for i in range(n)]
+    threads = [threading.Thread(target=d.run, daemon=True) for d in devices]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30.0)
+    return server.result

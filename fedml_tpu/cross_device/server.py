@@ -1,0 +1,176 @@
+"""Cross-device FL server (Beehive analogue).
+
+Parity target: reference ``cross_device/server_mnn/fedml_server_manager.py:14``
+(device ONLINE handshake, start-train broadcast, model-file collection,
+FINISH) and ``fedml_aggregator.py:17,63`` (reads each device's uploaded
+model file, weighted-averages, evaluates the global model server-side).
+
+TPU-native redesign: the *server* is a JAX host — aggregation is a jitted
+weighted tree-average and evaluation a jitted batched forward, while the
+device side stays file-based (devices upload params artifacts; the wire
+message carries the artifact path + sample count). Transport is any
+``FedMLCommManager`` backend (in-proc for tests, TCP/gRPC across a LAN/WAN).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..core import mlops
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..serving import load_model, save_model
+from .message_define import DeviceMessage
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceAggregator:
+    """Server state: device model files -> weighted average -> eval
+    (reference ``fedml_aggregator.py:63`` reads MNN files and averages)."""
+
+    def __init__(self, args, global_params, eval_fn=None):
+        self.args = args
+        self.global_params = global_params
+        self.eval_fn = eval_fn
+        self.client_num = int(getattr(args, "client_num_per_round", 1))
+        self.model_files: Dict[int, str] = {}
+        self.sample_nums: Dict[int, float] = {}
+
+    def add_device_result(self, device_id: int, model_file: str,
+                          num_samples: float) -> None:
+        self.model_files[device_id] = model_file
+        self.sample_nums[device_id] = float(num_samples)
+
+    def all_received(self) -> bool:
+        return len(self.model_files) >= self.client_num
+
+    def aggregate(self):
+        total = sum(self.sample_nums.values()) or 1.0
+        acc = None
+        for did, path in sorted(self.model_files.items()):
+            params = load_model(path)
+            w = self.sample_nums[did] / total
+            scaled = jax.tree_util.tree_map(
+                lambda a: np.asarray(a, np.float32) * w, params)
+            acc = scaled if acc is None else jax.tree_util.tree_map(
+                np.add, acc, scaled)
+        self.global_params = jax.tree_util.tree_map(
+            lambda a: np.asarray(a, np.float32), acc)
+        self.model_files.clear()
+        self.sample_nums.clear()
+        return self.global_params
+
+    def test_on_server(self) -> Optional[Dict[str, float]]:
+        if self.eval_fn is None:
+            return None
+        return self.eval_fn(self.global_params)
+
+
+class DeviceServerManager(FedMLCommManager):
+    """Rank 0; devices register with their own ids (ranks 1..N)."""
+
+    def __init__(self, args, aggregator: DeviceAggregator, comm=None,
+                 rank: int = 0, size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.expected_devices = int(getattr(args, "client_num_per_round", 1))
+        self.devices_online: Dict[int, Dict] = {}
+        self.is_initialized = False
+        self.cache_dir = os.path.expanduser(
+            getattr(args, "model_file_cache_dir", None)
+            or "~/.cache/fedml_tpu/device_models")
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.history = []
+        self.result: Optional[dict] = None
+        self._lock = threading.Lock()
+
+    # --- FSM ---------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            DeviceMessage.MSG_TYPE_D2S_REGISTER, self.handle_register)
+        self.register_message_receive_handler(
+            DeviceMessage.MSG_TYPE_D2S_MODEL, self.handle_device_model)
+
+    def handle_register(self, msg: Message) -> None:
+        did = int(msg.get(DeviceMessage.ARG_DEVICE_ID))
+        self.devices_online[did] = {
+            "os": msg.get(DeviceMessage.ARG_DEVICE_OS, "?"),
+            "engine": msg.get(DeviceMessage.ARG_DEVICE_ENGINE, "?"),
+        }
+        logger.info("server: device %d online (%s/%s), %d/%d", did,
+                    self.devices_online[did]["os"],
+                    self.devices_online[did]["engine"],
+                    len(self.devices_online), self.expected_devices)
+        if (len(self.devices_online) >= self.expected_devices
+                and not self.is_initialized):
+            self.is_initialized = True
+            mlops.log_aggregation_status("RUNNING")
+            self._dispatch_round(DeviceMessage.MSG_TYPE_S2D_INIT)
+
+    def _global_model_file(self) -> str:
+        path = os.path.join(self.cache_dir,
+                            f"global_round_{self.round_idx}.pkl")
+        save_model(self.aggregator.global_params, path)
+        return path
+
+    def _dispatch_round(self, msg_type: str) -> None:
+        """Write the global artifact once, point every device at it
+        (reference start_train JSON with the global model S3 path)."""
+        path = self._global_model_file()
+        n_total = int(getattr(self.args, "client_num_in_total",
+                              self.expected_devices))
+        rs = np.random.RandomState(1000 + self.round_idx)
+        silos = (np.arange(len(self.devices_online))
+                 if n_total <= len(self.devices_online)
+                 else rs.choice(n_total, len(self.devices_online),
+                                replace=False))
+        for i, did in enumerate(sorted(self.devices_online)):
+            msg = Message(msg_type, self.rank, did)
+            msg.add_params(DeviceMessage.ARG_MODEL_FILE, path)
+            msg.add_params(DeviceMessage.ARG_ROUND_IDX, self.round_idx)
+            msg.add_params(DeviceMessage.ARG_DATA_SILO_IDX, int(silos[i]))
+            self.send_message(msg)
+
+    def handle_device_model(self, msg: Message) -> None:
+        did = int(msg.get(DeviceMessage.ARG_DEVICE_ID))
+        with self._lock:
+            self.aggregator.add_device_result(
+                did, msg.get(DeviceMessage.ARG_MODEL_FILE),
+                float(msg.get(DeviceMessage.ARG_NUM_SAMPLES, 1.0)))
+            if not self.aggregator.all_received():
+                return
+            self.aggregator.aggregate()
+        stats = self.aggregator.test_on_server()
+        rec = {"round": self.round_idx}
+        if stats:
+            rec.update(stats)
+            logger.info("server round %d: %s", self.round_idx, stats)
+        self.history.append(rec)
+        mlops.log_round_info(self.round_num, self.round_idx)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            self.finish_session()
+            return
+        self._dispatch_round(DeviceMessage.MSG_TYPE_S2D_SYNC)
+
+    def finish_session(self) -> None:
+        for did in sorted(self.devices_online):
+            self.send_message(Message(DeviceMessage.MSG_TYPE_S2D_FINISH,
+                                      self.rank, did))
+        last_eval = next((r for r in reversed(self.history)
+                          if "test_acc" in r), {})
+        self.result = {"params": self.aggregator.global_params,
+                       "history": self.history,
+                       "final_test_acc": last_eval.get("test_acc"),
+                       "rounds": self.round_num}
+        mlops.log_aggregation_status("FINISHED")
+        self.finish()

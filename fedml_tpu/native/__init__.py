@@ -1,0 +1,177 @@
+"""Native device core bindings (MobileNN analogue).
+
+The reference ships a C++ on-device SDK (``android/fedmlsdk/MobileNN``:
+``FedMLBaseTrainer`` + MNN/torch engines + native LightSecAgg,
+``src/security/LightSecAgg.cpp``) bridged to the app through JNI. Here the
+native core is :mod:`mobilenn.cpp` (softmax-regression SGD + GF(2^31-1)
+masking) compiled on demand with ``g++`` and bridged through ``ctypes`` —
+the JNI analogue for a Python host. The simulated device client
+(:mod:`fedml_tpu.cross_device.client`) selects it with
+``device_engine: native``.
+
+``available()`` is False when no toolchain/binary exists; callers fall back
+to the JAX engine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "mobilenn.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+PRIME = 2147483647  # 2^31 - 1, matches core/mpc/field_ops.py
+
+
+def _cache_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    root = os.path.expanduser(os.environ.get(
+        "FEDML_TPU_NATIVE_DIR", "~/.cache/fedml_tpu/native"))
+    return os.path.join(root, f"libmobilenn-{digest}.so")
+
+
+def _build() -> Optional[str]:
+    so = _cache_path()
+    if os.path.exists(so):
+        return so
+    os.makedirs(os.path.dirname(so), exist_ok=True)
+    tmp = so + ".tmp.so"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        logger.warning("native build failed (%s): %s", e,
+                       detail.decode(errors="replace")[:500])
+        return None
+    os.replace(tmp, so)
+    logger.info("built native core -> %s", so)
+    return so
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.train_linear_sgd.restype = ctypes.c_float
+        lib.train_linear_sgd.argtypes = [
+            f32p, f32p, f32p, i32p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_float,
+            ctypes.c_uint64]
+        lib.eval_linear.restype = ctypes.c_float
+        lib.eval_linear.argtypes = [f32p, f32p, f32p, i32p, ctypes.c_int32,
+                                    ctypes.c_int32, ctypes.c_int32]
+        lib.gen_mask.restype = None
+        lib.gen_mask.argtypes = [u32p, ctypes.c_int64, ctypes.c_uint64]
+        lib.mask_vector.restype = None
+        lib.mask_vector.argtypes = [u32p, f32p, ctypes.c_int64,
+                                    ctypes.c_float, ctypes.c_uint64]
+        lib.unmask_vector.restype = None
+        lib.unmask_vector.argtypes = [f32p, u32p, ctypes.c_int64,
+                                      ctypes.c_float, ctypes.c_uint64]
+        lib.mobilenn_abi_version.restype = ctypes.c_int32
+        lib.mobilenn_abi_version.argtypes = []
+        assert lib.mobilenn_abi_version() == 1
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+class NativeLinearTrainer:
+    """Device-side trainer over the native core. Param layout matches the
+    flax ``LogisticRegression`` bundle ({'Dense_0': {'kernel','bias'}}), so
+    the server aggregates native and JAX device updates interchangeably."""
+
+    def __init__(self):
+        self.lib = _load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable (no g++?)")
+
+    def train(self, params: Dict, x: np.ndarray, y: np.ndarray,
+              epochs: int, batch_size: int, lr: float, seed: int):
+        dense = params["Dense_0"]
+        W = np.ascontiguousarray(np.asarray(dense["kernel"], np.float32))
+        b = np.ascontiguousarray(np.asarray(dense["bias"], np.float32))
+        x2 = np.ascontiguousarray(x.reshape(len(x), -1).astype(np.float32))
+        y2 = np.ascontiguousarray(np.asarray(y, np.int32))
+        d, k = W.shape
+        loss = self.lib.train_linear_sgd(
+            _f32p(W), _f32p(b), _f32p(x2), _i32p(y2),
+            np.int32(len(x2)), np.int32(d), np.int32(k),
+            np.int32(epochs), np.int32(batch_size), np.float32(lr),
+            np.uint64(seed))
+        return {"Dense_0": {"kernel": W, "bias": b}}, float(loss)
+
+    def evaluate(self, params: Dict, x: np.ndarray, y: np.ndarray) -> float:
+        dense = params["Dense_0"]
+        W = np.ascontiguousarray(np.asarray(dense["kernel"], np.float32))
+        b = np.ascontiguousarray(np.asarray(dense["bias"], np.float32))
+        x2 = np.ascontiguousarray(x.reshape(len(x), -1).astype(np.float32))
+        y2 = np.ascontiguousarray(np.asarray(y, np.int32))
+        d, k = W.shape
+        return float(self.lib.eval_linear(
+            _f32p(W), _f32p(b), _f32p(x2), _i32p(y2),
+            np.int32(len(x2)), np.int32(d), np.int32(k)))
+
+
+def gen_mask(n: int, seed: int) -> np.ndarray:
+    lib = _load()
+    out = np.empty(n, np.uint32)
+    lib.gen_mask(_u32p(out), np.int64(n), np.uint64(seed))
+    return out
+
+
+def mask_vector(v: np.ndarray, scale: float, seed: int) -> np.ndarray:
+    lib = _load()
+    v = np.ascontiguousarray(v, np.float32)
+    out = np.empty(v.size, np.uint32)
+    lib.mask_vector(_u32p(out), _f32p(v), np.int64(v.size),
+                    np.float32(scale), np.uint64(seed))
+    return out
+
+
+def unmask_vector(masked: np.ndarray, scale: float, seed: int) -> np.ndarray:
+    lib = _load()
+    masked = np.ascontiguousarray(masked, np.uint32)
+    out = np.empty(masked.size, np.float32)
+    lib.unmask_vector(_f32p(out), _u32p(masked), np.int64(masked.size),
+                      np.float32(scale), np.uint64(seed))
+    return out

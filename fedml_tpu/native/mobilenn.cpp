@@ -1,0 +1,165 @@
+// Native on-device training core — the MobileNN analogue.
+//
+// Parity target: the reference's C++ device SDK
+// (android/fedmlsdk/MobileNN: FedMLBaseTrainer + MNN/torch engine
+// implementations, ~2.6k LoC C++) and its native secure-aggregation masking
+// (MobileNN/src/security/LightSecAgg.cpp). Devices in that stack train a
+// small model locally in native code and exchange *masked* updates.
+//
+// This is a fresh implementation sized to what a TPU-federated deployment
+// actually needs on-device: a softmax-regression SGD trainer (the
+// cross-device reference workload is LR/LeNet-class models) and
+// finite-field masking over GF(p), p = 2^31 - 1 — the same field the
+// Python SecAgg math uses (core/mpc/field_ops.py), so natively-masked
+// updates unmask server-side with the existing Python pipeline.
+//
+// Deterministic by construction: shuffling and mask generation use
+// explicit splitmix64 streams seeded by the caller, so device results are
+// reproducible across runs and platforms.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <vector>
+
+namespace {
+
+// splitmix64: tiny, high-quality, seedable PRG (public-domain algorithm)
+inline uint64_t splitmix64(uint64_t& state) {
+  uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+constexpr uint64_t kPrime = 2147483647ULL;  // 2^31 - 1 (Mersenne)
+
+}  // namespace
+
+extern "C" {
+
+// Softmax-regression SGD: logits = x·W + b, cross-entropy loss, plain SGD.
+// x: [n, d] row-major, y: [n] labels in [0, k). W: [d, k], b: [k] updated
+// in place. Runs `epochs` passes over batches of `batch` with per-epoch
+// Fisher-Yates shuffling from `seed`. Returns mean loss of the LAST epoch.
+float train_linear_sgd(float* W, float* b, const float* x, const int32_t* y,
+                       int32_t n, int32_t d, int32_t k, int32_t epochs,
+                       int32_t batch, float lr, uint64_t seed) {
+  if (n <= 0 || d <= 0 || k <= 0 || batch <= 0) return -1.0f;
+  std::vector<int32_t> order(n);
+  for (int32_t i = 0; i < n; ++i) order[i] = i;
+  std::vector<float> logits(k), probs(k);
+  std::vector<float> gW(static_cast<size_t>(d) * k), gb(k);
+  float last_epoch_loss = 0.0f;
+
+  for (int32_t e = 0; e < epochs; ++e) {
+    uint64_t rng = seed + static_cast<uint64_t>(e) * 0x51ED2701ULL;
+    for (int32_t i = n - 1; i > 0; --i) {  // Fisher-Yates
+      int32_t j = static_cast<int32_t>(splitmix64(rng) % (i + 1));
+      int32_t t = order[i]; order[i] = order[j]; order[j] = t;
+    }
+    float epoch_loss = 0.0f;
+    int32_t seen = 0;
+    for (int32_t start = 0; start < n; start += batch) {
+      int32_t bs = (start + batch <= n) ? batch : (n - start);
+      std::memset(gW.data(), 0, gW.size() * sizeof(float));
+      std::memset(gb.data(), 0, gb.size() * sizeof(float));
+      for (int32_t bi = 0; bi < bs; ++bi) {
+        const float* xi = x + static_cast<size_t>(order[start + bi]) * d;
+        int32_t yi = y[order[start + bi]];
+        // forward
+        float maxl = -1e30f;
+        for (int32_t c = 0; c < k; ++c) {
+          float acc = b[c];
+          for (int32_t f = 0; f < d; ++f) acc += xi[f] * W[f * k + c];
+          logits[c] = acc;
+          if (acc > maxl) maxl = acc;
+        }
+        float denom = 0.0f;
+        for (int32_t c = 0; c < k; ++c) {
+          probs[c] = std::exp(logits[c] - maxl);
+          denom += probs[c];
+        }
+        for (int32_t c = 0; c < k; ++c) probs[c] /= denom;
+        epoch_loss += -std::log(probs[yi] > 1e-12f ? probs[yi] : 1e-12f);
+        ++seen;
+        // backward: dlogit = probs - onehot(y)
+        for (int32_t c = 0; c < k; ++c) {
+          float dl = probs[c] - (c == yi ? 1.0f : 0.0f);
+          gb[c] += dl;
+          for (int32_t f = 0; f < d; ++f) gW[f * k + c] += xi[f] * dl;
+        }
+      }
+      const float scale = lr / static_cast<float>(bs);
+      for (size_t idx = 0; idx < gW.size(); ++idx) W[idx] -= scale * gW[idx];
+      for (int32_t c = 0; c < k; ++c) b[c] -= scale * gb[c];
+    }
+    last_epoch_loss = seen ? epoch_loss / seen : 0.0f;
+  }
+  return last_epoch_loss;
+}
+
+// Accuracy of the current W, b on (x, y) — the device-side eval hook.
+float eval_linear(const float* W, const float* b, const float* x,
+                  const int32_t* y, int32_t n, int32_t d, int32_t k) {
+  if (n <= 0) return 0.0f;
+  int32_t correct = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    const float* xi = x + static_cast<size_t>(i) * d;
+    int32_t best = 0;
+    float bestv = -1e30f;
+    for (int32_t c = 0; c < k; ++c) {
+      float acc = b[c];
+      for (int32_t f = 0; f < d; ++f) acc += xi[f] * W[f * k + c];
+      if (acc > bestv) { bestv = acc; best = c; }
+    }
+    if (best == y[i]) ++correct;
+  }
+  return static_cast<float>(correct) / static_cast<float>(n);
+}
+
+// Generate a PRG mask stream over GF(2^31-1) from `seed` (LightSecAgg
+// device-side primitive; server unmasks with the Python field ops).
+void gen_mask(uint32_t* out, int64_t n, uint64_t seed) {
+  uint64_t rng = seed;
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = static_cast<uint32_t>(splitmix64(rng) % kPrime);
+}
+
+// Quantize float vector v into the field (fixed-point, `scale` ticks per
+// unit, offset so negatives map into the field) and add the PRG mask from
+// `seed`: out[i] = (q(v[i]) + mask[i]) mod p.
+void mask_vector(uint32_t* out, const float* v, int64_t n, float scale,
+                 uint64_t seed) {
+  uint64_t rng = seed;
+  const int64_t half = static_cast<int64_t>(kPrime / 2);
+  for (int64_t i = 0; i < n; ++i) {
+    double q = std::llround(static_cast<double>(v[i]) * scale);
+    int64_t qi = static_cast<int64_t>(q);
+    // clamp into (-p/2, p/2) then shift into [0, p)
+    if (qi > half - 1) qi = half - 1;
+    if (qi < -half) qi = -half;
+    uint64_t f = static_cast<uint64_t>(qi + half);
+    uint64_t m = splitmix64(rng) % kPrime;
+    out[i] = static_cast<uint32_t>((f + m) % kPrime);
+  }
+}
+
+// Remove the PRG mask and de-quantize: the server-side inverse of
+// mask_vector for a SINGLE device (aggregate unmasking sums masked vectors
+// and subtracts the sum of masks — done by the Python pipeline; this
+// single-vector form is used in tests and point-to-point checks).
+void unmask_vector(float* out, const uint32_t* masked, int64_t n,
+                   float scale, uint64_t seed) {
+  uint64_t rng = seed;
+  const int64_t half = static_cast<int64_t>(kPrime / 2);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t m = splitmix64(rng) % kPrime;
+    uint64_t f = (static_cast<uint64_t>(masked[i]) + kPrime - m) % kPrime;
+    out[i] = static_cast<float>(static_cast<int64_t>(f) - half) / scale;
+  }
+}
+
+int32_t mobilenn_abi_version() { return 1; }
+
+}  // extern "C"
