@@ -1,0 +1,8 @@
+"""Hierarchical cross-silo (Octopus hierarchical / Cheetah analogue):
+silo-internal data parallelism over an inner ``data``-axis mesh, WAN FSM
+unchanged. See :mod:`.trainer` for the DDP-collapse design note and
+:mod:`.process_group` for multi-host silos."""
+
+from .process_group import init_silo_process_group  # noqa: F401
+from .runner import run_hierarchical_cross_silo_inproc  # noqa: F401
+from .trainer import HierarchicalSiloTrainer  # noqa: F401

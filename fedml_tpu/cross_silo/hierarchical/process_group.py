@@ -1,0 +1,53 @@
+"""Multi-host silo bootstrap (reference ``process_group_manager.py:8``).
+
+The reference's hierarchical silo spawns torchrun-style worker processes
+and builds a torch ``ProcessGroup`` from RANK/WORLD_SIZE/MASTER_ADDR env
+vars (``__init__.py:354-365``). The JAX equivalent is
+``jax.distributed.initialize``: every host of a silo runs the SAME program;
+after initialization ``jax.devices()`` spans the silo and the jitted
+silo step (:mod:`.trainer`) is automatically SPMD across hosts — there is
+no slave event loop to write.
+
+Env contract (torchrun-compatible names so reference launch scripts port):
+``MASTER_ADDR``/``MASTER_PORT`` → coordinator, ``WORLD_SIZE`` → number of
+silo hosts, ``RANK`` → this host's index.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def init_silo_process_group(coordinator: Optional[str] = None,
+                            num_hosts: Optional[int] = None,
+                            host_rank: Optional[int] = None) -> bool:
+    """Join this host to the silo's JAX distributed runtime. No-op (False)
+    when single-host (WORLD_SIZE absent or 1)."""
+    global _initialized
+    if _initialized:
+        return True
+    num_hosts = int(num_hosts
+                    if num_hosts is not None
+                    else os.environ.get("WORLD_SIZE", "1"))
+    if num_hosts <= 1:
+        return False
+    host_rank = int(host_rank
+                    if host_rank is not None
+                    else os.environ.get("RANK", "0"))
+    coordinator = coordinator or (
+        os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" +
+        os.environ.get("MASTER_PORT", "29500"))
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_hosts,
+                               process_id=host_rank)
+    _initialized = True
+    logger.info("silo process group up: host %d/%d via %s", host_rank,
+                num_hosts, coordinator)
+    return True

@@ -6,6 +6,11 @@ from __future__ import annotations
 
 def build_cross_silo_runner(args, dataset, model, client_trainer=None,
                             server_aggregator=None):
+    scenario = str(getattr(args, "scenario", "horizontal")).lower()
+    if scenario == "hierarchical":
+        from .hierarchical.runner import HierarchicalCrossSiloRunner
+        return HierarchicalCrossSiloRunner(args, dataset, model,
+                                           client_trainer, server_aggregator)
     from .horizontal.runner import CrossSiloRunner
     return CrossSiloRunner(args, dataset, model, client_trainer,
                            server_aggregator)
