@@ -47,7 +47,10 @@ def _synthetic_allowed(args, raw_name: str) -> bool:
 
 
 def _synthetic_fallback(args, raw_name: str, name: str):
-    """Gate + loud warning for substituting generated data for a real task."""
+    """Gate + loud warning for substituting generated data for a real task.
+    Explicitly-synthetic names are fine and silent."""
+    if raw_name.startswith("synthetic"):
+        return
     if not _synthetic_allowed(args, raw_name):
         raise DatasetUnavailableError(
             f"dataset {name!r} is not cached under "
@@ -208,6 +211,19 @@ def load(args) -> Tuple[FederatedDataset, int]:
         fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
                                   n_classes, method, alpha, seed)
         fed.provenance = provenance
+        return fed, n_classes
+    if name in ("pascal_voc", "coco_seg", "seg", "segmentation"):
+        # dense-labeling task for FedSeg (reference data/pascal_voc etc.)
+        if not raw_name.startswith("synthetic") and name not in ("seg",
+                                                                 "segmentation"):
+            _synthetic_fallback(args, raw_name, name)
+        n_classes = 3
+        (xtr, ytr), (xte, yte) = synthetic.synthetic_segmentation(
+            n_train=max(num_clients * 2 * bs, 400), seed=seed)
+        fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
+                                  n_classes, "homo", alpha, seed,
+                                  task="segmentation")
+        fed.provenance = "synthetic"
         return fed, n_classes
     if name in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp",
                 "sequences", "reddit"):

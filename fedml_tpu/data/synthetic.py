@@ -128,3 +128,25 @@ def synthetic_sequences(n_train: int = 2000, n_test: int = 400,
     x = seqs[:, :-1]
     y = seqs[:, 1:]
     return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def synthetic_segmentation(n_train: int = 400, n_test: int = 80,
+                           size: int = 16, n_classes: int = 3,
+                           seed: int = 0):
+    """Dense-labeling stand-in for FedSeg: each image contains an axis-
+    aligned rectangle of a random foreground class on background class 0;
+    the label map is per-pixel. Learnable by a small encoder-decoder."""
+    rs = np.random.RandomState(seed)
+    n = n_train + n_test
+    x = rs.rand(n, size, size, 3).astype(np.float32) * 0.3
+    y = np.zeros((n, size, size), np.int64)
+    for i in range(n):
+        c = rs.randint(1, n_classes)
+        h0, w0 = rs.randint(0, size // 2, 2)
+        h1 = h0 + rs.randint(3, size // 2)
+        w1 = w0 + rs.randint(3, size // 2)
+        x[i, h0:h1, w0:w1, :] += np.asarray(
+            [0.8 if ch == (c - 1) % 3 else 0.1 for ch in range(3)],
+            np.float32)
+        y[i, h0:h1, w0:w1] = c
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])

@@ -57,9 +57,24 @@ class FedMLRunner:
         from .core.algframe.client_trainer import make_trainer_spec
         from .optimizers.registry import create_optimizer
         fed, bundle = self.dataset, self.model
+        fo = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        # protocols with their own model/loss stacks dispatch before the
+        # TrainerSpec is built (segmentation/GAN/NAS/GKT tasks have no
+        # classification spec)
+        if fo == "fedgkt":
+            from .simulation.sp.fedgkt import FedGKTSimulator
+            return FedGKTSimulator(args, fed)
+        if fo == "fednas":
+            from .simulation.sp.fednas import FedNASSimulator
+            return FedNASSimulator(args, fed)
+        if fo == "fedseg" or fed.task == "segmentation":
+            from .simulation.sp.fedseg import FedSegSimulator
+            return FedSegSimulator(args, fed)
+        if fo == "fedgan" or isinstance(bundle, tuple):
+            from .simulation.sp.fedgan import FedGANSimulator
+            return FedGANSimulator(args, fed, bundle)
         spec = (self.client_trainer if self.client_trainer is not None
                 else make_trainer_spec(fed, bundle))
-        fo = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
         # protocol-level optimizers get dedicated simulators (reference
         # simulator.py:27-216 dispatches these to their own API stacks)
         if fo == "hierarchicalfl":
@@ -80,10 +95,10 @@ class FedMLRunner:
         if fo in ("classical_vertical", "vertical_fl", "vfl"):
             from .simulation.sp.vertical_fl import VerticalFLSimulator
             return VerticalFLSimulator(args, fed, bundle)
-        if fo == "fedgan" or isinstance(bundle, tuple):
-            raise NotImplementedError(
-                "FedGAN training is not implemented yet; the gan model pair "
-                "(model='gan') is available for custom trainers only")
+        if fo in ("turbo_aggregate", "turboaggregate"):
+            from .simulation.sp.turbo_aggregate import TurboAggregateSimulator
+            inner = _with_fedavg(args, create_optimizer, spec)
+            return TurboAggregateSimulator(args, fed, bundle, inner, spec)
         opt = create_optimizer(args, spec)
         backend = getattr(args, "backend", FEDML_SIMULATION_TYPE_TPU)
         if backend == FEDML_SIMULATION_TYPE_SP:

@@ -1,0 +1,220 @@
+"""FedGKT — Group Knowledge Transfer.
+
+Parity target: reference ``simulation/mpi/fedgkt/`` (GKTTrainer/GKTServer:
+edge devices train a small feature extractor + local classifier; they ship
+extracted FEATURES + LOGITS + labels to the server; the server trains a
+large head on those features with CE + KL-distillation from client logits,
+then ships its own per-sample logits back; clients distill from the server
+logits next round). Model exchange never happens — the protocol's payload
+is the feature/logit tensors, which is what makes it fit memory-poor edges.
+
+TPU-native design: client epoch and server epoch are each one jitted scan;
+the feature tensors cross as stacked arrays (the S3 payload analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+logger = logging.getLogger(__name__)
+
+
+class _EdgeNet(nn.Module):
+    """Small client model: feature extractor + auxiliary classifier."""
+    feat_dim: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        h = nn.relu(nn.Dense(self.feat_dim)(x))
+        logits = nn.Dense(self.num_classes)(h)
+        return h, logits
+
+
+class _ServerHead(nn.Module):
+    """Larger server model consuming client features."""
+    num_classes: int
+    hidden: int = 256
+
+    @nn.compact
+    def __call__(self, h, train: bool = False):
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        return nn.Dense(self.num_classes)(h)
+
+
+def _masked_ce(logits, y, mask):
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+    return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def _masked_kl(student_logits, teacher_logits, mask, temp: float):
+    t = jax.nn.softmax(teacher_logits / temp)
+    s = jax.nn.log_softmax(student_logits / temp)
+    kl = jnp.sum(t * (jnp.log(jnp.maximum(t, 1e-9)) - s), axis=-1)
+    return jnp.sum(kl * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class FedGKTSimulator:
+    def __init__(self, args, fed_dataset, bundle=None, optimizer=None,
+                 spec=None):
+        self.args = args
+        self.fed = fed_dataset
+        self.temp = float(getattr(args, "gkt_temperature", 3.0) or 3.0)
+        self.alpha = float(getattr(args, "gkt_kd_alpha", 1.0) or 1.0)
+        self.feat_dim = int(getattr(args, "gkt_feat_dim", 64) or 64)
+        k = fed_dataset.num_classes
+        self.edge = _EdgeNet(self.feat_dim, k)
+        self.head = _ServerHead(k)
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        ke, kh, self.rng = jax.random.split(rng, 3)
+        sample = fed_dataset.train.x[0, 0]
+        self.edge_params = [
+            self.edge.init(jax.random.fold_in(ke, c), sample)["params"]
+            for c in range(fed_dataset.num_clients)]
+        h0 = jnp.zeros((2, self.feat_dim), jnp.float32)
+        self.head_params = self.head.init(kh, h0)["params"]
+        # CE+KL on raw features diverges at classification lr defaults;
+        # the protocol carries its own tuned rate (reference uses per-
+        # protocol optimizer configs in fedgkt/GKTTrainer)
+        self.lr = float(getattr(args, "gkt_lr", 0.01) or 0.01)
+        self._client_epoch = jax.jit(self._client_epoch_impl)
+        self._server_epoch = jax.jit(self._server_epoch_impl)
+        self._extract = jax.jit(self._extract_impl)
+        self.history: List[Dict[str, Any]] = []
+
+    # --- client side --------------------------------------------------------
+    def _client_epoch_impl(self, params, cdata, server_logits, use_kd):
+        opt = optax.sgd(self.lr, momentum=0.9)
+        state = opt.init(params)
+
+        def step(carry, inp):
+            params, state = carry
+            x, y, mask, slog = inp
+
+            def loss_fn(p):
+                _, logits = self.edge.apply({"params": p}, x)
+                ce = _masked_ce(logits, y, mask)
+                kd = _masked_kl(logits, slog, mask, self.temp)
+                return ce + self.alpha * use_kd * kd
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            up, state = opt.update(grads, state, params)
+            return (optax.apply_updates(params, up), state), loss
+
+        (params, _), losses = jax.lax.scan(
+            step, (params, state),
+            (cdata.x, cdata.y, cdata.mask, server_logits))
+        return params, jnp.mean(losses)
+
+    def _extract_impl(self, params, cdata):
+        def body(_, inp):
+            x, _y = inp
+            h, logits = self.edge.apply({"params": params}, x)
+            return None, (h, logits)
+
+        _, (feats, logits) = jax.lax.scan(body, None, (cdata.x, cdata.y))
+        return feats, logits
+
+    # --- server side --------------------------------------------------------
+    def _server_epoch_impl(self, head_params, feats, logits, ys, masks):
+        opt = optax.sgd(self.lr, momentum=0.9)
+        state = opt.init(head_params)
+
+        def step(carry, inp):
+            params, state = carry
+            h, clog, y, mask = inp
+
+            def loss_fn(p):
+                slog = self.head.apply({"params": p}, h)
+                return (_masked_ce(slog, y, mask)
+                        + self.alpha * _masked_kl(slog, clog, mask,
+                                                  self.temp))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            up, state = opt.update(grads, state, params)
+            return (optax.apply_updates(params, up), state), loss
+
+        (head_params, _), losses = jax.lax.scan(
+            step, (head_params, state), (feats, logits, ys, masks))
+
+        def back(_, inp):
+            h, _ = inp
+            return None, self.head.apply({"params": head_params}, h)
+
+        _, server_logits = jax.lax.scan(back, None, (feats, logits))
+        return head_params, server_logits, jnp.mean(losses)
+
+    # --- evaluation: edge features -> server head ---------------------------
+    def _evaluate(self) -> float:
+        correct = total = 0.0
+        test = self.fed.test
+        # evaluate with client 0's extractor (reference evaluates the
+        # server model fed by the edge extractor)
+        p = self.edge_params[0]
+        for i in range(test["x"].shape[0]):
+            h, _ = self.edge.apply({"params": p}, test["x"][i])
+            slog = self.head.apply({"params": self.head_params}, h)
+            pred = jnp.argmax(slog, -1)
+            m = test["mask"][i]
+            correct += float(jnp.sum((pred == test["y"][i]) * m))
+            total += float(jnp.sum(m))
+        return correct / max(total, 1.0)
+
+    def run(self, comm_round=None) -> Dict[str, Any]:
+        rounds = int(comm_round if comm_round is not None
+                     else self.args.comm_round)
+        n_clients = self.fed.num_clients
+        t0 = time.time()
+        # per-client cached server logits (zeros -> KD off in round 0)
+        nb, bs = self.fed.train.x.shape[1], self.fed.train.x.shape[2]
+        k = self.fed.num_classes
+        server_logits = [jnp.zeros((nb, bs, k), jnp.float32)
+                         for _ in range(n_clients)]
+        for r in range(rounds):
+            use_kd = jnp.float32(0.0 if r == 0 else 1.0)
+            feats_all, logits_all, ys, masks = [], [], [], []
+            losses = []
+            for c in range(n_clients):
+                cdata = jax.tree_util.tree_map(lambda a: a[c],
+                                               self.fed.train)
+                self.edge_params[c], loss = self._client_epoch(
+                    self.edge_params[c], cdata, server_logits[c], use_kd)
+                losses.append(float(loss))
+                f, lg = self._extract(self.edge_params[c], cdata)
+                feats_all.append(f)
+                logits_all.append(lg)
+                ys.append(cdata.y)
+                masks.append(cdata.mask)
+            # server trains on the concatenated feature stream
+            feats = jnp.concatenate(feats_all)
+            logits = jnp.concatenate(logits_all)
+            y = jnp.concatenate(ys)
+            mask = jnp.concatenate(masks)
+            self.head_params, slog, sloss = self._server_epoch(
+                self.head_params, feats, logits, y, mask)
+            # route the server logits back per client
+            off = 0
+            for c in range(n_clients):
+                n_b = feats_all[c].shape[0]
+                server_logits[c] = slog[off:off + n_b]
+                off += n_b
+            acc = self._evaluate()
+            rec = {"round": r, "client_loss": float(np.mean(losses)),
+                   "server_loss": float(sloss), "test_acc": acc}
+            logger.info("fedgkt round %d: %s", r, rec)
+            self.history.append(rec)
+        return {"params": self.head_params,
+                "edge_params": self.edge_params,
+                "history": self.history,
+                "final_test_acc": self.history[-1]["test_acc"],
+                "wall_time_s": time.time() - t0, "rounds": rounds}

@@ -1,0 +1,165 @@
+"""TurboAggregate — group-ring secure aggregation protocol.
+
+Parity target: reference ``simulation/mpi/fedavg_robust``-adjacent
+``turboaggregate`` stack (``simulation/sp/turboaggregate`` in the optimizer
+list): clients are partitioned into L groups arranged in a ring; group l
+adds its (masked) partial sum onto the running aggregate received from
+group l-1 and forwards it — aggregation cost grows O(N log N) instead of
+the star topology's O(N^2) masking pairs.
+
+TPU-native design: the additive masking rides the same GF(2^31-1)
+fixed-point field as the SecAgg stack (``core/mpc``); each group's members
+mask their quantized updates with pairwise-cancelling PRG streams INSIDE
+the group, so the forwarded partial sums never expose an individual
+update, and the final ring output de-quantizes to exactly the FedAvg
+aggregate (asserted against the plain weighted average in tests).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.algframe.types import TrainHyper
+from ...core.algframe.local_training import evaluate
+from ...core.collectives import (tree_flatten_to_vector,
+                                 vector_to_tree_like)
+
+logger = logging.getLogger(__name__)
+
+PRIME = np.uint64(2147483647)  # 2^31 - 1, shared with core/mpc/field_ops
+
+
+def _quantize(v: np.ndarray, scale: float) -> np.ndarray:
+    half = np.int64(int(PRIME) // 2)
+    q = np.clip(np.rint(v.astype(np.float64) * scale), -half, half - 1)
+    return ((q + half) % np.int64(int(PRIME))).astype(np.uint64)
+
+
+def _dequantize_sum(f: np.ndarray, n_terms: int, scale: float) -> np.ndarray:
+    p = np.int64(int(PRIME))
+    half = p // 2
+    shifted = (f.astype(np.int64) - (n_terms * half) % p) % p
+    signed = np.where(shifted > half, shifted - p, shifted)
+    return signed.astype(np.float64) / scale
+
+
+def _prg_mask(n: int, seed: int) -> np.ndarray:
+    return np.random.RandomState(seed).randint(
+        0, int(PRIME), size=n, dtype=np.uint64)
+
+
+class TurboAggregateSimulator:
+    """FedAvg whose aggregation runs through the group-ring protocol."""
+
+    def __init__(self, args, fed_dataset, bundle, optimizer, spec):
+        self.args = args
+        self.fed = fed_dataset
+        self.bundle = bundle
+        self.opt = optimizer
+        self.spec = spec
+        self.groups = int(getattr(args, "turbo_groups", 2) or 2)
+        self.scale = float(getattr(args, "secagg_scale", 2 ** 16))
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        init_rng, self.rng = jax.random.split(rng)
+        sample = fed_dataset.train.x[0, 0]
+        self.params = bundle.init(init_rng, sample)
+        self.server_state = self.opt.server_init(self.params)
+        self._local_train = jax.jit(
+            lambda p, ss, cs, cd, key, hyper: self.opt.local_train(
+                p, ss, cs, cd, key, hyper))
+        self._evaluate = jax.jit(
+            lambda p, x, y, m: evaluate(spec, p, x, y, m))
+        self.history: List[Dict[str, Any]] = []
+
+    def _ring_aggregate(self, updates: List[np.ndarray],
+                        weights: List[float], round_idx: int) -> np.ndarray:
+        """Group-ring masked aggregation. Masks cancel within each group;
+        the ring carries only partial sums."""
+        n = len(updates)
+        dim = updates[0].size
+        group_of = [i % self.groups for i in range(n)]
+        total_w = sum(weights) or 1.0
+        p = np.uint64(int(PRIME))
+        running = np.zeros(dim, np.uint64)
+        n_terms = 0
+        for g in range(self.groups):
+            members = [i for i in range(n) if group_of[i] == g]
+            partial = np.zeros(dim, np.uint64)
+            for idx, i in enumerate(members):
+                scaled = updates[i] * (weights[i] / total_w)
+                q = _quantize(scaled, self.scale)
+                # pairwise-cancelling masks inside the group: member j adds
+                # +mask(j,j+1) and -mask(j-1,j) (ring within the group)
+                nxt = members[(idx + 1) % len(members)]
+                prv = members[(idx - 1) % len(members)]
+                if len(members) > 1:
+                    m_add = _prg_mask(dim, 7919 * round_idx + 13 * i + nxt)
+                    m_sub = _prg_mask(dim, 7919 * round_idx + 13 * prv + i)
+                    q = (q + m_add) % p
+                    q = (q + p - m_sub) % p
+                partial = (partial + q) % p
+            running = (running + partial) % p
+            n_terms += len(members)
+        return _dequantize_sum(running, n_terms, self.scale)
+
+    def run(self, comm_round=None) -> Dict[str, Any]:
+        rounds = int(comm_round if comm_round is not None
+                     else self.args.comm_round)
+        n_per_round = int(getattr(self.args, "client_num_per_round",
+                                  self.fed.num_clients))
+        hyper = TrainHyper(
+            learning_rate=jnp.float32(self.args.learning_rate),
+            epochs=int(self.args.epochs))
+        cstate0 = self.opt.client_state_init(self.params)
+        t0 = time.time()
+        for r in range(rounds):
+            rs = np.random.RandomState(300 + r)
+            sampled = rs.choice(self.fed.num_clients,
+                                min(n_per_round, self.fed.num_clients),
+                                replace=False)
+            updates, weights = [], []
+            metrics_sum = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
+            hyper_r = hyper.replace(round_idx=jnp.int32(r))
+            for cid in sampled:
+                cdata = jax.tree_util.tree_map(lambda a: a[cid],
+                                               self.fed.train)
+                key = jax.random.fold_in(jax.random.fold_in(self.rng, r),
+                                         int(cid))
+                out = self._local_train(self.params, self.server_state,
+                                        cstate0, cdata, key, hyper_r)
+                vec = np.asarray(tree_flatten_to_vector(out.update),
+                                 np.float64)
+                updates.append(vec)
+                weights.append(float(out.weight))
+                for k in metrics_sum:
+                    metrics_sum[k] += float(out.metrics[k])
+            agg_vec = self._ring_aggregate(updates, weights, r)
+            agg = vector_to_tree_like(jnp.asarray(agg_vec, jnp.float32),
+                                      self.params)
+            self.params, self.server_state = self.opt.server_update(
+                self.params, self.server_state, agg,
+                self.opt.server_extras_zero(self.params), jnp.int32(r))
+            cnt = max(metrics_sum["count"], 1.0)
+            rec = {"round": r,
+                   "train_loss": metrics_sum["loss_sum"] / cnt,
+                   "train_acc": metrics_sum["correct"] / cnt}
+            freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
+            if r % freq == 0 or r == rounds - 1:
+                stats = self._evaluate(self.params, self.fed.test["x"],
+                                       self.fed.test["y"],
+                                       self.fed.test["mask"])
+                n = max(float(stats["count"]), 1.0)
+                rec["test_acc"] = float(stats["correct"]) / n
+                logger.info("turbo round %d: acc=%.4f", r, rec["test_acc"])
+            self.history.append(rec)
+        last = next((h for h in reversed(self.history) if "test_acc" in h),
+                    {})
+        return {"params": self.params, "history": self.history,
+                "final_test_acc": last.get("test_acc"),
+                "wall_time_s": time.time() - t0, "rounds": rounds}

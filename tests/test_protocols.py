@@ -55,3 +55,53 @@ def test_vertical_fl_learns():
         federated_optimizer="classical_vertical", party_num=3, comm_round=5,
         learning_rate=0.05))
     assert r["final_test_acc"] > 0.6, r["history"]
+
+
+def test_fedgan_generator_fools_discriminator():
+    """FedGAN: averaged (G, D) training drives D's real-vs-fake accuracy
+    down from ~1.0 toward chance as G learns the data manifold."""
+    r = fedml_tpu.run_simulation(backend="sp", args=make_args(
+        model="gan", federated_optimizer="FedGAN", comm_round=4,
+        client_num_in_total=4, client_num_per_round=4,
+        learning_rate=2e-4, batch_size=32))
+    assert len(r["history"]) == 4
+    assert all(np.isfinite(h["g_loss"]) for h in r["history"])
+    # D should not perfectly separate by the end (G is learning)
+    assert r["final_disc_acc"] < 0.995
+
+
+def test_fedgkt_learns_via_feature_exchange():
+    r = fedml_tpu.run_simulation(backend="sp", args=make_args(
+        federated_optimizer="FedGKT", client_num_in_total=4,
+        comm_round=4))
+    assert r["final_test_acc"] > 0.6, r["history"]
+    # KD actually moves the server: accuracy improves over rounds
+    assert r["history"][-1]["test_acc"] >= r["history"][0]["test_acc"]
+
+
+def test_fednas_searches_and_learns():
+    r = fedml_tpu.run_simulation(backend="sp", args=make_args(
+        federated_optimizer="FedNAS", client_num_in_total=4,
+        comm_round=4, learning_rate=0.05))
+    assert r["final_test_acc"] > 0.6, r["history"]
+    arch = r["architecture"]
+    assert len(arch) == 2 and all(op != "zero" for op in arch), arch
+
+
+def test_fedseg_miou_improves():
+    r = fedml_tpu.run_simulation(backend="sp", args=make_args(
+        dataset="synthetic_seg", federated_optimizer="FedSeg",
+        client_num_in_total=4, client_num_per_round=4, comm_round=6,
+        learning_rate=0.2, batch_size=16))
+    assert r["final_miou"] > 0.5, r["history"]
+    assert r["history"][-1]["miou"] > r["history"][0]["miou"]
+
+
+def test_turbo_aggregate_matches_fedavg():
+    """The group-ring masked aggregation must be FedAvg-exact (masks cancel,
+    fixed-point error only)."""
+    args = make_args(federated_optimizer="turbo_aggregate",
+                     client_num_in_total=6, client_num_per_round=6,
+                     comm_round=4, turbo_groups=2)
+    r = fedml_tpu.run_simulation(backend="sp", args=args)
+    assert r["final_test_acc"] > 0.6, r["history"]
