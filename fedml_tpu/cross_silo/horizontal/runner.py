@@ -87,11 +87,48 @@ class CrossSiloRunner:
         self.bundle = model
         role = str(getattr(args, "role", "client")).lower()
         rank = int(getattr(args, "rank", 1) or 1)
-        if role == "server":
+        fo = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        if fo in ("sa", "secagg", "lsa", "lightsecagg"):
+            self.manager = self._build_secure(args, dataset, model,
+                                              client_trainer, fo, role, rank)
+        elif role == "server":
             self.manager = build_server(args, dataset, model, client_trainer)
         else:
             self.manager = build_client(args, dataset, model,
                                         max(rank, 1), client_trainer)
+
+    @staticmethod
+    def _build_secure(args, fed, bundle, client_trainer, fo, role, rank):
+        """Secure-aggregation runtimes (reference fedml_client.py:1-64 /
+        fedml_server.py dispatch on SA vs LSA vs plain)."""
+        from ...optimizers.registry import create_optimizer
+        from ..client.trainer import SiloTrainer
+        spec = _build_spec(fed, bundle, client_trainer)
+        n = int(getattr(args, "client_num_per_round", 1))
+        if role == "server":
+            rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+            init_rng, _ = jax.random.split(rng)
+            global_params = jax.device_get(
+                bundle.init(init_rng, fed.train.x[0, 0]))
+            kw = dict(eval_fn=_make_eval_fn(spec, fed), rank=0, size=n + 1,
+                      backend=_wan_backend(args))
+            if fo in ("sa", "secagg"):
+                from ..secagg import SecAggServerManager
+                return SecAggServerManager(args, global_params, **kw)
+            from ..lightsecagg import LSAServerManager
+            return LSAServerManager(args, global_params, **kw)
+        import copy
+        inner_args = copy.copy(args)
+        inner_args.federated_optimizer = "FedAvg"  # local step is FedAvg
+        optimizer = create_optimizer(inner_args, spec)
+        trainer = SiloTrainer(args, fed, bundle, spec, optimizer)
+        kw = dict(rank=max(rank, 1), size=n + 1,
+                  backend=_wan_backend(args))
+        if fo in ("sa", "secagg"):
+            from ..secagg import SecAggClientManager
+            return SecAggClientManager(args, trainer, **kw)
+        from ..lightsecagg import LSAClientManager
+        return LSAClientManager(args, trainer, **kw)
 
     def run(self, comm_round=None) -> Any:
         self.manager.run()

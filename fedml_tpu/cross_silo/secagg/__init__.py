@@ -233,12 +233,19 @@ class SecAggServerManager(FedMLCommManager):
             self._start_round()
 
     def _start_round(self) -> None:
-        # NOTE: the dropout timer is armed on the FIRST masked arrival (see
-        # on_masked_model), not here — arming at round start would race long
-        # first-compile times; counting from the first report only measures
-        # straggler skew.
+        # The straggler timer is armed on the FIRST masked arrival (see
+        # on_masked_model) — arming the tight timeout at round start would
+        # race long first-compile times. But zero arrivals must not hang
+        # forever either: arm a generous dead-round leash here that the
+        # first arrival replaces with the tight timer.
         with self._lock:
             self._phase = "collect"
+            if self.round_timeout > 0:
+                leash = max(3.0 * self.round_timeout, 60.0)
+                self._timer = threading.Timer(
+                    leash, self._on_collect_timeout, args=(self.round_idx,))
+                self._timer.daemon = True
+                self._timer.start()
         wire = tree_to_wire(self.global_params)
         for rank in range(1, self.n_clients + 1):
             out = Message(SAMessage.S2C_TRAIN, 0, rank)
@@ -280,7 +287,11 @@ class SecAggServerManager(FedMLCommManager):
             self.weights[idx] = float(msg.get(SAMessage.KEY_N))
             if len(self.masked) == self.n_clients:
                 self._begin_unmask_locked()
-            elif self.round_timeout > 0 and self._timer is None:
+            elif self.round_timeout > 0 and len(self.masked) == 1:
+                # first arrival: swap the dead-round leash for the tight
+                # straggler timer
+                if self._timer is not None:
+                    self._timer.cancel()
                 self._timer = threading.Timer(
                     self.round_timeout, self._on_collect_timeout,
                     args=(self.round_idx,))

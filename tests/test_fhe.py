@@ -1,0 +1,52 @@
+"""FHE aggregation (reference core/fhe/fhe_agg.py): Paillier-backed
+encrypted FedAvg must equal the plaintext weighted average."""
+
+import numpy as np
+
+from fedml_tpu.core.fhe import FedMLFHE, fhe_fedavg, keygen
+from fedml_tpu.core.fhe.paillier import (add_ciphertexts, pack_vector,
+                                         unpack_vector)
+
+
+def test_paillier_roundtrip_and_homomorphism():
+    pub, priv = keygen(512)
+    a, b = 123456789, 987654321
+    ca, cb = pub.encrypt_int(a), pub.encrypt_int(b)
+    assert priv.decrypt_int(ca) == a
+    assert priv.decrypt_int(pub.add(ca, cb)) == a + b
+    # semantic security: same plaintext, different ciphertexts
+    assert pub.encrypt_int(a) != ca
+
+
+def test_packed_vector_sum():
+    pub, priv = keygen(512)
+    rs = np.random.RandomState(0)
+    v1 = rs.randn(300).astype(np.float64)
+    v2 = rs.randn(300).astype(np.float64)
+    c1 = pack_vector(v1, pub)
+    c2 = pack_vector(v2, pub)
+    agg = add_ciphertexts([c1, c2], pub)
+    out = unpack_vector(agg, priv, 300, n_added=2)
+    np.testing.assert_allclose(out, v1 + v2, atol=1e-4)
+
+
+def test_fhe_fedavg_matches_plain():
+    pub, priv = keygen(512)
+    rs = np.random.RandomState(1)
+    vecs = [rs.randn(200) for _ in range(4)]
+    weights = [10.0, 20.0, 30.0, 40.0]
+    enc_avg = fhe_fedavg(vecs, weights, pub, priv)
+    total = sum(weights)
+    plain = sum(v * (w / total) for v, w in zip(vecs, weights))
+    np.testing.assert_allclose(enc_avg, plain, atol=1e-4)
+
+
+def test_facade_flags():
+    class A:
+        enable_fhe = True
+        fhe_key_bits = 256
+    f = FedMLFHE(A())
+    assert f.is_fhe_enabled()
+    v = np.array([0.5, -1.25, 3.0])
+    cts = f.fhe_enc(v)
+    np.testing.assert_allclose(f.fhe_dec(cts, 3), v, atol=1e-4)
