@@ -1,0 +1,104 @@
+"""Centralized (non-FL) baseline trainer.
+
+Parity target: reference ``centralized/centralized_trainer.py`` (plain
+trainer over the pooled dataset, used to baseline FL results). TPU-native:
+pools every client's real samples and runs the same jitted local-SGD scan
+the FL engines use — so "FL vs centralized" comparisons differ only in the
+protocol, not the training code.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.algframe.client_trainer import make_trainer_spec
+from ..core.algframe.local_training import evaluate, run_local_sgd
+from ..core.algframe.types import ClientData, TrainHyper
+
+logger = logging.getLogger(__name__)
+
+
+class CentralizedTrainer:
+    """Train one model on the union of all clients' data."""
+
+    def __init__(self, args, fed_dataset, bundle, spec=None):
+        self.args = args
+        self.fed = fed_dataset
+        self.bundle = bundle
+        self.spec = spec or make_trainer_spec(fed_dataset, bundle)
+        # pool real samples across clients into one padded batch stream
+        x = np.asarray(fed_dataset.train.x)
+        y = np.asarray(fed_dataset.train.y)
+        m = np.asarray(fed_dataset.train.mask)
+        bs = x.shape[2]
+        real = m.reshape(-1) > 0
+        flat_x = x.reshape((-1,) + x.shape[3:])[real]
+        flat_y = y.reshape((-1,) + y.shape[3:])[real]
+        n = len(flat_x)
+        nb = max(1, -(-n // bs))
+        pad = nb * bs - n
+        mask = np.concatenate([np.ones(n, np.float32),
+                               np.zeros(pad, np.float32)])
+        if pad:
+            flat_x = np.concatenate(
+                [flat_x, np.zeros((pad,) + flat_x.shape[1:], flat_x.dtype)])
+            flat_y = np.concatenate(
+                [flat_y, np.zeros((pad,) + flat_y.shape[1:], flat_y.dtype)])
+        self.data = ClientData(
+            x=jnp.asarray(flat_x.reshape((nb, bs) + flat_x.shape[1:])),
+            y=jnp.asarray(flat_y.reshape((nb, bs) + flat_y.shape[1:])),
+            mask=jnp.asarray(mask.reshape(nb, bs)),
+            num_samples=jnp.float32(n))
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        init_rng, self.rng = jax.random.split(rng)
+        self.params = bundle.init(init_rng, fed_dataset.train.x[0, 0])
+        import optax
+        lr = float(getattr(args, "learning_rate", 0.03))
+        momentum = float(getattr(args, "momentum", 0.0) or 0.0)
+        self._opt = (optax.sgd(lr, momentum=momentum) if momentum
+                     else optax.sgd(lr))
+
+        def epoch(params, opt_state, rng):
+            hyper = TrainHyper(learning_rate=jnp.float32(lr), epochs=1)
+            return run_local_sgd(self.spec, self._opt, params, self.data,
+                                 rng, hyper, init_opt_state=opt_state)
+
+        self._epoch = jax.jit(epoch)
+        self._evaluate = jax.jit(
+            lambda p: evaluate(self.spec, p, self.fed.test["x"],
+                               self.fed.test["y"], self.fed.test["mask"]))
+        self.history = []
+
+    def run(self, comm_round=None) -> Dict[str, Any]:
+        epochs = int(comm_round if comm_round is not None
+                     else getattr(self.args, "epochs", 1)
+                     * getattr(self.args, "comm_round", 1))
+        t0 = time.time()
+        opt_state = self._opt.init(self.params)
+        for e in range(epochs):
+            key = jax.random.fold_in(self.rng, e)
+            self.params, opt_state, metrics = self._epoch(
+                self.params, opt_state, key)
+            cnt = max(float(metrics["count"]), 1.0)
+            rec = {"epoch": e,
+                   "train_loss": float(metrics["loss_sum"]) / cnt,
+                   "train_acc": float(metrics["correct"]) / cnt}
+            freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
+            if e % freq == 0 or e == epochs - 1:
+                stats = self._evaluate(self.params)
+                nte = max(float(stats["count"]), 1.0)
+                rec["test_acc"] = float(stats["correct"]) / nte
+                logger.info("centralized epoch %d: acc=%.4f", e,
+                            rec["test_acc"])
+            self.history.append(rec)
+        last = next((h for h in reversed(self.history) if "test_acc" in h),
+                    {})
+        return {"params": self.params, "history": self.history,
+                "final_test_acc": last.get("test_acc"),
+                "wall_time_s": time.time() - t0, "rounds": epochs}

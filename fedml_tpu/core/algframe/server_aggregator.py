@@ -1,0 +1,40 @@
+"""User-pluggable server aggregator for the simulator path.
+
+Parity target: reference ``core/alg_frame/server_aggregator.py:14`` (ABC
+with ``on_before_aggregation`` :44 / ``aggregate`` :75 /
+``on_after_aggregation`` :90 hooks, honored by every runner). TPU-native
+shape: the hooks operate on the round's stacked update **matrix** [K, D]
+plus weights [K] — exactly what the engine's collect mode emits — and
+return the aggregate vector [D]. Passing an instance to ``FedMLRunner``
+switches the mesh engine into collect mode automatically.
+
+When a defense is also enabled the defense takes precedence (the reference
+runs defenses inside these same hooks; here they are one fused kernel), and
+the user aggregator is skipped with a warning.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class ServerAggregator(ABC):
+    """Override ``aggregate``; the before/after hooks are optional."""
+
+    def on_before_aggregation(
+            self, update_matrix: jnp.ndarray, weights: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return update_matrix, weights
+
+    @abstractmethod
+    def aggregate(self, update_matrix: jnp.ndarray,
+                  weights: jnp.ndarray) -> jnp.ndarray:
+        """[K, D] stacked client updates + [K] weights -> [D] aggregate."""
+
+    def on_after_aggregation(self, agg_vec: jnp.ndarray) -> jnp.ndarray:
+        return agg_vec

@@ -89,6 +89,14 @@ class FedMLCommManager(Observer):
                                    getattr(self.args, "ip_config", None),
                                    int(getattr(self.args, "grpc_base_port", 0)
                                        or 29790))
+        if b == "TRPC":
+            from .communication.trpc import TRPCCommManager
+            return TRPCCommManager(
+                self.rank, self.size,
+                master_addr=str(getattr(self.args, "trpc_master_addr",
+                                        "127.0.0.1")),
+                master_port=int(getattr(self.args, "trpc_master_port", 0)
+                                or 29500))
         if b in ("MQTT_S3", "MQTT_WEB3", "MQTT_THETASTORE", "MQTT_S3_MNN"):
             raise ImportError(
                 f"backend {b} needs paho-mqtt (not available in this "

@@ -21,7 +21,8 @@ from ....utils.confval import get_float, get_int
 PyTree = Any
 
 ATTACK_TYPES = ("byzantine_random", "byzantine_zero", "byzantine_flip",
-                "label_flip", "model_replacement", "gaussian_noise")
+                "label_flip", "model_replacement", "gaussian_noise",
+                "backdoor", "edge_case_backdoor", "lazy_worker")
 
 
 # --- model poisoning (operate on [K, D] update matrix + byzantine mask) ----
@@ -60,6 +61,37 @@ def gaussian_noise(mat: jnp.ndarray, rng: jax.Array,
 
 # --- data poisoning --------------------------------------------------------
 
+def lazy_worker(mat: jnp.ndarray, byz_mask: jnp.ndarray, rng: jax.Array,
+                noise: float = 1e-3) -> jnp.ndarray:
+    """Freeloaders (reference lazy-worker attack): byzantine clients do no
+    training and submit a near-zero update with a dash of noise to evade
+    exact-zero detection."""
+    fake = noise * jax.random.normal(rng, mat.shape, mat.dtype)
+    m = byz_mask.reshape(-1, 1).astype(mat.dtype)
+    return mat * (1 - m) + fake * m
+
+
+def backdoor_stamp(x: np.ndarray, trigger_value: float = 1.0,
+                   patch: int = 3, image: Optional[bool] = None
+                   ) -> np.ndarray:
+    """Stamp the backdoor trigger (a corner patch) onto samples.
+
+    ``image=True`` stamps a top-left ``patch x patch`` corner on
+    [..., H, W, C] layouts; ``image=False`` stamps the first
+    ``patch * patch`` features of flat [..., F] layouts. Leading axes are
+    arbitrary (batched/stacked inputs), so callers that know the layout
+    MUST pass ``image`` — the ndim heuristic only covers the unbatched
+    2D/4D cases."""
+    x = np.array(x, copy=True)
+    if image is None:
+        image = x.ndim >= 3
+    if image:
+        x[..., :patch, :patch, :] = trigger_value
+    else:
+        x[..., :patch * patch] = trigger_value
+    return x
+
+
 def label_flip(y: np.ndarray, num_classes: int,
                src: Optional[int] = None, dst: Optional[int] = None
                ) -> np.ndarray:
@@ -96,10 +128,11 @@ class FedMLAttacker:
     def is_model_attack(self) -> bool:
         return self.enabled and self.attack_type in (
             "byzantine_random", "byzantine_zero", "byzantine_flip",
-            "model_replacement", "gaussian_noise")
+            "model_replacement", "gaussian_noise", "lazy_worker")
 
     def is_data_attack(self) -> bool:
-        return self.enabled and self.attack_type == "label_flip"
+        return self.enabled and self.attack_type in (
+            "label_flip", "backdoor", "edge_case_backdoor")
 
     def byzantine_mask(self, client_ids: np.ndarray) -> np.ndarray:
         """Clients 0..f-1 are byzantine (deterministic, test-friendly)."""
@@ -122,6 +155,8 @@ class FedMLAttacker:
             return model_replacement(mat, mask, boost)
         if t == "gaussian_noise":
             return gaussian_noise(mat, rng, self.attack_scale)
+        if t == "lazy_worker":
+            return lazy_worker(mat, mask, rng)
         return mat
 
     def poison_labels(self, y: np.ndarray, num_classes: int) -> np.ndarray:

@@ -29,6 +29,7 @@ DEFENSE_TYPES = (
     "trimmed_mean", "rfa", "geometric_median", "norm_clip", "cclip",
     "weak_dp", "crfl", "foolsgold", "three_sigma", "outlier_detection",
     "residual_reweight", "slsgd", "robust_learning_rate", "rlr",
+    "soteria", "wbc", "cross_round",
 )
 
 
@@ -152,7 +153,36 @@ class FedMLDefender:
             return out, info
         if d in ("robust_learning_rate", "rlr"):
             return robust_agg.robust_learning_rate(mat, weights)
+        if d == "soteria":
+            return robust_agg.soteria(mat, weights,
+                                      get_float(self.args, "soteria_frac",
+                                                0.5))
+        if d == "wbc":
+            return robust_agg.wbc(mat, weights)
+        if d == "cross_round":
+            prev, has_prev = self._cross_round_state(np.asarray(mat),
+                                                     client_ids)
+            return robust_agg.cross_round_filter(
+                mat, weights, jnp.asarray(prev), jnp.asarray(has_prev),
+                get_float(self.args, "cross_round_threshold", -0.5))
         raise ValueError(f"unknown defense_type {self.defense_type!r}")
+
+    def _cross_round_state(self, mat: np.ndarray, client_ids):
+        """Per-client previous-round updates for the cross-round defense
+        (keyed by true client id; absent history passes through)."""
+        if client_ids is None:
+            client_ids = np.arange(mat.shape[0])
+        if not hasattr(self, "_cr_prev"):
+            self._cr_prev = {}
+        prev = np.zeros_like(mat)
+        has = np.zeros(mat.shape[0], np.float32)
+        for row, cid in enumerate(np.asarray(client_ids)):
+            if int(cid) in self._cr_prev:
+                prev[row] = self._cr_prev[int(cid)]
+                has[row] = 1.0
+        for row, cid in enumerate(np.asarray(client_ids)):
+            self._cr_prev[int(cid)] = mat[row]
+        return prev, has
 
     def _update_fg_history(self, mat: np.ndarray, client_ids) -> np.ndarray:
         """FoolsGold needs per-client *accumulated* history across rounds."""

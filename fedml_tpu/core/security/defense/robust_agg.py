@@ -259,3 +259,66 @@ def robust_learning_rate(updates: Arr, weights: Arr, threshold: int = 2
     sign_sum = jnp.abs(jnp.sum(jnp.sign(updates), axis=0))
     lr_sign = jnp.where(sign_sum >= threshold, 1.0, -1.0)
     return weighted_mean(updates, weights) * lr_sign, {"lr_sign": lr_sign}
+
+
+def soteria(updates: Arr, weights: Arr, frac: float = 0.5
+            ) -> Tuple[Arr, Dict]:
+    """Soteria-style representation pruning (reference
+    ``soteria_defense.py``): before aggregation, zero the smallest-magnitude
+    ``frac`` of each client's update coordinates — the perturbed
+    representation defends against gradient-inversion reconstruction while
+    keeping the dominant directions."""
+    k, d = updates.shape
+    cut = jnp.quantile(jnp.abs(updates), frac, axis=1, keepdims=True)
+    pruned = jnp.where(jnp.abs(updates) >= cut, updates, 0.0)
+    return weighted_mean(pruned, weights), {"pruned_frac": frac}
+
+
+def wbc(updates: Arr, weights: Arr, iters: int = 8) -> Tuple[Arr, Dict]:
+    """White-Blood-Cell clustering defense (reference ``wbc_defense.py``
+    shape): 2-means over the update vectors; only the LARGER cluster (the
+    presumed-honest majority) is aggregated."""
+    k = updates.shape[0]
+    # seed centroids at the two most-distant rows (deterministic)
+    dists = pairwise_sq_dists(updates)
+    flat_idx = jnp.argmax(dists)
+    i0, i1 = flat_idx // k, flat_idx % k
+    c = jnp.stack([updates[i0], updates[i1]])
+
+    def body(_, c):
+        assign = jnp.argmin(
+            jnp.stack([jnp.sum((updates - c[0]) ** 2, axis=1),
+                       jnp.sum((updates - c[1]) ** 2, axis=1)]), axis=0)
+        one = (assign == 1).astype(updates.dtype)[:, None]
+        n1 = jnp.maximum(jnp.sum(one), 1.0)
+        n0 = jnp.maximum(jnp.sum(1.0 - one), 1.0)
+        return jnp.stack([jnp.sum(updates * (1 - one), axis=0) / n0,
+                          jnp.sum(updates * one, axis=0) / n1])
+
+    c = jax.lax.fori_loop(0, iters, body, c)
+    assign = jnp.argmin(
+        jnp.stack([jnp.sum((updates - c[0]) ** 2, axis=1),
+                   jnp.sum((updates - c[1]) ** 2, axis=1)]), axis=0)
+    # label of the LARGER cluster: cluster 1 wins iff it holds > k/2 rows
+    majority = (jnp.sum(assign) > k / 2).astype(jnp.int32)
+    keep = (assign == majority).astype(updates.dtype)
+    return (weighted_mean(updates, weights * keep),
+            {"kept": jnp.sum(keep)})
+
+
+def cross_round_filter(updates: Arr, weights: Arr, prev: Arr,
+                       has_prev: Arr, sim_threshold: float = -0.5
+                       ) -> Tuple[Arr, Dict]:
+    """Cross-round consistency defense (reference
+    ``cross_round_defense.py`` shape): a client whose update direction
+    REVERSES versus its own previous round (cosine < threshold) is
+    suspicious (oscillating / adaptive poisoning) and dropped this round.
+    Clients without history pass through."""
+    dot = jnp.sum(updates * prev, axis=1)
+    norm = (jnp.linalg.norm(updates, axis=1)
+            * jnp.linalg.norm(prev, axis=1) + 1e-12)
+    cos = dot / norm
+    keep = jnp.where(has_prev > 0,
+                     (cos >= sim_threshold).astype(updates.dtype), 1.0)
+    return (weighted_mean(updates, weights * keep),
+            {"kept": jnp.sum(keep), "mean_cos": jnp.mean(cos)})

@@ -244,6 +244,14 @@ class LSAServerManager(FedMLCommManager):
         self._phase = "agg"
         self._surviving = sorted(self.masked)
         self.agg_shares = []
+        if self.round_timeout > 0:
+            # a survivor dying between masked upload and agg response must
+            # not hang the decode phase either
+            self._timer = threading.Timer(
+                max(self.round_timeout, 10.0), self._on_agg_timeout,
+                args=(self.round_idx,))
+            self._timer.daemon = True
+            self._timer.start()
         for j in self._surviving:
             out = Message(LSAMessage.S2C_AGG_REQUEST, 0, j + 1)
             out.add_params(LSAMessage.KEY_SURVIVING,
@@ -252,6 +260,21 @@ class LSAServerManager(FedMLCommManager):
                            {str(i): self.encoded[i][str(j)]
                             for i in self._surviving})
             self.send_message(out)
+
+    def _on_agg_timeout(self, armed_round: int) -> None:
+        with self._lock:
+            if self._phase != "agg" or self.round_idx != armed_round:
+                return
+            logger.error(
+                "lsa round %d: only %d/%d agg shares at timeout — decode "
+                "impossible, aborting session", self.round_idx,
+                len(self.agg_shares), self.split_t + self.privacy_t)
+            self._phase = "done"
+            self.result = {"error": "lsa_agg_timeout",
+                           "round": self.round_idx}
+        for rank in range(1, self.n_clients + 1):
+            self.send_message(Message(LSAMessage.S2C_FINISH, 0, rank))
+        self.finish()
 
     def on_agg_share(self, msg: Message) -> None:
         j = msg.get_sender_id() - 1
@@ -263,6 +286,9 @@ class LSAServerManager(FedMLCommManager):
                 msg.get(LSAMessage.KEY_AGG), np.uint32)))
             if len(self.agg_shares) < need:
                 return
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
             self._phase = "decode"
         self._decode_and_advance()
 

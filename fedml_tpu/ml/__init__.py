@@ -1,0 +1,32 @@
+"""Compat namespace mirroring the reference's ``fedml.ml`` layout.
+
+The reference splits the ML layer into ``ml/aggregator`` (FedMLAggOperator),
+``ml/trainer`` (concrete local trainers), and ``ml/engine`` (multi-engine
+adapter). In this framework those roles live in first-class modules — the
+agg operator is :func:`fedml_tpu.core.collectives.tree_weighted_average`,
+trainers are the pure-function specs of
+:mod:`fedml_tpu.core.algframe.client_trainer`, and there is exactly one
+engine (JAX/XLA) by design, so the adapter layer is gone. This package
+re-exports them under the reference's names so ``fedml.ml``-style imports
+port mechanically.
+"""
+
+from ..core.algframe.client_trainer import (  # noqa: F401
+    ClassificationTrainer, MultiLabelTrainer, RegressionTrainer,
+    SequenceTrainer, TrainerSpec, make_trainer_spec)
+from ..core.collectives import tree_weighted_average  # noqa: F401
+
+
+class FedMLAggOperator:
+    """Reference ``ml/aggregator/agg_operator.py:8`` shape: ``agg(args,
+    raw_grad_list)`` with (n_k, params) pairs -> weighted average."""
+
+    @staticmethod
+    def agg(args, raw_grad_list):
+        import jax
+        import jax.numpy as jnp
+        weights = jnp.asarray([float(n) for n, _ in raw_grad_list],
+                              jnp.float32)
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *[p for _, p in raw_grad_list])
+        return tree_weighted_average(stacked, weights)

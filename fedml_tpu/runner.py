@@ -58,6 +58,9 @@ class FedMLRunner:
         from .optimizers.registry import create_optimizer
         fed, bundle = self.dataset, self.model
         fo = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        if fo == "centralized":
+            from .centralized import CentralizedTrainer
+            return CentralizedTrainer(args, fed, bundle)
         # protocols with their own model/loss stacks dispatch before the
         # TrainerSpec is built (segmentation/GAN/NAS/GKT tasks have no
         # classification spec)
@@ -105,7 +108,8 @@ class FedMLRunner:
             from .simulation.sp.simulator import SPSimulator
             return SPSimulator(args, fed, bundle, opt, spec)
         from .simulation.tpu.engine import TPUSimulator
-        return TPUSimulator(args, fed, bundle, opt, spec)
+        return TPUSimulator(args, fed, bundle, opt, spec,
+                            server_aggregator=self.server_aggregator)
 
     def run(self, comm_round: Optional[int] = None) -> Any:
         return self.runner.run(comm_round)

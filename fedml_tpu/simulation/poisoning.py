@@ -13,11 +13,37 @@ from ..core.security import FedMLAttacker
 
 
 def poison_dataset(fed, attacker: FedMLAttacker):
-    """Apply label-flipping to the byzantine clients' training shards."""
+    """Apply the configured data attack to the byzantine clients' shards:
+    label flipping, or backdoor trigger stamping (all samples / edge-case
+    variant that stamps only the globally rarest class — reference
+    edge-case backdoor of ``core/security/attack/``)."""
+    from ..core.security.attack import backdoor_stamp
+
     mask = attacker.byzantine_mask(np.arange(fed.num_clients))  # [K]
     y = np.asarray(fed.train.y)
-    flipped = attacker.poison_labels(y, fed.num_classes)
     sel = mask.reshape((-1,) + (1,) * (y.ndim - 1)) > 0
+    t = attacker.attack_type
+    if t in ("backdoor", "edge_case_backdoor"):
+        x = np.asarray(fed.train.x)
+        target = int(getattr(attacker.args, "backdoor_target_label", 0) or 0)
+        # x is [K, nb, bs, ...feature dims]; image iff features are H,W,C
+        stamped = backdoor_stamp(x, image=(x.ndim == y.ndim + 3))
+        if t == "edge_case_backdoor":
+            # padding rows carry label 0 — count only real samples
+            real = np.asarray(fed.train.mask).reshape(-1) > 0
+            counts = np.bincount(y.reshape(-1)[real],
+                                 minlength=fed.num_classes)
+            rare = int(np.argmin(np.where(counts > 0, counts, counts.max())))
+            edge = (y == rare)
+            sel = sel & edge
+        new_x = np.where(
+            np.broadcast_to(sel.reshape(sel.shape + (1,) * (x.ndim - y.ndim)),
+                            x.shape), stamped, x)
+        new_y = np.where(sel, target, y)
+        new_train = fed.train.replace(x=jnp.asarray(new_x),
+                                      y=jnp.asarray(new_y))
+        return dataclasses.replace(fed, train=new_train)
+    flipped = attacker.poison_labels(y, fed.num_classes)
     new_y = np.where(sel, flipped, y)
     new_train = fed.train.replace(y=jnp.asarray(new_y))
     return dataclasses.replace(fed, train=new_train)

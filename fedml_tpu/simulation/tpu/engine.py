@@ -88,8 +88,9 @@ class TPUSimulator:
     multiple clients per chip via the schedule tensor."""
 
     def __init__(self, args, fed_dataset, bundle, optimizer, spec,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, server_aggregator=None):
         self.args = args
+        self.server_aggregator = server_aggregator
         self.fed = fed_dataset
         self.bundle = bundle
         self.opt = optimizer
@@ -134,7 +135,14 @@ class TPUSimulator:
         self.contribution = ContributionAssessorManager(args)
         defended_mode = (self.attacker.is_model_attack()
                          or self.defender.is_defense_enabled())
-        self.robust_mode = defended_mode or self.contribution.enabled
+        self.robust_mode = (defended_mode or self.contribution.enabled
+                            or self.server_aggregator is not None)
+        if (self.server_aggregator is not None
+                and self.defender.is_defense_enabled()):
+            logger.warning(
+                "both a defense (%s) and a user ServerAggregator are "
+                "configured: the defense takes precedence and the user "
+                "aggregator is SKIPPED", self.defender.defense_type)
         _check_extras_compat(self.opt, self.params, self.dp, defended_mode)
         self._round_fn = (self._build_collect_fn() if self.robust_mode
                           else self._build_round_fn())
@@ -164,6 +172,15 @@ class TPUSimulator:
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
+        # "scan" (default): slots run sequentially per chip — minimal
+        # memory. "vmap": slots train in LOCKSTEP per chip in chunks of
+        # ``client_vmap_chunk`` (scan over chunks, vmap within) — the small
+        # per-client matmuls batch across clients and feed the MXU at
+        # chunk-multiplied width, with activation memory bounded by the
+        # chunk size.
+        vmap_mode = (str(getattr(self.args, "client_parallelism", "scan"))
+                     .lower() == "vmap")
+        vmap_chunk = int(getattr(self.args, "client_vmap_chunk", 8) or 8)
 
         def round_body(params, server_state, local_data, local_states,
                        sched_idx, sched_active, round_key, hyper):
@@ -180,6 +197,89 @@ class TPUSimulator:
             zero_extras = opt.server_extras_zero(params)
             zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
                             "count": jnp.float32(0)}
+
+            if vmap_mode:
+                s_total = sched_idx.shape[0]
+                chunk = max(min(vmap_chunk, s_total), 1)
+                n_chunks = -(-s_total // chunk)
+                padded = n_chunks * chunk
+                # pad the schedule with inactive slots; index 0 is a safe
+                # dummy gather target (weight-gated to zero)
+                pad_idx = jnp.concatenate(
+                    [sched_idx, jnp.zeros(padded - s_total,
+                                          sched_idx.dtype)])
+                pad_act = jnp.concatenate(
+                    [sched_active, jnp.zeros(padded - s_total,
+                                             sched_active.dtype)])
+                chunks_idx = pad_idx.reshape(n_chunks, chunk)
+                chunks_act = pad_act.reshape(n_chunks, chunk)
+
+                def one_slot(states, li, active):
+                    cdata = jax.tree_util.tree_map(lambda a: a[li],
+                                                   local_data)
+                    cstate = jax.tree_util.tree_map(lambda a: a[li], states)
+                    gcid = dev * cpd + li
+                    key = jax.random.fold_in(round_key, gcid)
+                    out = opt.local_train(params, server_state, cstate,
+                                          cdata, key, hyper)
+                    upd = out.update
+                    if dp.is_local_dp_enabled():
+                        upd = dp.add_local_noise(
+                            upd, jax.random.fold_in(key, DP_LDP_FOLD))
+                    elif dp.is_global_dp_enabled():
+                        upd = dp.clip_update(upd)
+                    w = out.weight * active
+                    return upd, out.extras, w, out.metrics, out.client_state
+
+                def chunk_body(carry, inp):
+                    states, acc_u, acc_ex, acc_w, acc_m = carry
+                    lis, acts = inp
+                    upds, extras, ws, mets, new_states = jax.vmap(
+                        one_slot, in_axes=(None, 0, 0))(states, lis, acts)
+                    acc_u = jax.tree_util.tree_map(
+                        lambda acc, u: acc + jnp.tensordot(
+                            ws.astype(u.dtype), u, axes=1), acc_u, upds)
+                    acc_ex = jax.tree_util.tree_map(
+                        lambda acc, e: acc + jnp.tensordot(
+                            ws.astype(e.dtype), e, axes=1), acc_ex, extras)
+                    acc_w = acc_w + jnp.sum(ws)
+                    acc_m = jax.tree_util.tree_map(
+                        lambda acc, m: acc + jnp.sum(
+                            m * acts.astype(m.dtype)), acc_m, mets)
+                    # scatter updated client states. ACTIVE slot indices are
+                    # distinct per device (build_schedule), but zero-padded
+                    # inactive slots alias index 0 — scatter order with
+                    # duplicate indices is undefined, so route inactive
+                    # slots out of bounds and drop them instead of gating
+                    # by value.
+                    safe_lis = jnp.where(acts > 0, lis,
+                                         jnp.int32(cpd))  # OOB -> dropped
+                    def scatter(st, ns):
+                        return st.at[safe_lis].set(ns, mode="drop")
+                    states = jax.tree_util.tree_map(scatter, states,
+                                                    new_states)
+                    return (states, acc_u, acc_ex, acc_w, acc_m), None
+
+                init = (local_states, zero_update, zero_extras,
+                        jnp.float32(0), zero_metrics)
+                (states, acc_u, acc_ex, acc_w, acc_m), _ = jax.lax.scan(
+                    chunk_body, init, (chunks_idx, chunks_act))
+                total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
+                denom = jnp.maximum(total_w, 1e-12)
+                agg_update = jax.tree_util.tree_map(
+                    lambda x: x / denom.astype(x.dtype), psum_tree(acc_u))
+                agg_extras = jax.tree_util.tree_map(
+                    lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
+                metrics = psum_tree(acc_m)
+                if dp.is_global_dp_enabled():
+                    agg_update = dp.add_global_noise(
+                        agg_update, jax.random.fold_in(round_key,
+                                                       DP_CDP_FOLD))
+                new_params, new_server_state = opt.server_update(
+                    params, server_state, agg_update, agg_extras,
+                    hyper.round_idx)
+                states = jax.tree_util.tree_map(lambda a: a[None], states)
+                return new_params, new_server_state, states, metrics
 
             def slot(carry, s):
                 states, acc_u, acc_ex, acc_w, acc_m = carry
@@ -341,8 +441,27 @@ class TPUSimulator:
             mat = self.attacker.poison_updates(
                 mat, ids, jax.random.fold_in(round_key, ATTACK_FOLD))
         if self.defender.is_defense_enabled():
-            vec, _ = self.defender.defend_matrix(
-                mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+            from ...core.security.defense import sharded
+            if (getattr(self.args, "sharded_defense", False)
+                    and sharded.supports_sharded(self.defender.defense_type)):
+                # LLM-scale path: the [K, D] matrix stays feature-sharded
+                # across the mesh; only [K, K] stats are replicated
+                vec = sharded.defend_matrix_sharded(
+                    self.mesh, AXIS_CLIENT, mat, w,
+                    self.defender.defense_type,
+                    byzantine_count=self.defender.byzantine_count,
+                    multi_k=self.defender.krum_param_m,
+                    trim_fraction=self.defender.trim_fraction)
+            else:
+                vec, _ = self.defender.defend_matrix(
+                    mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+        elif self.server_aggregator is not None:
+            # user-pluggable hook chain (reference server_aggregator.py
+            # :44/:75/:90) on the stacked matrix
+            mat2, w2 = self.server_aggregator.on_before_aggregation(
+                mat, jnp.asarray(w, jnp.float32))
+            vec = self.server_aggregator.on_after_aggregation(
+                self.server_aggregator.aggregate(mat2, w2))
         else:
             vec = weighted_mean(mat, jnp.asarray(w, jnp.float32))
         if self.contribution.enabled:

@@ -1,0 +1,67 @@
+"""Gradient/update compression (reference ``utils/compression.py``:
+top-k and random-k sparsification with index bookkeeping).
+
+TPU-native design: compressors are jit-able pure functions on flat vectors
+(dense in, (values, indices) out), so they can run inside the round program
+before a cross-DCN hop. ``compress_tree``/``decompress_tree`` lift them to
+pytrees for the WAN managers, whose payloads shrink by the sparsity factor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def topk_compress(vec: jnp.ndarray, k: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Keep the k largest-magnitude entries: returns (values[k], idx[k])."""
+    k = max(min(int(k), vec.shape[0]), 1)
+    _, idx = jax.lax.top_k(jnp.abs(vec), k)
+    return vec[idx], idx.astype(jnp.int32)
+
+
+def randk_compress(vec: jnp.ndarray, k: int, rng: jax.Array,
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Keep k uniformly-random entries, unbiased-rescaled by d/k so the
+    expected decompressed vector equals the input."""
+    d = vec.shape[0]
+    k = max(min(int(k), d), 1)
+    idx = jax.random.choice(rng, d, shape=(k,), replace=False).astype(
+        jnp.int32)
+    return vec[idx] * (d / k), idx
+
+
+def decompress(values: jnp.ndarray, idx: jnp.ndarray, d: int) -> jnp.ndarray:
+    return jnp.zeros(d, values.dtype).at[idx].set(values)
+
+
+def compress_tree(tree: PyTree, ratio: float, method: str = "topk",
+                  rng: jax.Array = None) -> Dict[str, Any]:
+    """Flatten a pytree and sparsify to ``ratio`` of its entries; the
+    result is a wire-friendly dict (values, indices, length)."""
+    from ..core.collectives import tree_flatten_to_vector
+    vec = tree_flatten_to_vector(tree)
+    d = vec.shape[0]
+    k = max(int(d * float(ratio)), 1)
+    if method == "topk":
+        vals, idx = topk_compress(vec, k)
+    elif method in ("randk", "random_k"):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        vals, idx = randk_compress(vec, k, rng)
+    else:
+        raise ValueError(f"unknown compression method {method!r} "
+                         f"(topk, randk)")
+    return {"values": vals, "indices": idx, "length": d}
+
+
+def decompress_tree(blob: Dict[str, Any], template: PyTree) -> PyTree:
+    from ..core.collectives import vector_to_tree_like
+    vec = decompress(jnp.asarray(blob["values"]),
+                     jnp.asarray(blob["indices"]), int(blob["length"]))
+    return vector_to_tree_like(vec, template)

@@ -12,6 +12,8 @@ from fedml_tpu import model as model_mod
 from fedml_tpu.arguments import Arguments
 from fedml_tpu.cross_silo.horizontal.runner import run_cross_silo_inproc
 
+pytestmark = __import__('pytest').mark.slow
+
 
 def make_args(**kw):
     base = dict(dataset="synthetic_mnist", model="lr",

@@ -17,6 +17,8 @@ from fedml_tpu.llm.attention import (
     ring_axis,
 )
 
+pytestmark = pytest.mark.slow
+
 CFG = LLMConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
                 num_layers=2, num_heads=4, max_seq_len=32)
 

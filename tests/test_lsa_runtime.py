@@ -13,6 +13,8 @@ from fedml_tpu.cross_silo.horizontal.runner import run_cross_silo_inproc
 from fedml_tpu.cross_silo.lightsecagg import (LSAClientManager,
                                               run_lsa_inproc)
 
+pytestmark = __import__('pytest').mark.slow
+
 
 def make_args(**kw):
     base = dict(dataset="synthetic_mnist", model="lr",

@@ -10,6 +10,8 @@ import fedml_tpu
 from fedml_tpu.arguments import Arguments
 from fedml_tpu.optimizers import available_optimizers
 
+pytestmark = __import__('pytest').mark.slow
+
 OPTIMIZERS = ["FedAvg", "FedProx", "FedOpt", "FedSGD", "FedLocalSGD",
               "SCAFFOLD", "FedNova", "FedDyn", "Mime"]
 

@@ -7,6 +7,8 @@ import pytest
 import fedml_tpu
 from fedml_tpu.arguments import Arguments
 
+pytestmark = __import__('pytest').mark.slow
+
 
 def make_args(**kw):
     base = dict(dataset="synthetic_mnist", model="lr",

@@ -11,6 +11,8 @@ from fedml_tpu.arguments import Arguments
 from fedml_tpu.cross_silo.horizontal.runner import run_cross_silo_inproc
 from fedml_tpu.cross_silo.secagg import run_secagg_inproc
 
+pytestmark = __import__('pytest').mark.slow
+
 
 def make_args(**kw):
     base = dict(dataset="synthetic_mnist", model="lr",
@@ -58,7 +60,7 @@ def test_secagg_dropout_recovery():
         def on_train(self, msg):
             return  # dead silo: participated in setup, never trains
 
-    args = make_args(comm_round=2, round_timeout_s=3.0)
+    args = make_args(comm_round=2, round_timeout_s=10.0)
     fed, output_dim = data_mod.load(args)
     bundle = model_mod.create(args, output_dim)
 

@@ -150,3 +150,112 @@ class TestGradientInversion:
         cos = np.dot(rec, truth) / (np.linalg.norm(rec) * np.linalg.norm(truth))
         assert cos > 0.8, cos
         assert int(np.argmax(np.asarray(out["y_logits"][0]))) == 3
+
+
+class TestNewAttacksDefenses:
+    def test_lazy_worker_attack_filtered_by_wbc(self):
+        import fedml_tpu
+        args = Arguments(dataset="synthetic_mnist", model="lr",
+                         client_num_in_total=8, client_num_per_round=8,
+                         comm_round=4, batch_size=32, learning_rate=0.1,
+                         random_seed=2, enable_attack=True,
+                         attack_type="lazy_worker", byzantine_client_num=3,
+                         enable_defense=True, defense_type="wbc",
+                         frequency_of_the_test=3)
+        r = fedml_tpu.run_simulation(backend="tpu", args=args)
+        assert r["final_test_acc"] > 0.55, r["history"]
+
+    def test_backdoor_poisons_data_and_krum_defends(self):
+        import fedml_tpu
+        base = dict(dataset="synthetic_mnist", model="lr",
+                    client_num_in_total=8, client_num_per_round=8,
+                    comm_round=4, batch_size=32, learning_rate=0.1,
+                    random_seed=2, enable_attack=True,
+                    attack_type="backdoor", byzantine_client_num=2,
+                    backdoor_target_label=0, frequency_of_the_test=3)
+        r = fedml_tpu.run_simulation(
+            backend="tpu", args=Arguments(enable_defense=True,
+                                          defense_type="krum", **base))
+        assert r["final_test_acc"] > 0.5, r["history"]
+
+    def test_backdoor_stamp_shapes(self):
+        from fedml_tpu.core.security.attack import backdoor_stamp
+        flat = np.zeros((5, 784), np.float32)
+        out = backdoor_stamp(flat)
+        assert out[:, :9].min() == 1.0 and out[:, 9:].max() == 0.0
+        img = np.zeros((5, 8, 8, 3), np.float32)
+        out = backdoor_stamp(img)
+        assert out[:, :3, :3, :].min() == 1.0
+        assert out[:, 3:, 3:, :].max() == 0.0
+
+    def test_soteria_and_cross_round_run(self):
+        from fedml_tpu.core.security.defense import FedMLDefender
+        rs = np.random.RandomState(0)
+        mat = jnp.asarray(rs.randn(6, 40).astype(np.float32))
+        w = jnp.ones(6)
+        for d in ("soteria", "wbc"):
+            dfd = FedMLDefender(Arguments(enable_defense=True,
+                                          defense_type=d))
+            vec, _ = dfd.defend_matrix(mat, w)
+            assert vec.shape == (40,) and np.isfinite(np.asarray(vec)).all()
+        # cross_round: an oscillating client is dropped in round 2
+        dfd = FedMLDefender(Arguments(enable_defense=True,
+                                      defense_type="cross_round"))
+        ids = np.arange(6)
+        v1, _ = dfd.defend_matrix(mat, w, client_ids=ids)
+        flip = mat.at[0].set(-mat[0])  # client 0 reverses direction
+        v2, info = dfd.defend_matrix(flip, w, client_ids=ids)
+        assert float(info["kept"]) == 5.0
+
+
+class TestShardedDefense:
+    def test_sharded_matches_host(self):
+        """Feature-sharded defense == host defense on an 8-device mesh."""
+        import jax
+        from fedml_tpu.core.mesh import build_mesh
+        from fedml_tpu.core.security.defense import sharded
+        from fedml_tpu.core.security.defense import robust_agg
+        mesh = build_mesh({"client": 8})
+        rs = np.random.RandomState(3)
+        mat = jnp.asarray(rs.randn(10, 123).astype(np.float32))
+        w = jnp.asarray(rs.rand(10).astype(np.float32) + 0.5)
+        cases = {
+            "krum": lambda: robust_agg.krum(mat, w, 2, 1)[0],
+            "multi_krum": lambda: robust_agg.krum(mat, w, 2, 3)[0],
+            "median": lambda: robust_agg.coordinate_median(mat, w)[0],
+            "trimmed_mean": lambda: robust_agg.trimmed_mean(mat, w, 0.1)[0],
+            "three_sigma": None,
+        }
+        for d, host_fn in cases.items():
+            out = sharded.defend_matrix_sharded(
+                mesh, "client", mat, w, d, byzantine_count=2, multi_k=3)
+            assert out.shape == (123,)
+            # the big axis stays sharded until we pull it
+            if host_fn is not None:
+                np.testing.assert_allclose(np.asarray(out),
+                                           np.asarray(host_fn()),
+                                           rtol=2e-4, atol=2e-5,
+                                           err_msg=d)
+
+    def test_engine_uses_sharded_defense(self):
+        import fedml_tpu
+        args = Arguments(dataset="synthetic_mnist", model="lr",
+                         client_num_in_total=8, client_num_per_round=8,
+                         comm_round=3, batch_size=32, learning_rate=0.1,
+                         random_seed=2, enable_attack=True,
+                         attack_type="byzantine_random",
+                         byzantine_client_num=2, enable_defense=True,
+                         defense_type="multi_krum", krum_param_m=3,
+                         sharded_defense=True, frequency_of_the_test=2)
+        r = fedml_tpu.run_simulation(backend="tpu", args=args)
+        assert r["final_test_acc"] > 0.55, r["history"]
+
+    def test_wbc_keeps_majority_cluster(self):
+        """Regression: wbc must aggregate the LARGER (honest) cluster."""
+        from fedml_tpu.core.security.defense.robust_agg import wbc
+        honest = np.ones((6, 10), np.float32)
+        byz = np.zeros((2, 10), np.float32)
+        mat = jnp.asarray(np.concatenate([honest, byz]))
+        vec, info = wbc(mat, jnp.ones(8))
+        assert float(info["kept"]) == 6.0
+        np.testing.assert_allclose(np.asarray(vec), np.ones(10), atol=1e-5)

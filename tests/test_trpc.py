@@ -1,0 +1,76 @@
+"""TRPC backend (torch.distributed.rpc TensorPipe): echo across two
+spawned single-rank processes (reference trpc_comm_manager.py:21)."""
+
+import multiprocessing as mp
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _rank_main(rank, port, q):
+    # fresh process: plain CPU jax/torch, independent RPC world
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["MASTER_ADDR"] = "127.0.0.1"
+    os.environ["MASTER_PORT"] = str(port)
+    import threading
+
+    from fedml_tpu.core.distributed.communication.base_com_manager import (
+        Observer)
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.core.distributed.communication.trpc import TRPCCommManager
+
+    mgr = TRPCCommManager(rank, world_size=2)
+
+    class Sink(Observer):
+        def __init__(self):
+            self.got = threading.Event()
+            self.payload = None
+
+        def receive_message(self, msg_type, msg):
+            self.payload = msg.get("payload")
+            self.got.set()
+
+    sink = Sink()
+    mgr.add_observer(sink)
+    t = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+    t.start()
+    if rank == 0:
+        msg = Message("trpc_echo", 0, 1)
+        msg.add_params("payload", [4, 5, 6])
+        mgr.send_message(msg)
+        ok = sink.got.wait(timeout=30)   # rank 1 echoes back
+        q.put(("r0", ok, sink.payload))
+    else:
+        ok = sink.got.wait(timeout=30)
+        if ok:
+            reply = Message("trpc_echo", 1, 0)
+            reply.add_params("payload", sink.payload)
+            mgr.send_message(reply)
+        q.put(("r1", ok, sink.payload))
+    import time
+    time.sleep(1.0)
+    mgr.stop_receive_message()
+
+
+def test_trpc_two_process_echo():
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = 29611
+    procs = [ctx.Process(target=_rank_main, args=(r, port, q))
+             for r in (0, 1)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(2):
+        name, ok, payload = q.get(timeout=120)
+        results[name] = (ok, payload)
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    assert results["r1"] == (True, [4, 5, 6])
+    assert results["r0"] == (True, [4, 5, 6])
