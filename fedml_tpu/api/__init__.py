@@ -234,6 +234,24 @@ def run_stop(run_id: str) -> bool:
     return True
 
 
+def run_wait(run_id: str, timeout_s: Optional[float] = None,
+             poll_s: float = 0.5, kill_on_timeout: bool = True
+             ) -> Optional[str]:
+    """Job-monitor primitive (reference ``comm_utils/job_monitor.py`` role):
+    block until the run reaches a terminal status; on timeout optionally
+    stop the run. Returns the final status."""
+    deadline = (time.time() + timeout_s) if timeout_s is not None else None
+    while True:
+        status = run_status(run_id)
+        if status not in (STATUS_RUNNING,):
+            return status
+        if deadline is not None and time.time() > deadline:
+            if kill_on_timeout:
+                run_stop(run_id)
+            return run_status(run_id)
+        time.sleep(poll_s)
+
+
 def run_list() -> List[Dict[str, Any]]:
     root = _runs_root()
     if not os.path.isdir(root):

@@ -89,6 +89,21 @@ class FedMLCommManager(Observer):
                                    getattr(self.args, "ip_config", None),
                                    int(getattr(self.args, "grpc_base_port", 0)
                                        or 29790))
+        if b in ("PUBSUB", "PUBSUB_STORAGE", "MQTT_S3_LOCAL"):
+            from .communication.pubsub import PubSubStorageCommManager
+            port = int(getattr(self.args, "pubsub_broker_port", 0) or 0)
+            if port <= 0:
+                raise ValueError(
+                    "backend PUBSUB needs args.pubsub_broker_port (the "
+                    "port of a running PubSubBroker; start one with "
+                    "fedml_tpu.core.distributed.communication.pubsub."
+                    "PubSubBroker())")
+            return PubSubStorageCommManager(
+                self.rank,
+                broker_host=str(getattr(self.args, "pubsub_broker_host",
+                                        "127.0.0.1")),
+                broker_port=port,
+                run_id=str(getattr(self.args, "run_id", "0")))
         if b == "TRPC":
             from .communication.trpc import TRPCCommManager
             return TRPCCommManager(
@@ -97,8 +112,15 @@ class FedMLCommManager(Observer):
                                         "127.0.0.1")),
                 master_port=int(getattr(self.args, "trpc_master_port", 0)
                                 or 29500))
+        if b == "MPI":
+            raise ImportError(
+                "MPI backend needs mpi4py + an MPI runtime (absent here); "
+                "INPROC covers the simulation role, TCP/GRPC/TRPC the "
+                "distributed one")
         if b in ("MQTT_S3", "MQTT_WEB3", "MQTT_THETASTORE", "MQTT_S3_MNN"):
             raise ImportError(
                 f"backend {b} needs paho-mqtt (not available in this "
-                "environment); use GRPC or TCP for WAN runs")
+                "environment); PUBSUB provides the same control/data-plane "
+                "split (broker topics + object-store payloads) over stdlib "
+                "TCP, or use GRPC/TCP")
         raise ValueError(f"unknown comm backend {b!r}")

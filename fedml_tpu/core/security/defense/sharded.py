@@ -19,7 +19,7 @@ are replicated. Parity with the host path is asserted in tests.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache
 from typing import Dict, Tuple
 
 import jax
@@ -28,10 +28,51 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import robust_agg
 
-# defenses expressible as: selection weights from psum'd pairwise dists
-# (or none), then a local weighted reduction over the feature shard
+# defenses expressible as: selection weights from psum'd statistics, then a
+# local weighted reduction over the feature shard. three_sigma uses
+# distance-to-coordinate-median + median/MAD scores exactly like the host
+# kernel (a weaker mean/std variant would let byzantine rows widen the band)
 _SHARDED = ("krum", "multi_krum", "coordinate_median", "median",
             "trimmed_mean", "mean", "three_sigma")
+
+
+@lru_cache(maxsize=32)
+def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
+                      byzantine_count: int, multi_k: int,
+                      trim_fraction: float):
+    """One compiled kernel per (mesh, defense, params); jit re-traces only
+    on new shapes — without this cache every round would recompile."""
+
+    def body(mat_s, weights):
+        # mat_s: [K, D/n] local shard
+        if defense_type in ("coordinate_median", "median"):
+            vec, _ = robust_agg.coordinate_median(mat_s, weights)
+            return vec
+        if defense_type == "trimmed_mean":
+            vec, _ = robust_agg.trimmed_mean(mat_s, weights, trim_fraction)
+            return vec
+        if defense_type == "three_sigma":
+            # host parity: score_i = ||u_i - coord_median||; keep within
+            # median(score) + 3 * 1.4826 * MAD(score)
+            med = jnp.median(mat_s, axis=0)
+            part = jnp.sum((mat_s - med[None]) ** 2, axis=1)
+            scores = jnp.sqrt(jax.lax.psum(part, axis))
+            mu = jnp.median(scores)
+            sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
+            keep = (scores <= mu + 3.0 * sd).astype(weights.dtype)
+            return robust_agg.weighted_mean(mat_s, weights * keep)
+        partial_d = robust_agg.pairwise_sq_dists(mat_s)
+        dists = jax.lax.psum(partial_d, axis)
+        sel_w = _selection_weights(defense_type, dists, weights,
+                                   byzantine_count, multi_k)
+        return robust_agg.weighted_mean(mat_s, sel_w)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P()),
+        out_specs=P(axis),
+        check_vma=False,
+    ))
 
 
 def supports_sharded(defense_type: str) -> bool:
@@ -51,12 +92,6 @@ def _selection_weights(defense_type: str, dists: jnp.ndarray,
         order = jnp.argsort(scores)
         sel = jnp.zeros(k).at[order[:m]].set(1.0)
         return sel * weights
-    if defense_type == "three_sigma":
-        # distance-to-mean z-score filter on sqrt(mean pairwise dist)
-        avg_d = jnp.sqrt(jnp.mean(dists, axis=1))
-        mu, sd = jnp.mean(avg_d), jnp.std(avg_d) + 1e-9
-        keep = (jnp.abs(avg_d - mu) <= 3.0 * sd).astype(weights.dtype)
-        return keep * weights
     return weights  # mean
 
 
@@ -76,26 +111,8 @@ def defend_matrix_sharded(
         raise ValueError(f"{defense_type!r} has no sharded path; host "
                          f"fallback required (supported: {_SHARDED})")
 
-    def body(mat_s, weights):
-        # mat_s: [K, D/n] local shard
-        if defense_type in ("coordinate_median", "median"):
-            vec, _ = robust_agg.coordinate_median(mat_s, weights)
-            return vec
-        if defense_type == "trimmed_mean":
-            vec, _ = robust_agg.trimmed_mean(mat_s, weights, trim_fraction)
-            return vec
-        partial_d = robust_agg.pairwise_sq_dists(mat_s)
-        dists = jax.lax.psum(partial_d, axis)
-        sel_w = _selection_weights(defense_type, dists, weights,
-                                   byzantine_count, multi_k)
-        return robust_agg.weighted_mean(mat_s, sel_w)
-
-    fn = jax.jit(jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(None, axis), P()),
-        out_specs=P(axis),
-        check_vma=False,
-    ))
+    fn = _build_sharded_fn(mesh, axis, defense_type, byzantine_count,
+                           multi_k, float(trim_fraction))
     n = mesh.shape[axis]
     d = mat.shape[1]
     pad = (-d) % n

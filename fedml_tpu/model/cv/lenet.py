@@ -1,0 +1,25 @@
+"""LeNet — the mobile/cross-device reference model.
+
+Parity target: the reference's MNN LeNet shipped to phones
+(``model/mobile/``, MobileNN dataset+trainer pairs) and the classic
+LeNet-5 shape. Cross-device sessions default to it for MNIST-class tasks.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(6, (5, 5), padding="SAME")(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID")(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.num_classes)(x)

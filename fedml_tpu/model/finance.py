@@ -1,0 +1,54 @@
+"""Finance / vertical-FL party models.
+
+Parity target: reference ``model/finance/`` (``vfl_classifier.py``,
+``vfl_feature_extractor.py``, ``vfl_models_standalone.py`` — per-party
+dense feature extractors + an interactive classifier for the lending-club /
+NUS-WIDE vertical tasks). TPU-native: plain flax modules; the VFL
+simulator (:mod:`fedml_tpu.simulation.sp.vertical_fl`) composes guest/host
+extractors with the interactive head, and gradients cross party boundaries
+as tensors out of one jitted backward.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VFLFeatureExtractor(nn.Module):
+    """One party's local tower over its vertical feature slice (reference
+    ``vfl_feature_extractor.py`` LocalModel)."""
+    out_dim: int = 32
+    hidden: Sequence[int] = (64,)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        for w in self.hidden:
+            h = nn.relu(nn.Dense(w)(h))
+        return nn.Dense(self.out_dim)(h)
+
+
+class VFLClassifier(nn.Module):
+    """Interactive head over concatenated party representations (reference
+    ``vfl_classifier.py`` DenseModel: a linear layer on the fused reps)."""
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, fused, train: bool = False):
+        return nn.Dense(self.num_classes)(fused)
+
+
+class LendingClubMLP(nn.Module):
+    """Tabular credit-risk MLP (the lending-club standalone baseline)."""
+    num_classes: int = 2
+    hidden: Sequence[int] = (128, 64)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.reshape((x.shape[0], -1))
+        for w in self.hidden:
+            h = nn.relu(nn.Dense(w)(h))
+        return nn.Dense(self.num_classes)(h)

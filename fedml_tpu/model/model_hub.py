@@ -83,6 +83,18 @@ def _create(args, output_dim: int):
         return ModelBundle(CNNFemnist(output_dim), name, _has_dropout=True)
     if name in ("simple_cnn", "cifar_cnn"):
         return ModelBundle(SimpleCNN(output_dim), name)
+    if name in ("lenet", "lenet5", "mnn_lenet"):
+        from .cv.lenet import LeNet5
+        return ModelBundle(LeNet5(output_dim), name)
+    if name in ("vfl_feature_extractor", "local_model"):
+        from .finance import VFLFeatureExtractor
+        return ModelBundle(VFLFeatureExtractor(out_dim=output_dim), name)
+    if name in ("vfl_classifier", "dense_model"):
+        from .finance import VFLClassifier
+        return ModelBundle(VFLClassifier(output_dim), name)
+    if name in ("lending_club_mlp", "finance_mlp"):
+        from .finance import LendingClubMLP
+        return ModelBundle(LendingClubMLP(output_dim), name)
     if name.startswith("resnet"):
         from .cv.resnet import create_resnet
         return ModelBundle(create_resnet(name, output_dim), name)

@@ -13,6 +13,7 @@ from .constants import (
     FEDML_TRAINING_PLATFORM_CROSS_DEVICE,
     FEDML_TRAINING_PLATFORM_CROSS_SILO,
     FEDML_TRAINING_PLATFORM_CROSS_CLOUD,
+    FEDML_TRAINING_PLATFORM_SERVING,
     FEDML_TRAINING_PLATFORM_SIMULATION,
 )
 
@@ -51,6 +52,11 @@ class FedMLRunner:
         if ttype == FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
             from .cross_device.runner import build_cross_device_runner
             return build_cross_device_runner(args, self.dataset, self.model)
+        if ttype == FEDML_TRAINING_PLATFORM_SERVING:
+            from .serving.federated import FederatedServingRunner
+            return FederatedServingRunner(
+                args, self.dataset, self.model,
+                self.client_trainer, self.server_aggregator)
         raise ValueError(f"unknown training_type {ttype!r}")
 
     def _build_simulator(self, args):

@@ -1,0 +1,54 @@
+"""Federated serving runner — ``training_type: fedml_serving``.
+
+Parity target: reference ``serving/client/*`` + ``serving/server/*`` and
+the ``runner.py:137`` dispatch: a federated session whose END STATE is a
+live model endpoint — silos fine-tune collaboratively, the server
+aggregates and then serves the resulting global model.
+
+Composition over new machinery: the training phase IS the cross-silo
+runtime; this runner chains it with :class:`FedMLInferenceRunner` so the
+aggregated params go live the moment the session finishes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class FederatedServingRunner:
+    """role=server: run the FL session, then serve the aggregate over HTTP
+    (blocking unless ``serving_block: false``); role=client: plain silo."""
+
+    def __init__(self, args, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        from ..cross_silo.horizontal.runner import CrossSiloRunner
+        self.args = args
+        self.fed = dataset
+        self.bundle = model
+        self.role = str(getattr(args, "role", "client")).lower()
+        self.inner = CrossSiloRunner(args, dataset, model, client_trainer,
+                                     server_aggregator)
+        self.inference_runner = None
+
+    def run(self, comm_round: Optional[int] = None) -> Any:
+        result = self.inner.run(comm_round)
+        if self.role != "server" or not isinstance(result, dict):
+            return result
+        from . import CheckpointPredictor, FedMLInferenceRunner
+        predictor = CheckpointPredictor(self.bundle, result["params"])
+        port = int(getattr(self.args, "serving_port", 0) or 0)
+        self.inference_runner = FedMLInferenceRunner(predictor, port=port)
+        block = bool(getattr(self.args, "serving_block", False))
+        if block:
+            logger.info("federated serving: endpoint on :%d",
+                        self.inference_runner.port)
+            self.inference_runner.run()
+        else:
+            self.inference_runner.start()
+            logger.info("federated serving: endpoint live on :%d",
+                        self.inference_runner.port)
+        result["serving_port"] = self.inference_runner.port
+        return result

@@ -99,3 +99,54 @@ def test_bf16_precision_path():
     r = fedml_tpu.run_simulation(backend="tpu", args=args)
     assert np.isfinite(r["final_test_acc"])
     assert r["final_test_acc"] > 0.3
+
+
+def test_lenet_and_finance_models_forward():
+    import jax
+    import jax.numpy as jnp
+    for name, shape, out in (("lenet", (2, 28, 28, 1), 10),
+                             ("vfl_feature_extractor", (2, 30), 16),
+                             ("vfl_classifier", (2, 48), 2),
+                             ("lending_club_mlp", (2, 90), 2)):
+        bundle = create(Arguments(model=name), out)
+        x = jnp.zeros(shape, jnp.float32)
+        params = bundle.init(jax.random.PRNGKey(0), x)
+        assert bundle.apply(params, x).shape == (2, out)
+
+
+def test_federated_serving_session(tmp_path):
+    """training_type=fedml_serving: FL session ends with a live endpoint."""
+    import json
+    import threading
+    import urllib.request
+    from fedml_tpu import data as data_mod
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_silo.horizontal.runner import build_client
+    from fedml_tpu.runner import FedMLRunner
+    args = Arguments(dataset="synthetic_mnist", model="lr",
+                     client_num_in_total=2, client_num_per_round=2,
+                     comm_round=2, epochs=1, batch_size=32,
+                     learning_rate=0.1, frequency_of_the_test=1,
+                     random_seed=7, training_type="fedml_serving",
+                     role="server", backend="INPROC")
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    fed, output_dim = data_mod.load(args)
+    bundle = create(args, output_dim)
+    clients = [build_client(args, fed, bundle, rank=r, backend="INPROC")
+               for r in (1, 2)]
+    for c in clients:
+        threading.Thread(target=c.run, daemon=True).start()
+    runner = FedMLRunner(args, dataset=fed, model=bundle)
+    result = runner.run()
+    assert result["final_test_acc"] > 0.6
+    port = result["serving_port"]
+    x = np.zeros((1, 784), np.float32).tolist()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"inputs": x}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        out = json.load(r)
+    assert len(out["outputs"][0]) == 10
+    runner.runner.inference_runner.stop()

@@ -192,3 +192,19 @@ class TestDiagnosis:
         assert set(report) == {"device", "grpc", "tcp"}
         for name, (ok, detail) in report.items():
             assert ok, f"{name}: {detail}"
+
+
+def test_run_wait_timeout_kills(runs_dir, tmp_path):
+    """Job-monitor: a hung job is stopped when the wait deadline passes."""
+    job = tmp_path / "job.yaml"
+    job.write_text("job: sleep 120\n")
+    res = api.launch_job(str(job))
+    status = api.run_wait(res.run_id, timeout_s=2.0)
+    assert status == api.STATUS_KILLED
+
+
+def test_run_wait_returns_finished(runs_dir, tmp_path):
+    job = tmp_path / "job.yaml"
+    job.write_text("job: echo done\n")
+    res = api.launch_job(str(job))
+    assert api.run_wait(res.run_id, timeout_s=30.0) == api.STATUS_FINISHED

@@ -224,7 +224,7 @@ class TestShardedDefense:
             "multi_krum": lambda: robust_agg.krum(mat, w, 2, 3)[0],
             "median": lambda: robust_agg.coordinate_median(mat, w)[0],
             "trimmed_mean": lambda: robust_agg.trimmed_mean(mat, w, 0.1)[0],
-            "three_sigma": None,
+            "three_sigma": lambda: robust_agg.three_sigma(mat, w)[0],
         }
         for d, host_fn in cases.items():
             out = sharded.defend_matrix_sharded(
