@@ -1,0 +1,207 @@
+"""Serving autoscaler: policies + replica set + scale-out gateway.
+
+Parity target: reference ``model_scheduler/autoscaler/autoscaler.py``
+(policy classes :20,70,135,186 — EWM of QPS, concurrency, traffic
+lookback — consulted by the deploy agents to resize endpoint replicas)
+and the inference gateway (``device_model_inference.py``). Local-first
+redesign: replicas are in-process :class:`FedMLInferenceRunner` instances
+(the docker-container analogue without a container runtime); the
+:class:`Gateway` fronts them with round-robin dispatch and records the
+QPS/latency series the policies consume; :class:`Autoscaler` applies a
+policy on a cadence and grows/shrinks the replica set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------- policies ----
+
+@dataclasses.dataclass
+class EWMPolicy:
+    """Exponentially-weighted moving average of per-replica QPS (reference
+    ``EWMPolicy`` :70): scale so that EWM(qps)/replica stays under
+    ``target_qps_per_replica``."""
+    target_qps_per_replica: float = 10.0
+    alpha: float = 0.5
+    _ewm: Optional[float] = None
+
+    def desired_replicas(self, qps: float, latency_s: float,
+                         current: int) -> int:
+        self._ewm = (qps if self._ewm is None
+                     else self.alpha * qps + (1 - self.alpha) * self._ewm)
+        return max(1, math.ceil(self._ewm / self.target_qps_per_replica))
+
+
+@dataclasses.dataclass
+class ConcurrencyPolicy:
+    """Little's-law concurrency policy (reference ``ConcurrentQueryPolicy``
+    :135): in-flight = qps x latency; one replica sustains
+    ``target_concurrency``."""
+    target_concurrency: float = 4.0
+
+    def desired_replicas(self, qps: float, latency_s: float,
+                         current: int) -> int:
+        inflight = qps * max(latency_s, 1e-6)
+        return max(1, math.ceil(inflight / self.target_concurrency))
+
+
+@dataclasses.dataclass
+class LookbackPolicy:
+    """Scale on the max QPS seen in a trailing window (reference
+    ``MeetTrafficDemandPolicy`` :186 shape): headroom for bursts."""
+    target_qps_per_replica: float = 10.0
+    window: int = 10
+    _hist: Deque[float] = dataclasses.field(default_factory=deque)
+
+    def desired_replicas(self, qps: float, latency_s: float,
+                         current: int) -> int:
+        self._hist.append(qps)
+        while len(self._hist) > self.window:
+            self._hist.popleft()
+        peak = max(self._hist)
+        return max(1, math.ceil(peak / self.target_qps_per_replica))
+
+
+# ---------------------------------------------------------- replica set ----
+
+class ReplicaSet:
+    """N live inference runners over one predictor-factory (the
+    container-fleet analogue; ``scale_to`` is the rolling update)."""
+
+    def __init__(self, predictor_factory, min_replicas: int = 1,
+                 max_replicas: int = 8):
+        from . import FedMLInferenceRunner
+        self._runner_cls = FedMLInferenceRunner
+        self.predictor_factory = predictor_factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.replicas: List = []
+        self._lock = threading.Lock()
+        self.scale_to(self.min_replicas)
+
+    def scale_to(self, n: int) -> int:
+        n = min(max(n, self.min_replicas), self.max_replicas)
+        with self._lock:
+            while len(self.replicas) < n:
+                runner = self._runner_cls(self.predictor_factory())
+                runner.start()
+                self.replicas.append(runner)
+                logger.info("replica up on :%d (%d total)", runner.port,
+                            len(self.replicas))
+            while len(self.replicas) > n:
+                runner = self.replicas.pop()
+                runner.stop()
+                logger.info("replica down (%d left)", len(self.replicas))
+        return n
+
+    def ports(self) -> List[int]:
+        with self._lock:
+            return [r.port for r in self.replicas]
+
+    def stop(self) -> None:
+        with self._lock:
+            for r in self.replicas:
+                r.stop()
+            self.replicas.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.replicas)
+
+
+# -------------------------------------------------------------- gateway ----
+
+class Gateway:
+    """Round-robin HTTP front over a ReplicaSet that records the
+    QPS/latency series policies consume (reference inference gateway)."""
+
+    def __init__(self, replica_set: ReplicaSet, window_s: float = 5.0):
+        self.replica_set = replica_set
+        self.window_s = float(window_s)
+        self._i = 0
+        self._lock = threading.Lock()
+        self._events: Deque[Tuple[float, float]] = deque()  # (ts, latency)
+
+    def predict(self, request: dict, timeout: float = 30.0) -> dict:
+        ports = self.replica_set.ports()
+        if not ports:
+            raise RuntimeError("no live replicas")
+        with self._lock:
+            port = ports[self._i % len(ports)]
+            self._i += 1
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps(request).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            out = json.load(r)
+        dt = time.perf_counter() - t0
+        now = time.time()
+        with self._lock:
+            self._events.append((now, dt))
+            cutoff = now - self.window_s
+            while self._events and self._events[0][0] < cutoff:
+                self._events.popleft()
+        return out
+
+    def metrics(self) -> Tuple[float, float]:
+        """(qps, mean latency seconds) over the trailing window."""
+        now = time.time()
+        with self._lock:
+            cutoff = now - self.window_s
+            while self._events and self._events[0][0] < cutoff:
+                self._events.popleft()
+            n = len(self._events)
+            lat = (sum(l for _, l in self._events) / n) if n else 0.0
+        return n / self.window_s, lat
+
+
+# ------------------------------------------------------------ autoscaler ----
+
+class Autoscaler:
+    """Applies a policy on a cadence (reference autoscaler daemon loop)."""
+
+    def __init__(self, gateway: Gateway, policy, interval_s: float = 1.0):
+        self.gateway = gateway
+        self.policy = policy
+        self.interval_s = float(interval_s)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self) -> int:
+        """One evaluation: metrics -> desired -> scale. Returns the new
+        replica count (also usable directly, without the daemon thread)."""
+        qps, lat = self.gateway.metrics()
+        desired = self.policy.desired_replicas(
+            qps, lat, len(self.gateway.replica_set))
+        return self.gateway.replica_set.scale_to(desired)
+
+    def start(self) -> None:
+        self._running = True
+
+        def loop():
+            while self._running:
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 — daemon must survive
+                    logger.exception("autoscaler step failed")
+                time.sleep(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
