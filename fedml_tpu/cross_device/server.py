@@ -92,6 +92,11 @@ class DeviceServerManager(FedMLCommManager):
         self.history = []
         self.result: Optional[dict] = None
         self._lock = threading.Lock()
+        # elastic rounds (mirrors the cross-silo server): a dead device
+        # must not stall the all-received barrier forever
+        self.round_timeout_s = float(getattr(args, "round_timeout_s", 0)
+                                     or 0)
+        self._timer: Optional[threading.Timer] = None
 
     # --- FSM ---------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -147,8 +152,38 @@ class DeviceServerManager(FedMLCommManager):
                 did, msg.get(DeviceMessage.ARG_MODEL_FILE),
                 float(msg.get(DeviceMessage.ARG_NUM_SAMPLES, 1.0)))
             if not self.aggregator.all_received():
+                if (self.round_timeout_s > 0
+                        and len(self.aggregator.model_files) == 1):
+                    this_round = self.round_idx
+                    self._timer = threading.Timer(
+                        self.round_timeout_s,
+                        lambda: self._on_round_timeout(this_round))
+                    self._timer.daemon = True
+                    self._timer.start()
                 return
-            self.aggregator.aggregate()
+            self._finish_collect_locked()
+        self._advance_round()
+
+    def _on_round_timeout(self, armed_round: int) -> None:
+        with self._lock:
+            if (self.round_idx != armed_round
+                    or not self.aggregator.model_files):
+                return  # round completed normally in the meantime
+            logger.warning(
+                "device server round %d: timeout with %d/%d device models "
+                "— aggregating the devices that reported", self.round_idx,
+                len(self.aggregator.model_files),
+                self.aggregator.client_num)
+            self._finish_collect_locked()
+        self._advance_round()
+
+    def _finish_collect_locked(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self.aggregator.aggregate()
+
+    def _advance_round(self) -> None:
         stats = self.aggregator.test_on_server()
         rec = {"round": self.round_idx}
         if stats:

@@ -113,3 +113,50 @@ class TestNativeCore:
                           agg.astype(np.int64))
         recovered = signed.astype(np.float64) / scale
         np.testing.assert_allclose(recovered, sum(vs), atol=1e-3)
+
+
+def test_dead_device_does_not_stall_round(tmp_path):
+    """Elastic rounds (capability beyond the reference's cross-device
+    server): a device that dies after registration must not hang the
+    all-received barrier — the round aggregates the reporters."""
+    import threading
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_device import (DeviceClientManager,
+                                        build_device_client,
+                                        build_device_server)
+
+    class DeadDevice(DeviceClientManager):
+        def handle_round(self, msg):
+            self.finish()  # dies before training/uploading
+
+    args = make_args(comm_round=2, round_timeout_s=12.0,
+                     model_file_cache_dir=str(tmp_path))
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    server = build_device_server(args, fed, bundle, backend="INPROC")
+    devices = [build_device_client(args, fed, bundle, device_id=i,
+                                   backend="INPROC") for i in (1, 2)]
+    from fedml_tpu.core.algframe.client_trainer import make_trainer_spec
+    from fedml_tpu.optimizers.registry import create_optimizer
+    spec = make_trainer_spec(fed, bundle)
+    dead = DeadDevice(args, fed, bundle, spec,
+                      create_optimizer(args, spec), device_id=3,
+                      backend="INPROC")
+    threads = [threading.Thread(target=d.run, daemon=True)
+               for d in devices + [dead]]
+    for t in threads:
+        t.start()
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=120)
+    assert done.get("ok"), "server stalled on the dead device"
+    assert len(server.result["history"]) == 2
+    assert server.result["final_test_acc"] > 0.5
