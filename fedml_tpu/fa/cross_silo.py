@@ -1,0 +1,142 @@
+"""FA over the WAN FSM — cross-silo federated analytics.
+
+Parity target: reference ``fa/cross_silo/`` (the FL cross-silo skeleton
+minus models: server broadcasts the round's init message, clients run
+``local_analyze`` on their raw local data and ship a *submission*, the
+server folds submissions with the ``FAServerAggregator``). Transport is
+any ``FedMLCommManager`` backend; the in-proc session helper mirrors the
+FL one so an analytics session is testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+
+logger = logging.getLogger(__name__)
+
+
+class FAMessage:
+    C2S_ONLINE = "fa_online"
+    S2C_INIT = "fa_init"          # round start: init_msg + round idx
+    C2S_SUBMISSION = "fa_submission"
+    S2C_FINISH = "fa_finish"
+
+    KEY_INIT = "init_msg"
+    KEY_ROUND = "round"
+    KEY_SUBMISSION = "submission"
+
+
+class FAClientManager(FedMLCommManager):
+    """One analytics party: raw local data + a client analyzer."""
+
+    def __init__(self, args, analyzer, local_data: Sequence, comm=None,
+                 rank: int = 1, size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.analyzer = analyzer
+        self.local_data = local_data
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(FAMessage.S2C_INIT,
+                                              self.on_init)
+        self.register_message_receive_handler(FAMessage.S2C_FINISH,
+                                              self.on_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.send_message(Message(FAMessage.C2S_ONLINE, self.rank, 0))
+        self.com_manager.handle_receive_message()
+
+    def on_init(self, msg: Message) -> None:
+        self.analyzer.set_init_msg(msg.get(FAMessage.KEY_INIT))
+        submission = self.analyzer.local_analyze(self.local_data, self.args)
+        out = Message(FAMessage.C2S_SUBMISSION, self.rank, 0)
+        out.add_params(FAMessage.KEY_SUBMISSION, submission)
+        out.add_params(FAMessage.KEY_ROUND, msg.get(FAMessage.KEY_ROUND))
+        self.send_message(out)
+
+    def on_finish(self, msg: Message) -> None:
+        self.finish()
+
+
+class FAServerManager(FedMLCommManager):
+    def __init__(self, args, aggregator, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.n_clients = size - 1
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self.online: Dict[int, bool] = {}
+        self.submissions: List[Any] = []
+        self.history: List[Any] = []
+        self.result: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._started = False
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(FAMessage.C2S_ONLINE,
+                                              self.on_online)
+        self.register_message_receive_handler(FAMessage.C2S_SUBMISSION,
+                                              self.on_submission)
+
+    def on_online(self, msg: Message) -> None:
+        self.online[msg.get_sender_id()] = True
+        if len(self.online) >= self.n_clients and not self._started:
+            self._started = True
+            self._start_round()
+
+    def _start_round(self) -> None:
+        init_msg = self.aggregator.get_init_msg()
+        for rank in sorted(self.online):
+            out = Message(FAMessage.S2C_INIT, 0, rank)
+            out.add_params(FAMessage.KEY_INIT, init_msg)
+            out.add_params(FAMessage.KEY_ROUND, self.round_idx)
+            self.send_message(out)
+
+    def on_submission(self, msg: Message) -> None:
+        with self._lock:
+            self.submissions.append(msg.get(FAMessage.KEY_SUBMISSION))
+            if len(self.submissions) < self.n_clients:
+                return
+            subs, self.submissions = self.submissions, []
+        result = self.aggregator.aggregate(subs)
+        self.history.append(result)
+        logger.info("fa server round %d done", self.round_idx)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            for rank in sorted(self.online):
+                self.send_message(Message(FAMessage.S2C_FINISH, 0, rank))
+            self.result = {"result": self.aggregator.get_server_data(),
+                           "history": self.history,
+                           "rounds": self.round_num}
+            self.finish()
+            return
+        self._start_round()
+
+
+def run_fa_cross_silo_inproc(args, client_datas: Sequence[Sequence],
+                             analyzer_factory, aggregator) -> Dict[str, Any]:
+    """Server + one FA client per data shard as threads over the in-proc
+    broker (the FL session helper's analytics twin)."""
+    from ..core.distributed.communication.inproc import InProcBroker
+
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    n = len(client_datas)
+    server = FAServerManager(args, aggregator, rank=0, size=n + 1,
+                             backend="INPROC")
+    clients = [FAClientManager(args, analyzer_factory(), client_datas[i],
+                               rank=i + 1, size=n + 1, backend="INPROC")
+               for i in range(n)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30.0)
+    return server.result

@@ -60,3 +60,21 @@ def test_triehh_finds_heavy_hitters():
     found = set(out["result"])
     assert "the" in found and "cat" in found
     assert "zebra" not in found
+
+
+def test_fa_cross_silo_session_matches_sim():
+    """FA over the WAN FSM (reference fa/cross_silo/): the session's
+    aggregate equals the in-process simulator's on the same shards."""
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.fa.analyzers import AvgAggregator, AvgClientAnalyzer
+    from fedml_tpu.fa.cross_silo import run_fa_cross_silo_inproc
+
+    datas = client_values()
+    args = Arguments(comm_round=1, client_num_per_round=4,
+                     training_type="cross_silo")
+    out = run_fa_cross_silo_inproc(args, datas,
+                                   analyzer_factory=AvgClientAnalyzer,
+                                   aggregator=AvgAggregator())
+    pooled = np.concatenate(datas)
+    assert abs(out["result"] - pooled.mean()) < 1e-9
+    assert out["rounds"] == 1
