@@ -72,6 +72,12 @@ def ppermute_tree(tree: PyTree, perm, axis_name: str = AXIS_CLIENT) -> PyTree:
         lambda x: jax.lax.ppermute(x, axis_name, perm), tree)
 
 
+def stack_trees(trees) -> PyTree:
+    """List of same-structure pytrees -> one pytree with a leading stacked
+    axis (the host-side input shape of :func:`tree_weighted_average`)."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *trees)
+
+
 def tree_weighted_average(stacked: PyTree, weights: jnp.ndarray) -> PyTree:
     """Host/golden-loop aggregation: leaves have a leading client axis;
     returns the weighted average (``FedMLAggOperator.agg``,

@@ -10,25 +10,10 @@ from typing import Any, Dict, Optional
 import jax
 
 from ..core.algframe.client_trainer import make_trainer_spec
-from ..core.algframe.local_training import evaluate
+from ..cross_silo.horizontal.runner import _make_eval_fn
 from ..optimizers.registry import create_optimizer
 from .client import DeviceClientManager
 from .server import DeviceAggregator, DeviceServerManager
-
-
-def _make_eval_fn(spec, fed):
-    import jax.numpy as jnp
-
-    ev = jax.jit(lambda p: evaluate(spec, jax.tree_util.tree_map(
-        jnp.asarray, p), fed.test["x"], fed.test["y"], fed.test["mask"]))
-
-    def eval_fn(params):
-        stats = ev(params)
-        n = max(float(stats["count"]), 1.0)
-        return {"test_acc": float(stats["correct"]) / n,
-                "test_loss": float(stats["loss_sum"]) / n}
-
-    return eval_fn
 
 
 def build_device_server(args, fed, bundle, backend: Optional[str] = None):

@@ -23,10 +23,10 @@ class FedMLAggOperator:
 
     @staticmethod
     def agg(args, raw_grad_list):
-        import jax
         import jax.numpy as jnp
+
+        from ..core.collectives import stack_trees
         weights = jnp.asarray([float(n) for n, _ in raw_grad_list],
                               jnp.float32)
-        stacked = jax.tree_util.tree_map(
-            lambda *ls: jnp.stack(ls), *[p for _, p in raw_grad_list])
+        stacked = stack_trees([p for _, p in raw_grad_list])
         return tree_weighted_average(stacked, weights)

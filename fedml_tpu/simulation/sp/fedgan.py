@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ...core.collectives import tree_weighted_average
+from ...core.collectives import stack_trees, tree_weighted_average
 
 logger = logging.getLogger(__name__)
 
@@ -138,10 +138,8 @@ class FedGANSimulator:
                 d_losses.append(float(losses["d_loss"]))
                 g_losses.append(float(losses["g_loss"]))
             w = jnp.asarray(weights, jnp.float32)
-            stack = lambda trees: jax.tree_util.tree_map(
-                lambda *ls: jnp.stack(ls), *trees)
-            self.gen_params = tree_weighted_average(stack(gps), w)
-            self.disc_params = tree_weighted_average(stack(dps), w)
+            self.gen_params = tree_weighted_average(stack_trees(gps), w)
+            self.disc_params = tree_weighted_average(stack_trees(dps), w)
             rec = {"round": r, "d_loss": sum(d_losses) / len(d_losses),
                    "g_loss": sum(g_losses) / len(g_losses),
                    "disc_acc": self._disc_real_vs_fake_acc()}

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ...core.collectives import tree_weighted_average
+from ...core.collectives import stack_trees, tree_weighted_average
 
 logger = logging.getLogger(__name__)
 
@@ -167,9 +167,7 @@ class FedNASSimulator:
                 weights.append(float(cdata.num_samples))
                 losses.append(float(loss))
             w = jnp.asarray(weights, jnp.float32)
-            stack = lambda trees: jax.tree_util.tree_map(
-                lambda *ls: jnp.stack(ls), *trees)
-            self.params = tree_weighted_average(stack(ps), w)
+            self.params = tree_weighted_average(stack_trees(ps), w)
             self.alphas = tree_weighted_average(jnp.stack(als), w)
             acc = self._evaluate()
             rec = {"round": r, "train_loss": float(np.mean(losses)),

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ...core.collectives import tree_weighted_average
+from ...core.collectives import stack_trees, tree_weighted_average
 
 logger = logging.getLogger(__name__)
 
@@ -131,9 +131,7 @@ class FedSegSimulator:
                 weights.append(float(cdata.num_samples))
                 losses.append(float(loss))
             w = jnp.asarray(weights, jnp.float32)
-            stacked = jax.tree_util.tree_map(
-                lambda *ls: jnp.stack(ls), *ps)
-            self.params = tree_weighted_average(stacked, w)
+            self.params = tree_weighted_average(stack_trees(ps), w)
             score = self._evaluate()
             rec = {"round": r, "train_loss": float(np.mean(losses)),
                    "miou": score, "test_acc": score}

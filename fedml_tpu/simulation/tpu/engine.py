@@ -198,6 +198,50 @@ class TPUSimulator:
             zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
                             "count": jnp.float32(0)}
 
+            def run_slot(states, li, active):
+                """Train one schedule slot (shared by the scan and vmap
+                paths — any drift between them would silently break their
+                bit-exact parity). CDP soundness note: the per-client
+                sensitivity bound (clip) must hold before aggregation even
+                though noise is added centrally."""
+                cdata = jax.tree_util.tree_map(lambda a: a[li], local_data)
+                cstate = jax.tree_util.tree_map(lambda a: a[li], states)
+                gcid = dev * cpd + li
+                key = jax.random.fold_in(round_key, gcid)
+                out = opt.local_train(params, server_state, cstate, cdata,
+                                      key, hyper)
+                upd = out.update
+                if dp.is_local_dp_enabled():
+                    upd = dp.add_local_noise(
+                        upd, jax.random.fold_in(key, DP_LDP_FOLD))
+                elif dp.is_global_dp_enabled():
+                    upd = dp.clip_update(upd)
+                w = out.weight * active
+                return upd, out.extras, w, out.metrics, out.client_state
+
+            def finish(states, acc_u, acc_ex, acc_w, acc_m):
+                """The FedAvg collective (pre-scaled SUM-reduce over
+                clients) + central DP + server transform."""
+                total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
+                denom = jnp.maximum(total_w, 1e-12)
+                agg_update = jax.tree_util.tree_map(
+                    lambda x: x / denom.astype(x.dtype), psum_tree(acc_u))
+                agg_extras = jax.tree_util.tree_map(
+                    lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
+                metrics = psum_tree(acc_m)
+                if dp.is_global_dp_enabled():
+                    agg_update = dp.add_global_noise(
+                        agg_update, jax.random.fold_in(round_key,
+                                                       DP_CDP_FOLD))
+                new_params, new_server_state = opt.server_update(
+                    params, server_state, agg_update, agg_extras,
+                    hyper.round_idx)
+                states = jax.tree_util.tree_map(lambda a: a[None], states)
+                return new_params, new_server_state, states, metrics
+
+            init = (local_states, zero_update, zero_extras,
+                    jnp.float32(0), zero_metrics)
+
             if vmap_mode:
                 s_total = sched_idx.shape[0]
                 chunk = max(min(vmap_chunk, s_total), 1)
@@ -214,28 +258,11 @@ class TPUSimulator:
                 chunks_idx = pad_idx.reshape(n_chunks, chunk)
                 chunks_act = pad_act.reshape(n_chunks, chunk)
 
-                def one_slot(states, li, active):
-                    cdata = jax.tree_util.tree_map(lambda a: a[li],
-                                                   local_data)
-                    cstate = jax.tree_util.tree_map(lambda a: a[li], states)
-                    gcid = dev * cpd + li
-                    key = jax.random.fold_in(round_key, gcid)
-                    out = opt.local_train(params, server_state, cstate,
-                                          cdata, key, hyper)
-                    upd = out.update
-                    if dp.is_local_dp_enabled():
-                        upd = dp.add_local_noise(
-                            upd, jax.random.fold_in(key, DP_LDP_FOLD))
-                    elif dp.is_global_dp_enabled():
-                        upd = dp.clip_update(upd)
-                    w = out.weight * active
-                    return upd, out.extras, w, out.metrics, out.client_state
-
                 def chunk_body(carry, inp):
                     states, acc_u, acc_ex, acc_w, acc_m = carry
                     lis, acts = inp
                     upds, extras, ws, mets, new_states = jax.vmap(
-                        one_slot, in_axes=(None, 0, 0))(states, lis, acts)
+                        run_slot, in_axes=(None, 0, 0))(states, lis, acts)
                     acc_u = jax.tree_util.tree_map(
                         lambda acc, u: acc + jnp.tensordot(
                             ws.astype(u.dtype), u, axes=1), acc_u, upds)
@@ -254,85 +281,40 @@ class TPUSimulator:
                     # by value.
                     safe_lis = jnp.where(acts > 0, lis,
                                          jnp.int32(cpd))  # OOB -> dropped
+
                     def scatter(st, ns):
                         return st.at[safe_lis].set(ns, mode="drop")
                     states = jax.tree_util.tree_map(scatter, states,
                                                     new_states)
                     return (states, acc_u, acc_ex, acc_w, acc_m), None
 
-                init = (local_states, zero_update, zero_extras,
-                        jnp.float32(0), zero_metrics)
                 (states, acc_u, acc_ex, acc_w, acc_m), _ = jax.lax.scan(
                     chunk_body, init, (chunks_idx, chunks_act))
-                total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
-                denom = jnp.maximum(total_w, 1e-12)
-                agg_update = jax.tree_util.tree_map(
-                    lambda x: x / denom.astype(x.dtype), psum_tree(acc_u))
-                agg_extras = jax.tree_util.tree_map(
-                    lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
-                metrics = psum_tree(acc_m)
-                if dp.is_global_dp_enabled():
-                    agg_update = dp.add_global_noise(
-                        agg_update, jax.random.fold_in(round_key,
-                                                       DP_CDP_FOLD))
-                new_params, new_server_state = opt.server_update(
-                    params, server_state, agg_update, agg_extras,
-                    hyper.round_idx)
-                states = jax.tree_util.tree_map(lambda a: a[None], states)
-                return new_params, new_server_state, states, metrics
+                return finish(states, acc_u, acc_ex, acc_w, acc_m)
 
             def slot(carry, s):
                 states, acc_u, acc_ex, acc_w, acc_m = carry
                 li = sched_idx[s]
                 active = sched_active[s]
-                cdata = jax.tree_util.tree_map(lambda a: a[li], local_data)
-                cstate = jax.tree_util.tree_map(lambda a: a[li], states)
-                gcid = dev * cpd + li
-                key = jax.random.fold_in(round_key, gcid)
-                out = opt.local_train(params, server_state, cstate, cdata,
-                                      key, hyper)
-                upd = out.update
-                if dp.is_local_dp_enabled():
-                    upd = dp.add_local_noise(
-                        upd, jax.random.fold_in(key, DP_LDP_FOLD))
-                elif dp.is_global_dp_enabled():
-                    # CDP soundness: the per-client sensitivity bound must
-                    # hold before aggregation even though noise is central
-                    upd = dp.clip_update(upd)
-                w = out.weight * active
+                upd, extras, w, mets, new_cstate = run_slot(states, li,
+                                                            active)
                 acc_u = jax.tree_util.tree_map(
                     lambda acc, u: acc + u * w.astype(u.dtype), acc_u, upd)
                 acc_ex = jax.tree_util.tree_map(
-                    lambda acc, e: acc + e * w.astype(e.dtype), acc_ex, out.extras)
+                    lambda acc, e: acc + e * w.astype(e.dtype), acc_ex,
+                    extras)
                 acc_w = acc_w + w
                 acc_m = jax.tree_util.tree_map(
-                    lambda acc, m: acc + m * active, acc_m, out.metrics)
+                    lambda acc, m: acc + m * active, acc_m, mets)
                 states = jax.tree_util.tree_map(
                     lambda a, n: a.at[li].set(
-                        jnp.where(active > 0, n, a[li])), states, out.client_state)
+                        jnp.where(active > 0, n, a[li])), states,
+                    new_cstate)
                 return (states, acc_u, acc_ex, acc_w, acc_m), None
 
-            init = (local_states, zero_update, zero_extras,
-                    jnp.float32(0), zero_metrics)
             (states, acc_u, acc_ex, acc_w, acc_m), _ = jax.lax.scan(
                 slot, init, jnp.arange(sched_idx.shape[0]))
-
-            # ---- the FedAvg collective: pre-scaled SUM-reduce over clients.
-            total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
-            denom = jnp.maximum(total_w, 1e-12)
-            agg_update = jax.tree_util.tree_map(
-                lambda x: x / denom.astype(x.dtype), psum_tree(acc_u))
-            agg_extras = jax.tree_util.tree_map(
-                lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
-            metrics = psum_tree(acc_m)
-
-            if dp.is_global_dp_enabled():
-                agg_update = dp.add_global_noise(
-                    agg_update, jax.random.fold_in(round_key, DP_CDP_FOLD))
-            new_params, new_server_state = opt.server_update(
-                params, server_state, agg_update, agg_extras, hyper.round_idx)
-            states = jax.tree_util.tree_map(lambda a: a[None], states)
-            return new_params, new_server_state, states, metrics
+            return finish(states, acc_u, acc_ex, acc_w, acc_m)
 
         shard_fn = jax.shard_map(
             round_body,
