@@ -93,3 +93,34 @@ def test_cross_silo_matches_sp_golden():
                     jax.tree_util.tree_leaves(result["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
+
+
+def test_cross_silo_session_over_real_grpc():
+    """Full FL session over the real gRPC transport (not in-proc): server +
+    2 silo clients, each with its own gRPC server on loopback — the wire
+    path a multi-host deployment uses."""
+    import threading
+    from fedml_tpu.cross_silo.horizontal.runner import (build_client,
+                                                        build_server)
+    args = make_args(client_num_in_total=2, client_num_per_round=2,
+                     comm_round=2, grpc_base_port=39990)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    server = build_server(args, fed, bundle, backend="GRPC")
+    clients = [build_client(args, fed, bundle, rank=r, backend="GRPC")
+               for r in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=240)
+    assert done.get("ok"), "gRPC session did not complete"
+    assert len(server.result["history"]) == 2
+    assert server.result["final_test_acc"] > 0.6
