@@ -103,6 +103,20 @@ def _unpack_np(code, data):
     return arr.reshape(shape)
 
 
+def dumps_tree(tree) -> bytes:
+    """Serialize a pytree of arrays (nested dicts/lists — the flax param
+    shape) with the wire codec. The single safe-serialization seam shared
+    by messages, model artifacts, and the object store — never pickle."""
+    import jax
+    host = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+    return msgpack.packb(host, default=_pack_np, use_bin_type=True)
+
+
+def loads_tree(blob: bytes) -> Any:
+    return msgpack.unpackb(blob, ext_hook=_unpack_np, raw=False,
+                           strict_map_key=False)
+
+
 def tree_to_wire(tree) -> Dict[str, Any]:
     """Flatten a pytree of arrays into {path: np.ndarray} for a Message
     payload (the analogue of shipping a state-dict)."""

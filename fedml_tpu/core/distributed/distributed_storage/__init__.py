@@ -16,10 +16,9 @@ from __future__ import annotations
 
 import hashlib
 import os
-import pickle
 from typing import Any, Optional
 
-import numpy as np
+from ..communication.message import dumps_tree, loads_tree
 
 
 class LocalObjectStorage:
@@ -56,10 +55,10 @@ class LocalObjectStorage:
         return blob
 
     # --- model payload convenience (reference write_model/read_model) ------
+    # wire tree codec, NOT pickle: stored payloads can come from remote
+    # silos, and reading one must never execute code.
     def write_model(self, params: Any) -> str:
-        import jax
-        host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
-        return self.put_object(pickle.dumps(host))
+        return self.put_object(dumps_tree(params))
 
     def read_model(self, key: str) -> Any:
-        return pickle.loads(self.get_object(key))
+        return loads_tree(self.get_object(key))

@@ -36,12 +36,18 @@ class FedMLFHE:
     """L4 singleton consulted by the algframe hooks (reference
     ``FedMLFHE`` in ``fhe_agg.py``): enabled by ``args.enable_fhe``."""
 
-    def __init__(self, args: Optional[Any] = None, key_bits: int = 512):
+    def __init__(self, args: Optional[Any] = None, key_bits: int = 2048):
         self.enabled = bool(getattr(args, "enable_fhe", False))
         self._pub: Optional[PublicKey] = None
         self._priv: Optional[PrivateKey] = None
         self.key_bits = int(getattr(args, "fhe_key_bits", key_bits)
                             or key_bits)
+        if self.enabled and self.key_bits < 2048:
+            import logging
+            logging.getLogger(__name__).warning(
+                "FHE key_bits=%d is below the ~2048-bit Paillier minimum — "
+                "the modulus is practically factorable. NOT for production "
+                "(tests may override for speed).", self.key_bits)
 
     def is_fhe_enabled(self) -> bool:
         return self.enabled

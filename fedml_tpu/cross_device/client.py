@@ -28,6 +28,7 @@ from ..core.algframe.types import TrainHyper
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
 from ..serving import load_model, save_model
+from ..utils.paths import confine_path
 from .message_define import DeviceMessage
 
 logger = logging.getLogger(__name__)
@@ -91,7 +92,17 @@ class DeviceClientManager(FedMLCommManager):
         round_idx = int(msg.get(DeviceMessage.ARG_ROUND_IDX))
         silo_idx = int(msg.get(DeviceMessage.ARG_DATA_SILO_IDX,
                                self.device_id - 1))
-        params = load_model(msg.get(DeviceMessage.ARG_MODEL_FILE))
+        # server-supplied path: confine to the shared cache dir (msgpack
+        # artifact + confinement = no unpickle / no arbitrary-file read).
+        # Drop bad messages instead of raising — an exception here would
+        # kill the device's receive loop.
+        try:
+            params = load_model(confine_path(
+                msg.get(DeviceMessage.ARG_MODEL_FILE), self.cache_dir))
+        except (ValueError, OSError) as e:
+            logger.warning("device %d: dropping round message: %s",
+                           self.device_id, e)
+            return
         cdata = jax.tree_util.tree_map(
             lambda a: a[silo_idx % self.fed.num_clients], self.fed.train)
         if self.engine == "native":
@@ -101,11 +112,12 @@ class DeviceClientManager(FedMLCommManager):
             new_params, n, loss = self._train_jax(params, cdata, round_idx)
         out_path = os.path.join(
             self.cache_dir,
-            f"device_{self.device_id}_round_{round_idx}.pkl")
+            f"device_{self.device_id}_round_{round_idx}.npk")
         save_model(new_params, out_path)
         reply = Message(DeviceMessage.MSG_TYPE_D2S_MODEL, self.device_id, 0)
         reply.add_params(DeviceMessage.ARG_DEVICE_ID, self.device_id)
         reply.add_params(DeviceMessage.ARG_MODEL_FILE, out_path)
+        reply.add_params(DeviceMessage.ARG_ROUND_IDX, round_idx)
         reply.add_params(DeviceMessage.ARG_NUM_SAMPLES, n)
         reply.add_params(DeviceMessage.ARG_TRAIN_LOSS, loss)
         self.send_message(reply)

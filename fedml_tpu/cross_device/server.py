@@ -26,6 +26,7 @@ from ..core import mlops
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
 from ..serving import load_model, save_model
+from ..utils.paths import confine_path
 from .message_define import DeviceMessage
 
 logger = logging.getLogger(__name__)
@@ -52,19 +53,28 @@ class DeviceAggregator:
         return len(self.model_files) >= self.client_num
 
     def aggregate(self):
-        total = sum(self.sample_nums.values()) or 1.0
-        acc = None
+        loaded = []
         for did, path in sorted(self.model_files.items()):
-            params = load_model(path)
-            w = self.sample_nums[did] / total
+            try:
+                # artifacts were magic-validated at receive time; a file
+                # that still fails here (deleted/truncated in between) is
+                # skipped, never fatal to the round-closing thread
+                loaded.append((self.sample_nums[did], load_model(path)))
+            except (ValueError, OSError) as e:
+                logger.warning("aggregate: skipping device %d: %s", did, e)
+        self.model_files.clear()
+        self.sample_nums.clear()
+        if not loaded:  # dead round: keep the previous global
+            return self.global_params
+        total = sum(n for n, _ in loaded) or 1.0
+        acc = None
+        for n, params in loaded:
+            w = n / total
             scaled = jax.tree_util.tree_map(
                 lambda a: np.asarray(a, np.float32) * w, params)
             acc = scaled if acc is None else jax.tree_util.tree_map(
                 np.add, acc, scaled)
-        self.global_params = jax.tree_util.tree_map(
-            lambda a: np.asarray(a, np.float32), acc)
-        self.model_files.clear()
-        self.sample_nums.clear()
+        self.global_params = acc
         return self.global_params
 
     def test_on_server(self) -> Optional[Dict[str, float]]:
@@ -97,6 +107,10 @@ class DeviceServerManager(FedMLCommManager):
         self.round_timeout_s = float(getattr(args, "round_timeout_s", 0)
                                      or 0)
         self._timer: Optional[threading.Timer] = None
+        # guards the timer-vs-last-arrival race: set under the lock when a
+        # round's collection closes, so a timer thread that was already
+        # blocked on the lock bails instead of double-advancing
+        self._round_closed = False
 
     # --- FSM ---------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -123,7 +137,7 @@ class DeviceServerManager(FedMLCommManager):
 
     def _global_model_file(self) -> str:
         path = os.path.join(self.cache_dir,
-                            f"global_round_{self.round_idx}.pkl")
+                            f"global_round_{self.round_idx}.npk")
         save_model(self.aggregator.global_params, path)
         return path
 
@@ -131,6 +145,15 @@ class DeviceServerManager(FedMLCommManager):
         """Write the global artifact once, point every device at it
         (reference start_train JSON with the global model S3 path)."""
         path = self._global_model_file()
+        with self._lock:
+            self._round_closed = False
+        # dead-round leash: if NO device ever reports this round (all
+        # crashed post-registration), the tight first-arrival timer in
+        # handle_device_model never arms and the round would hang forever.
+        # Arm a generous 3x leash now; the first arrival swaps it for the
+        # tight straggler timer (mirrors SecAggServerManager._start_round).
+        if self.round_timeout_s > 0:
+            self._arm_timer(3.0 * self.round_timeout_s)
         n_total = int(getattr(self.args, "client_num_in_total",
                               self.expected_devices))
         rs = np.random.RandomState(1000 + self.round_idx)
@@ -145,39 +168,74 @@ class DeviceServerManager(FedMLCommManager):
             msg.add_params(DeviceMessage.ARG_DATA_SILO_IDX, int(silos[i]))
             self.send_message(msg)
 
+    def _arm_timer(self, seconds: float) -> None:
+        """(Re-)arm the round timer; caller holds no invariants beyond the
+        current round index (a stale fire is ignored by armed_round)."""
+        if self._timer is not None:
+            self._timer.cancel()
+        this_round = self.round_idx
+        self._timer = threading.Timer(
+            seconds, lambda: self._on_round_timeout(this_round))
+        self._timer.daemon = True
+        self._timer.start()
+
     def handle_device_model(self, msg: Message) -> None:
         did = int(msg.get(DeviceMessage.ARG_DEVICE_ID))
+        # peer-supplied path: confine to the cache dir before it is ever
+        # opened (aggregate() reads it later). A bad message is dropped,
+        # not raised — a handler exception would kill the receive loop
+        # (one malicious peer must not take the server down).
+        try:
+            path = confine_path(msg.get(DeviceMessage.ARG_MODEL_FILE),
+                                self.cache_dir)
+            # validate the artifact NOW (existence + magic), not at
+            # aggregate() time where a failure would crash the
+            # round-closing thread
+            load_model(path)
+        except (ValueError, OSError) as e:
+            logger.warning("server: dropping model from device %d: %s",
+                           did, e)
+            return
         with self._lock:
+            # a straggler's model for an already-closed round must not
+            # fold into the current round (same stale-round rule as the
+            # FA server). _round_closed covers the window where the timer
+            # closed the round but round_idx has not advanced yet.
+            if (self._round_closed
+                    or int(msg.get(DeviceMessage.ARG_ROUND_IDX,
+                                   self.round_idx)) != self.round_idx):
+                logger.warning(
+                    "server: dropping stale round model from device %d",
+                    did)
+                return
             self.aggregator.add_device_result(
-                did, msg.get(DeviceMessage.ARG_MODEL_FILE),
+                did, path,
                 float(msg.get(DeviceMessage.ARG_NUM_SAMPLES, 1.0)))
             if not self.aggregator.all_received():
                 if (self.round_timeout_s > 0
                         and len(self.aggregator.model_files) == 1):
-                    this_round = self.round_idx
-                    self._timer = threading.Timer(
-                        self.round_timeout_s,
-                        lambda: self._on_round_timeout(this_round))
-                    self._timer.daemon = True
-                    self._timer.start()
+                    # first arrival: swap the dead-round leash for the
+                    # tight straggler timeout
+                    self._arm_timer(self.round_timeout_s)
                 return
             self._finish_collect_locked()
         self._advance_round()
 
     def _on_round_timeout(self, armed_round: int) -> None:
         with self._lock:
-            if (self.round_idx != armed_round
-                    or not self.aggregator.model_files):
+            if self.round_idx != armed_round or self._round_closed:
                 return  # round completed normally in the meantime
+            n = len(self.aggregator.model_files)
             logger.warning(
                 "device server round %d: timeout with %d/%d device models "
-                "— aggregating the devices that reported", self.round_idx,
-                len(self.aggregator.model_files),
-                self.aggregator.client_num)
+                "— %s", self.round_idx, n, self.aggregator.client_num,
+                "aggregating the devices that reported" if n
+                else "no device reported; keeping the previous global model")
             self._finish_collect_locked()
         self._advance_round()
 
     def _finish_collect_locked(self) -> None:
+        self._round_closed = True
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None

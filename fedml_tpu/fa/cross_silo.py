@@ -72,7 +72,10 @@ class FAServerManager(FedMLCommManager):
         self.round_num = int(getattr(args, "comm_round", 1))
         self.round_idx = 0
         self.online: Dict[int, bool] = {}
-        self.submissions: List[Any] = []
+        # keyed by sender id: a client retry must not count twice, and a
+        # late previous-round submission must not fold into this round
+        # (mirrors the SecAgg/LSA masked-input bookkeeping)
+        self.submissions: Dict[int, Any] = {}
         self.history: List[Any] = []
         self.result: Optional[dict] = None
         self._lock = threading.Lock()
@@ -99,16 +102,24 @@ class FAServerManager(FedMLCommManager):
             self.send_message(out)
 
     def on_submission(self, msg: Message) -> None:
+        # the whole round close (aggregate + round_idx advance) stays under
+        # the lock: a retransmit arriving mid-aggregation must see the NEW
+        # round index, or it would be folded into the next round
         with self._lock:
-            self.submissions.append(msg.get(FAMessage.KEY_SUBMISSION))
+            if int(msg.get(FAMessage.KEY_ROUND, -1)) != self.round_idx:
+                return  # stale round (WAN reorder) / retry — drop
+            self.submissions[msg.get_sender_id()] = msg.get(
+                FAMessage.KEY_SUBMISSION)
             if len(self.submissions) < self.n_clients:
                 return
-            subs, self.submissions = self.submissions, []
-        result = self.aggregator.aggregate(subs)
-        self.history.append(result)
-        logger.info("fa server round %d done", self.round_idx)
-        self.round_idx += 1
-        if self.round_idx >= self.round_num:
+            subs = [self.submissions[k] for k in sorted(self.submissions)]
+            self.submissions = {}
+            result = self.aggregator.aggregate(subs)
+            self.history.append(result)
+            logger.info("fa server round %d done", self.round_idx)
+            self.round_idx += 1
+            done = self.round_idx >= self.round_num
+        if done:
             for rank in sorted(self.online):
                 self.send_message(Message(FAMessage.S2C_FINISH, 0, rank))
             self.result = {"result": self.aggregator.get_server_data(),

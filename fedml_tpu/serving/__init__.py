@@ -11,15 +11,18 @@ Parity target: the reference's user-facing serving SDK —
 * :class:`CheckpointPredictor` jits the model's forward once and serves
   batched JAX inference from a saved training checkpoint, so the path from
   ``run_simulation`` to a live endpoint is two lines;
-* model artifacts are a single pickled numpy pytree (``save_model`` /
-  ``load_model``) — host-independent, no framework-versioned state dicts.
+* model artifacts are msgpack-encoded numpy pytrees (``save_model`` /
+  ``load_model``) — the same codec as the wire format
+  (:mod:`..core.distributed.communication.message`), NOT pickle: loading
+  a served artifact must never be a code-execution vector, and the trust
+  story should match the wire's (reference streams pickled state dicts;
+  we deliberately do not).
 """
 
 from __future__ import annotations
 
 import json
 import logging
-import pickle
 import threading
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -27,23 +30,39 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..core.distributed.communication.message import dumps_tree, loads_tree
+
 logger = logging.getLogger(__name__)
 
 PyTree = Any
 
+# artifact magic: lets load_model fail loudly (instead of unpacking
+# garbage) on foreign files, and marks the format as the msgpack codec
+_ARTIFACT_MAGIC = b"FMTPU1\n"
+
 
 def save_model(params: PyTree, path: str) -> str:
-    """Persist model params as a pickled numpy pytree."""
-    import jax
-    host = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
-    with open(path, "wb") as f:
-        pickle.dump(host, f)
+    """Persist model params with the wire codec (``dumps_tree``). No
+    pickle: artifacts may cross trust boundaries (device uploads, served
+    model pulls)."""
+    import os
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_ARTIFACT_MAGIC)
+        f.write(dumps_tree(params))
+    os.replace(tmp, path)
     return path
 
 
 def load_model(path: str) -> PyTree:
     with open(path, "rb") as f:
-        return pickle.load(f)
+        head = f.read(len(_ARTIFACT_MAGIC))
+        if head != _ARTIFACT_MAGIC:
+            raise ValueError(
+                f"{path}: not a fedml_tpu model artifact (bad magic); "
+                "legacy pickle artifacts are not loaded — re-save with "
+                "save_model")
+        return loads_tree(f.read())
 
 
 class FedMLPredictor(ABC):

@@ -1,6 +1,7 @@
 """Cross-device pillar: device protocol session (3 simulated devices),
 native C++ engine parity, native masking round-trip."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -160,3 +161,73 @@ def test_dead_device_does_not_stall_round(tmp_path):
     assert done.get("ok"), "server stalled on the dead device"
     assert len(server.result["history"]) == 2
     assert server.result["final_test_acc"] > 0.5
+
+
+def test_artifact_codec_is_not_pickle(tmp_path):
+    """Model artifacts are msgpack (magic-checked), never pickled — loading
+    a foreign file must fail loudly, not execute code."""
+    import pickle
+
+    from fedml_tpu.serving import load_model, save_model
+
+    params = {"dense": {"kernel": np.ones((3, 2), np.float32),
+                        "bias": np.zeros((2,), np.float32)}}
+    path = str(tmp_path / "m.npk")
+    save_model(params, path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    assert blob[:6] == b"FMTPU1"
+    back = load_model(path)
+    np.testing.assert_array_equal(back["dense"]["kernel"],
+                                  params["dense"]["kernel"])
+    evil = str(tmp_path / "evil.npk")
+    with open(evil, "wb") as f:
+        pickle.dump({"x": 1}, f)
+    with pytest.raises(ValueError, match="bad magic"):
+        load_model(evil)
+
+
+def test_peer_path_confinement(tmp_path):
+    """A peer-supplied model-file path outside the cache dir is rejected
+    before it is ever opened (ADVICE r2 medium)."""
+    from fedml_tpu.utils.paths import confine_path
+
+    root = tmp_path / "cache"
+    root.mkdir()
+    inside = root / "ok.npk"
+    inside.write_bytes(b"x")
+    assert confine_path(str(inside), str(root))
+    for bad in ("/etc/passwd", str(root / ".." / "escape.npk"),
+                str(tmp_path / "other.npk")):
+        with pytest.raises(ValueError, match="escapes"):
+            confine_path(bad, str(root))
+
+
+def test_dead_round_leash_zero_arrivals(tmp_path):
+    """If NO device reports in a round, the 3x leash armed at dispatch
+    closes the round with the previous global model (ADVICE r2)."""
+    import time
+
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_device.runner import build_device_server
+
+    args = make_args(model_file_cache_dir=str(tmp_path), comm_round=2,
+                     client_num_per_round=1, round_timeout_s=0.3)
+    args.inproc_broker = InProcBroker()
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    server = build_device_server(args, fed, bundle, backend="INPROC")
+    server.send_message = lambda msg: None   # devices never hear dispatch
+    server.finish = lambda: None
+    server.devices_online[1] = {"os": "?", "engine": "?"}
+    before = server.aggregator.global_params
+    server.is_initialized = True
+    server._dispatch_round("init")           # arms the 3x leash
+    deadline = time.time() + 10
+    while server.round_idx < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert server.round_idx >= 2, "dead rounds did not advance"
+    after = server.aggregator.global_params
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(before)[0]),
+        np.asarray(jax.tree_util.tree_leaves(after)[0]))

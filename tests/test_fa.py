@@ -78,3 +78,49 @@ def test_fa_cross_silo_session_matches_sim():
     pooled = np.concatenate(datas)
     assert abs(out["result"] - pooled.mean()) < 1e-9
     assert out["rounds"] == 1
+
+
+def test_fa_server_dedups_and_drops_stale_rounds():
+    """Duplicate submissions (client retry) count once; submissions tagged
+    with a stale round index are dropped (ADVICE r2)."""
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.fa.cross_silo import FAMessage, FAServerManager
+
+    folded = []
+
+    class Agg:
+        def get_init_msg(self):
+            return None
+
+        def aggregate(self, subs):
+            folded.append(list(subs))
+            return sum(subs)
+
+        def get_server_data(self):
+            return folded
+
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+
+    args = Arguments(comm_round=2, training_type="fa",
+                     inproc_broker=InProcBroker())
+    srv = FAServerManager(args, Agg(), rank=0, size=3, backend="INPROC")
+    srv.send_message = lambda msg: None  # no transport in this unit test
+    srv.finish = lambda: None
+
+    def sub(sender, value, round_idx):
+        m = Message(FAMessage.C2S_SUBMISSION, sender, 0)
+        m.add_params(FAMessage.KEY_SUBMISSION, value)
+        m.add_params(FAMessage.KEY_ROUND, round_idx)
+        return m
+
+    srv.on_submission(sub(1, 10, 0))
+    srv.on_submission(sub(1, 10, 0))     # retry: must not close the round
+    assert srv.round_idx == 0 and not folded
+    srv.on_submission(sub(2, 99, 5))     # wrong round: dropped
+    assert srv.round_idx == 0 and not folded
+    srv.on_submission(sub(2, 5, 0))      # second distinct sender closes it
+    assert srv.round_idx == 1
+    assert folded == [[10, 5]]
+    srv.on_submission(sub(1, 1, 0))      # late round-0 dupe: dropped
+    assert srv.round_idx == 1 and len(folded) == 1
