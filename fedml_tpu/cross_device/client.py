@@ -89,17 +89,18 @@ class DeviceClientManager(FedMLCommManager):
         self.send_message(msg)
 
     def handle_round(self, msg: Message) -> None:
-        round_idx = int(msg.get(DeviceMessage.ARG_ROUND_IDX))
-        silo_idx = int(msg.get(DeviceMessage.ARG_DATA_SILO_IDX,
-                               self.device_id - 1))
-        # server-supplied path: confine to the shared cache dir (msgpack
-        # artifact + confinement = no unpickle / no arbitrary-file read).
-        # Drop bad messages instead of raising — an exception here would
-        # kill the device's receive loop.
+        # server-supplied fields: confine the path to the shared cache dir
+        # (msgpack artifact + confinement = no unpickle / no arbitrary-file
+        # read). Drop bad messages instead of raising — an exception here
+        # would kill the device's receive loop. TypeError covers missing
+        # fields (confine_path(None) / int(None)).
         try:
+            round_idx = int(msg.get(DeviceMessage.ARG_ROUND_IDX))
+            silo_idx = int(msg.get(DeviceMessage.ARG_DATA_SILO_IDX,
+                                   self.device_id - 1))
             params = load_model(confine_path(
                 msg.get(DeviceMessage.ARG_MODEL_FILE), self.cache_dir))
-        except (ValueError, OSError) as e:
+        except (TypeError, ValueError, OSError) as e:
             logger.warning("device %d: dropping round message: %s",
                            self.device_id, e)
             return

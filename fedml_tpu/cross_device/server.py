@@ -25,7 +25,7 @@ import numpy as np
 from ..core import mlops
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
-from ..serving import load_model, save_model
+from ..serving import check_model_magic, load_model, save_model
 from ..utils.paths import confine_path
 from .message_define import DeviceMessage
 
@@ -107,6 +107,7 @@ class DeviceServerManager(FedMLCommManager):
         self.round_timeout_s = float(getattr(args, "round_timeout_s", 0)
                                      or 0)
         self._timer: Optional[threading.Timer] = None
+        self._timer_gen = 0
         # guards the timer-vs-last-arrival race: set under the lock when a
         # round's collection closes, so a timer thread that was already
         # blocked on the lock bails instead of double-advancing
@@ -169,30 +170,37 @@ class DeviceServerManager(FedMLCommManager):
             self.send_message(msg)
 
     def _arm_timer(self, seconds: float) -> None:
-        """(Re-)arm the round timer; caller holds no invariants beyond the
-        current round index (a stale fire is ignored by armed_round)."""
+        """(Re-)arm the round timer. ``Timer.cancel()`` is a no-op once the
+        callback has started, so a leash timer that already fired and is
+        blocked on the lock cannot be cancelled — the generation counter
+        lets such a stale callback recognize it was superseded (e.g. by the
+        tight straggler timer) and bail instead of closing the round."""
         if self._timer is not None:
             self._timer.cancel()
-        this_round = self.round_idx
+        self._timer_gen += 1
+        this_round, this_gen = self.round_idx, self._timer_gen
         self._timer = threading.Timer(
-            seconds, lambda: self._on_round_timeout(this_round))
+            seconds, lambda: self._on_round_timeout(this_round, this_gen))
         self._timer.daemon = True
         self._timer.start()
 
     def handle_device_model(self, msg: Message) -> None:
         did = int(msg.get(DeviceMessage.ARG_DEVICE_ID))
-        # peer-supplied path: confine to the cache dir before it is ever
-        # opened (aggregate() reads it later). A bad message is dropped,
-        # not raised — a handler exception would kill the receive loop
-        # (one malicious peer must not take the server down).
+        # peer-supplied fields: a bad message is dropped, not raised — a
+        # handler exception would kill the receive loop (one malicious peer
+        # must not take the server down). TypeError covers a missing path
+        # (confine_path(None)); ValueError covers escape attempts, a bad
+        # magic, and non-numeric round indices.
         try:
             path = confine_path(msg.get(DeviceMessage.ARG_MODEL_FILE),
                                 self.cache_dir)
-            # validate the artifact NOW (existence + magic), not at
-            # aggregate() time where a failure would crash the
-            # round-closing thread
-            load_model(path)
-        except (ValueError, OSError) as e:
+            # validate the artifact NOW (existence + magic header only —
+            # aggregate() does the full parse once), not at aggregate()
+            # time where a failure would crash the round-closing thread
+            check_model_magic(path)
+            msg_round = int(msg.get(DeviceMessage.ARG_ROUND_IDX,
+                                    self.round_idx))
+        except (TypeError, ValueError, OSError) as e:
             logger.warning("server: dropping model from device %d: %s",
                            did, e)
             return
@@ -201,9 +209,7 @@ class DeviceServerManager(FedMLCommManager):
             # fold into the current round (same stale-round rule as the
             # FA server). _round_closed covers the window where the timer
             # closed the round but round_idx has not advanced yet.
-            if (self._round_closed
-                    or int(msg.get(DeviceMessage.ARG_ROUND_IDX,
-                                   self.round_idx)) != self.round_idx):
+            if self._round_closed or msg_round != self.round_idx:
                 logger.warning(
                     "server: dropping stale round model from device %d",
                     did)
@@ -221,10 +227,11 @@ class DeviceServerManager(FedMLCommManager):
             self._finish_collect_locked()
         self._advance_round()
 
-    def _on_round_timeout(self, armed_round: int) -> None:
+    def _on_round_timeout(self, armed_round: int, armed_gen: int) -> None:
         with self._lock:
-            if self.round_idx != armed_round or self._round_closed:
-                return  # round completed normally in the meantime
+            if (self.round_idx != armed_round or self._round_closed
+                    or self._timer_gen != armed_gen):
+                return  # round completed or timer re-armed in the meantime
             n = len(self.aggregator.model_files)
             logger.warning(
                 "device server round %d: timeout with %d/%d device models "

@@ -54,6 +54,15 @@ def save_model(params: PyTree, path: str) -> str:
     return path
 
 
+def check_model_magic(path: str) -> None:
+    """Cheap receive-time validation: existence + magic header, without
+    unpacking the whole artifact (which the consumer will do anyway)."""
+    with open(path, "rb") as f:
+        if f.read(len(_ARTIFACT_MAGIC)) != _ARTIFACT_MAGIC:
+            raise ValueError(
+                f"{path}: not a fedml_tpu model artifact (bad magic)")
+
+
 def load_model(path: str) -> PyTree:
     with open(path, "rb") as f:
         head = f.read(len(_ARTIFACT_MAGIC))

@@ -472,11 +472,14 @@ class TPUSimulator:
 
     def round_cost_flops(self, hyper: TrainHyper) -> float:
         """FLOPs one round of this workload executes (all devices), for the
-        bench's MFU metric. XLA's cost analysis counts a ``lax.scan`` body
-        ONCE regardless of trip count, so instead of lowering the whole
-        round program we cost a single loop-free fwd+bwd batch step and
-        multiply by the number of real local steps a round runs:
-        ``sampled_clients x epochs x batches_per_client``."""
+        bench's MFU metric. XLA's cost analysis counts a loop body ONCE
+        regardless of trip count, so instead of lowering the whole round
+        program we cost a single loop-free fwd+bwd batch step and multiply
+        by the number of REAL local steps a round runs. On hetero partitions
+        clients are padded to the largest client's batch count, and the
+        dynamic local loop (``run_local_sgd``) skips padded batches — so the
+        step count here is the mask-derived mean real batches per client,
+        not the padded shape, or MFU would count padding as useful work."""
         try:
             batch = {
                 "x": jnp.zeros_like(self.fed.train.x[0, 0]),
@@ -497,8 +500,11 @@ class TPUSimulator:
                 cost = cost[0] if cost else {}
             per_batch = float(cost.get("flops", 0.0) or 0.0)
             n_sampled = int(self.args.client_num_per_round)
-            n_batches = int(self.fed.train.x.shape[1])
-            steps = n_sampled * int(hyper.epochs) * n_batches
+            mask = np.asarray(self.fed.train.mask)  # [clients, batches, bs]
+            real_batches = mask.reshape(mask.shape[0], mask.shape[1], -1)
+            mean_real = float(np.mean(np.sum(
+                np.any(real_batches > 0, axis=-1), axis=-1)))
+            steps = n_sampled * int(hyper.epochs) * mean_real
             return per_batch * steps
         except Exception:
             return 0.0

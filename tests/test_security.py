@@ -101,10 +101,11 @@ class TestEndToEnd:
         defended = fedml_tpu.run_simulation(backend="tpu", args=sim_args(
             enable_attack=True, attack_type="byzantine_random",
             byzantine_client_num=3, attack_scale=20.0,
-            enable_defense=True, defense_type="krum"))
+            enable_defense=True, defense_type="multi_krum", krum_param_m=3))
         assert attacked["final_test_acc"] < clean["final_test_acc"] - 0.1
-        # single-Krum uses one client's update per round, so it trails clean
-        # FedAvg slightly — but must largely neutralize the attack
+        # multi-Krum (m=3) averages the lowest-score honest picks; single-Krum
+        # follows one client per round and its short-horizon accuracy swings
+        # ~0.5-0.9 with the batch-order seed, which is too fragile to gate on
         assert defended["final_test_acc"] > attacked["final_test_acc"] + 0.1
         assert defended["final_test_acc"] > 0.8
 
