@@ -158,8 +158,8 @@ def bench_time_to_acc(target_acc=0.90, max_rounds=80):
     args = Arguments(
         dataset="digits", model="lr", client_num_in_total=10,
         client_num_per_round=10, comm_round=max_rounds, epochs=1,
-        batch_size=32, learning_rate=0.3, frequency_of_the_test=1,
-        random_seed=0)
+        batch_size=32, learning_rate=0.3, frequency_of_the_test=10_000,
+        random_seed=0)  # eval below, once per round — not also in-engine
     fed, output_dim = load(args)
     provenance = getattr(fed, "provenance", "real")
     bundle = create(args, output_dim)

@@ -15,14 +15,17 @@ server proceeds with the >= threshold survivors, reconstructs the dropped
 clients' secret keys (and survivors' self-mask seeds) from Shamir shares
 held by the survivors, and cancels the residual pairwise masks.
 
-SECURITY SCOPE: this runtime provides *protocol-shape parity only* — it is
-NOT confidential against the server. The environment has no crypto backend,
-so (a) "public keys" are the secret keys themselves (no real DH agreement),
-and (b) Shamir shares are routed through the server in plaintext rather
-than encrypted peer-to-peer. An honest-but-curious server could therefore
-reconstruct any individual update. The message flow, field math, masking
-algebra, and dropout-recovery logic match Bonawitz et al.; swap in real
-ECDH + authenticated encryption for the privacy property.
+Confidentiality against the server: each client holds two X25519 keypairs
+(``core/mpc/channels.py``) — pairwise PRG mask seeds come from real ECDH
+agreement on the *mask* keys, and routed Shamir shares are sealed with
+ChaCha20-Poly1305 under per-pair keys derived from the *channel* keys, so
+the server relays only ciphertext (``test_secagg_runtime.py`` asserts the
+relayed bytes reveal no share and fail AEAD authentication under any other
+pair's key). The mask secret key is Shamir-shared as 24-bit limbs over
+GF(2^31-1); the channel key is never shared, so reconstructing a dropped
+client's mask key does not open its past routed-share ciphertexts. At
+unmask time survivors reveal exactly what Bonawitz prescribes: dropped
+clients' mask-key shares and survivors' self-mask seed shares.
 """
 
 from __future__ import annotations
@@ -32,6 +35,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 import jax
+import msgpack
 import numpy as np
 
 from ...core import mlops
@@ -39,8 +43,9 @@ from ...core.distributed.communication.message import (Message, tree_to_wire,
                                                        wire_to_tree)
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...core.collectives import (tree_flatten_to_vector, vector_to_tree_like)
-from ...core.mpc import (P, dequantize, expand_mask, pairwise_seed, quantize,
+from ...core.mpc import (P, dequantize, expand_mask, quantize,
                          shamir_reconstruct, shamir_share)
+from ...core.mpc import channels
 from ...core.mpc.secagg import salt_seed
 
 logger = logging.getLogger(__name__)
@@ -85,13 +90,20 @@ class SecAggClientManager(FedMLCommManager):
         self.threshold = int(getattr(args, "secagg_threshold", 0) or
                              max(2, self.n_clients // 2 + 1))
         self.idx = self.rank - 1  # client index 0..n-1
-        rng = np.random.RandomState(
-            int(getattr(args, "random_seed", 0)) * 1000 + self.rank)
-        self.secret_key = int(rng.randint(0, _P_I))
+        # ALL secret material comes from OS entropy, never from the public
+        # random_seed config (the server holds the same args and could
+        # regenerate anything derived from it)
+        rng = channels.secret_rng()
+        # mask keypair: ECDH seeds the pairwise masks, secret Shamir-shared
+        self.mask_sk, self.mask_pk = channels.keygen()
+        # channel keypair: seals routed shares; never shared
+        self.enc_sk, self.enc_pk = channels.keygen()
         self.self_seed = int(rng.randint(0, _P_I))
         self._rng = rng
-        self.peer_publics: Dict[int, int] = {}
-        # shares this client HOLDS for each peer: peer_idx -> (seed, key)
+        # peer_idx -> {"mask": bytes, "enc": bytes}
+        self.peer_publics: Dict[int, Dict[str, bytes]] = {}
+        # shares this client HOLDS for each peer:
+        # peer_idx -> (seed_share, [mask-key limb shares])
         self.held_shares: Dict[int, Any] = {}
         self.round_idx = 0
 
@@ -105,29 +117,51 @@ class SecAggClientManager(FedMLCommManager):
 
     def run(self) -> None:
         msg = Message(SAMessage.C2S_PUBLIC_KEY, self.rank, 0)
-        msg.add_params(SAMessage.KEY_PK, self.secret_key)  # stand-in DH pub
+        msg.add_params(SAMessage.KEY_PK,
+                       {"mask": self.mask_pk, "enc": self.enc_pk})
         self.send_message(msg)
         super().run()
 
     def on_public_keys(self, msg: Message) -> None:
-        self.peer_publics = {int(k): int(v)
-                             for k, v in msg.get(SAMessage.KEY_PKS).items()}
-        # Shamir-share self_seed and secret_key; server routes share j to
-        # client j (in real SecAgg the share is encrypted for j — the
-        # environment has no crypto backend, protocol shape is identical)
+        self.peer_publics = {
+            int(k): {"mask": bytes(v["mask"]), "enc": bytes(v["enc"])}
+            for k, v in msg.get(SAMessage.KEY_PKS).items()}
+        # Shamir-share self_seed (one field element) and the mask secret
+        # key (24-bit limbs). The j-th share pair is sealed FOR client j
+        # under the pairwise channel key — the server routes ciphertext.
         seed_sh = shamir_share(self.self_seed, self.n_clients, self.threshold,
                                self._rng)
-        key_sh = shamir_share(self.secret_key, self.n_clients, self.threshold,
-                              self._rng)
+        limb_sh = [shamir_share(limb, self.n_clients, self.threshold,
+                                self._rng)
+                   for limb in channels.key_to_limbs(self.mask_sk)]
         out = Message(SAMessage.C2S_SHARES, self.rank, 0)
-        out.add_params(SAMessage.KEY_SHARES,
-                       {str(j): [list(seed_sh[j]), list(key_sh[j])]
-                        for j in range(self.n_clients)})
+        sealed = {}
+        for j in range(self.n_clients):
+            payload = msgpack.packb(
+                [list(seed_sh[j]), [list(ls[j]) for ls in limb_sh]])
+            sealed[str(j)] = channels.seal(
+                self.enc_sk, self.peer_publics[j]["enc"], payload,
+                aad=channels.pair_aad(self.idx, j, b"sa-setup"))
+        out.add_params(SAMessage.KEY_SHARES, sealed)
         self.send_message(out)
 
     def on_routed_shares(self, msg: Message) -> None:
-        self.held_shares = {int(k): v
-                            for k, v in msg.get(SAMessage.KEY_SHARES).items()}
+        for k, blob in msg.get(SAMessage.KEY_SHARES).items():
+            i = int(k)
+            # the whole parse stays in the try: AEAD authenticates whatever
+            # the SENDER sealed, so a malicious peer can deliver
+            # authentically-sealed garbage — that must drop the share, not
+            # kill the receive loop
+            try:
+                payload = channels.open_sealed(
+                    self.enc_sk, self.peer_publics[i]["enc"], bytes(blob),
+                    aad=channels.pair_aad(i, self.idx, b"sa-setup"))
+                seed_share, limb_shares = msgpack.unpackb(payload)
+            except (channels.DecryptError, ValueError, TypeError) as e:
+                logger.warning("secagg client %d: dropping share from %d: "
+                               "%s", self.idx, i, e)
+                continue
+            self.held_shares[i] = (seed_share, limb_shares)
 
     def on_train(self, msg: Message) -> None:
         self.round_idx = int(msg.get(SAMessage.KEY_ROUND, 0))
@@ -145,7 +179,7 @@ class SecAggClientManager(FedMLCommManager):
         for j, pub in self.peer_publics.items():
             if j == self.idx:
                 continue
-            s = pairwise_seed(self.secret_key, pub)
+            s = channels.mask_seed(self.mask_sk, pub["mask"])
             m = expand_mask(salt_seed(s, self.round_idx), d).astype(np.uint64)
             if self.idx < j:
                 total = (total + m) % _P_I
@@ -188,7 +222,9 @@ class SecAggServerManager(FedMLCommManager):
         self.round_num = int(getattr(args, "comm_round", 1))
         self.round_timeout = float(getattr(args, "round_timeout_s", 0) or 0)
         self.round_idx = 0
-        self.publics: Dict[int, int] = {}
+        # client_idx -> {"mask": bytes, "enc": bytes} (X25519 publics)
+        self.publics: Dict[int, Dict[str, bytes]] = {}
+        # owner_idx -> {recipient: sealed blob} — opaque to the server
         self.share_matrix: Dict[int, Dict[str, Any]] = {}
         self.masked: Dict[int, np.ndarray] = {}
         self.weights: Dict[int, float] = {}
@@ -210,8 +246,35 @@ class SecAggServerManager(FedMLCommManager):
         h(SAMessage.C2S_MASKED_MODEL, self.on_masked_model)
         h(SAMessage.C2S_UNMASK_SHARES, self.on_unmask_shares)
 
+    def run(self) -> None:
+        # setup leash: a client crashing before its pk/shares send must not
+        # hang the pk/shares barriers forever (_on_setup_timeout is a no-op
+        # once _start_round has moved the phase past "setup")
+        if self.round_timeout > 0:
+            self._timer = threading.Timer(
+                max(3.0 * self.round_timeout, 60.0), self._on_setup_timeout)
+            self._timer.daemon = True
+            self._timer.start()
+        super().run()
+
+    def _on_setup_timeout(self) -> None:
+        with self._lock:
+            if self._phase != "setup":
+                return
+            logger.error(
+                "secagg: setup incomplete at timeout (%d/%d public keys, "
+                "%d/%d share sets) — aborting session", len(self.publics),
+                self.n_clients, len(self.share_matrix), self.n_clients)
+            self._phase = "done"
+            self.result = {"error": "secagg_setup_timeout"}
+        for rank in range(1, self.n_clients + 1):
+            self.send_message(Message(SAMessage.S2C_FINISH, 0, rank))
+        self.finish()
+
     def on_public_key(self, msg: Message) -> None:
-        self.publics[msg.get_sender_id() - 1] = int(msg.get(SAMessage.KEY_PK))
+        pk = msg.get(SAMessage.KEY_PK)
+        self.publics[msg.get_sender_id() - 1] = {
+            "mask": bytes(pk["mask"]), "enc": bytes(pk["enc"])}
         if len(self.publics) == self.n_clients:
             for rank in range(1, self.n_clients + 1):
                 out = Message(SAMessage.S2C_PUBLIC_KEYS, 0, rank)
@@ -326,21 +389,33 @@ class SecAggServerManager(FedMLCommManager):
             self._phase = "aggregate"
         self._unmask_and_advance()
 
-    def _reconstruct(self, key: str, idx: int) -> int:
-        """Reconstruct a Shamir secret for client ``idx`` from the first
-        >= threshold unmask responses carrying its share under ``key``."""
+    def _collect_shares(self, key: str, idx: int) -> List[Any]:
         shares = []
         for resp in self.unmask_responses:
             sh = resp.get(key).get(str(idx))
             if sh is not None:
-                shares.append(tuple(sh))
+                shares.append(sh)
             if len(shares) >= self.threshold:
                 break
         if len(shares) < self.threshold:
             raise RuntimeError(
                 f"secagg: {len(shares)} shares < threshold {self.threshold} "
                 f"for client {idx} ({key})")
-        return shamir_reconstruct(shares)
+        return shares
+
+    def _reconstruct(self, key: str, idx: int) -> int:
+        """Reconstruct a single-field-element Shamir secret for ``idx``
+        from the first >= threshold unmask responses under ``key``."""
+        return shamir_reconstruct(
+            [tuple(sh) for sh in self._collect_shares(key, idx)])
+
+    def _reconstruct_mask_key(self, idx: int):
+        """Reconstruct client ``idx``'s X25519 mask secret from its 24-bit
+        limb shares (each limb is its own Shamir instance)."""
+        per_resp = self._collect_shares(SAMessage.KEY_KEY_SHARES, idx)
+        limbs = [shamir_reconstruct([tuple(resp[limb]) for resp in per_resp])
+                 for limb in range(channels.KEY_LIMBS)]
+        return channels.limbs_to_key(limbs)
 
     def _unmask_and_advance(self) -> None:
         surviving = self._surviving
@@ -355,12 +430,13 @@ class SecAggServerManager(FedMLCommManager):
                                d).astype(np.uint64)
             total = (total + _P_I - mask) % _P_I
         # cancel residual pairwise masks between survivors and dropped
-        # clients: reconstruct each dropped j's secret key, re-derive the
-        # symmetric pairwise seeds, and invert what each survivor added.
+        # clients: reconstruct each dropped j's mask secret key, re-derive
+        # the symmetric ECDH pairwise seeds, and invert what each survivor
+        # added.
         for j in self._dropped:
-            sk_j = self._reconstruct(SAMessage.KEY_KEY_SHARES, j)
+            sk_j = self._reconstruct_mask_key(j)
             for i in surviving:
-                s = pairwise_seed(sk_j, self.publics[i])
+                s = channels.mask_seed(sk_j, self.publics[i]["mask"])
                 m = expand_mask(salt_seed(s, self.round_idx),
                                 d).astype(np.uint64)
                 if i < j:   # survivor i added +m (i<j) -> subtract

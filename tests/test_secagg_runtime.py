@@ -101,3 +101,47 @@ def test_secagg_dropout_recovery():
                     jax.tree_util.tree_leaves(result["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-3)
+
+
+def test_server_relays_only_ciphertext():
+    """What the server sees of the routed shares must be AEAD ciphertext it
+    cannot open: no plaintext share bytes, and decryption without the
+    recipient's channel key fails authentication."""
+    import msgpack
+    import pytest
+    from fedml_tpu.core.mpc import channels
+    from fedml_tpu.cross_silo.secagg import SAMessage, SecAggServerManager
+
+    seen = {}
+
+    class SpyServer(SecAggServerManager):
+        def on_shares(self, msg):
+            owner = msg.get_sender_id() - 1
+            seen[owner] = dict(msg.get(SAMessage.KEY_SHARES))
+            super().on_shares(msg)
+
+    import fedml_tpu.cross_silo.secagg as sa_mod
+    orig = sa_mod.SecAggServerManager
+    sa_mod.SecAggServerManager = SpyServer
+    try:
+        args = make_args(comm_round=1)
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        result = run_secagg_inproc(args, fed, bundle)
+    finally:
+        sa_mod.SecAggServerManager = orig
+    assert result is not None and "error" not in result
+    assert len(seen) == 4
+    eve_sk, _eve_pk = channels.keygen()
+    for owner, routed in seen.items():
+        for j, blob in routed.items():
+            blob = bytes(blob)
+            # not a msgpack share list in the clear
+            with pytest.raises(Exception):
+                payload = msgpack.unpackb(blob)
+                assert isinstance(payload, list)  # reached = plaintext leak
+            # and not openable without the recipient's secret key
+            with pytest.raises(channels.DecryptError):
+                channels.open_sealed(
+                    eve_sk, _eve_pk, blob,
+                    aad=channels.pair_aad(int(owner), int(j), b"sa-setup"))
