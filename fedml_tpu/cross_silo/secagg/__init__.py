@@ -235,9 +235,15 @@ class SecAggServerManager(FedMLCommManager):
             tree_flatten_to_vector(global_params))
         self._lock = threading.Lock()
         self._phase = "setup"  # setup -> collect -> unmask -> done
+        self._keys_done = False
+        self._shares_done = False
         self._surviving: List[int] = []
         self._dropped: List[int] = []
         self._timer: Optional[threading.Timer] = None
+        # liveness floor: even with round_timeout_s unset, a crashed peer
+        # must eventually abort the session instead of deadlocking it —
+        # generous so first-compile stalls (~40s tunneled) never trip it
+        self._leash_s = max(3.0 * self.round_timeout, 300.0)
 
     def register_message_receive_handlers(self) -> None:
         h = self.register_message_receive_handler
@@ -250,11 +256,9 @@ class SecAggServerManager(FedMLCommManager):
         # setup leash: a client crashing before its pk/shares send must not
         # hang the pk/shares barriers forever (_on_setup_timeout is a no-op
         # once _start_round has moved the phase past "setup")
-        if self.round_timeout > 0:
-            self._timer = threading.Timer(
-                max(3.0 * self.round_timeout, 60.0), self._on_setup_timeout)
-            self._timer.daemon = True
-            self._timer.start()
+        self._timer = threading.Timer(self._leash_s, self._on_setup_timeout)
+        self._timer.daemon = True
+        self._timer.start()
         super().run()
 
     def _on_setup_timeout(self) -> None:
@@ -272,28 +276,40 @@ class SecAggServerManager(FedMLCommManager):
         self.finish()
 
     def on_public_key(self, msg: Message) -> None:
+        """Duplicate advertisements (client retransmits) must not re-trigger
+        the broadcast once setup has moved on (mirrors the LSA guard)."""
         pk = msg.get(SAMessage.KEY_PK)
-        self.publics[msg.get_sender_id() - 1] = {
-            "mask": bytes(pk["mask"]), "enc": bytes(pk["enc"])}
-        if len(self.publics) == self.n_clients:
-            for rank in range(1, self.n_clients + 1):
-                out = Message(SAMessage.S2C_PUBLIC_KEYS, 0, rank)
-                out.add_params(SAMessage.KEY_PKS,
-                               {str(k): v for k, v in self.publics.items()})
-                self.send_message(out)
+        with self._lock:
+            if self._keys_done:
+                return
+            self.publics[msg.get_sender_id() - 1] = {
+                "mask": bytes(pk["mask"]), "enc": bytes(pk["enc"])}
+            if len(self.publics) < self.n_clients:
+                return
+            self._keys_done = True
+        for rank in range(1, self.n_clients + 1):
+            out = Message(SAMessage.S2C_PUBLIC_KEYS, 0, rank)
+            out.add_params(SAMessage.KEY_PKS,
+                           {str(k): v for k, v in self.publics.items()})
+            self.send_message(out)
 
     def on_shares(self, msg: Message) -> None:
         owner = msg.get_sender_id() - 1
-        self.share_matrix[owner] = msg.get(SAMessage.KEY_SHARES)
-        if len(self.share_matrix) == self.n_clients:
-            # route: client j receives, for every owner i, i's j-th share
-            for j in range(self.n_clients):
-                routed = {str(i): self.share_matrix[i][str(j)]
-                          for i in range(self.n_clients)}
-                out = Message(SAMessage.S2C_ROUTED_SHARES, 0, j + 1)
-                out.add_params(SAMessage.KEY_SHARES, routed)
-                self.send_message(out)
-            self._start_round()
+        with self._lock:
+            if self._shares_done:  # retransmit must not restart the round
+                return
+            self.share_matrix[owner] = msg.get(SAMessage.KEY_SHARES)
+            if len(self.share_matrix) < self.n_clients:
+                return
+            self._shares_done = True
+        # route: client j receives, for every owner i, i's j-th share
+        for j in range(self.n_clients):
+            routed = {str(i): self.share_matrix[i][str(j)]
+                      for i in range(self.n_clients)}
+            out = Message(SAMessage.S2C_ROUTED_SHARES, 0, j + 1)
+            out.add_params(SAMessage.KEY_SHARES, routed)
+            self.send_message(out)
+        self._start_round()
 
     def _start_round(self) -> None:
         # The straggler timer is armed on the FIRST masked arrival (see
@@ -303,12 +319,13 @@ class SecAggServerManager(FedMLCommManager):
         # first arrival replaces with the tight timer.
         with self._lock:
             self._phase = "collect"
-            if self.round_timeout > 0:
-                leash = max(3.0 * self.round_timeout, 60.0)
-                self._timer = threading.Timer(
-                    leash, self._on_collect_timeout, args=(self.round_idx,))
-                self._timer.daemon = True
-                self._timer.start()
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(
+                self._leash_s, self._on_collect_timeout,
+                args=(self.round_idx,))
+            self._timer.daemon = True
+            self._timer.start()
         wire = tree_to_wire(self.global_params)
         for rank in range(1, self.n_clients + 1):
             out = Message(SAMessage.S2C_TRAIN, 0, rank)
@@ -371,11 +388,46 @@ class SecAggServerManager(FedMLCommManager):
         self._dropped = [i for i in range(self.n_clients)
                          if i not in self.masked]
         self.unmask_responses = []
+        # a survivor dying between masked upload and unmask response must
+        # not hang the session: proceed with >= threshold responses at the
+        # leash, abort below threshold
+        self._timer = threading.Timer(
+            self._leash_s, self._on_unmask_timeout, args=(self.round_idx,))
+        self._timer.daemon = True
+        self._timer.start()
         for rank in [i + 1 for i in self._surviving]:
             out = Message(SAMessage.S2C_UNMASK_REQUEST, 0, rank)
             out.add_params(SAMessage.KEY_SURVIVING, self._surviving)
             out.add_params(SAMessage.KEY_DROPPED, self._dropped)
             self.send_message(out)
+
+    def _on_unmask_timeout(self, armed_round: int) -> None:
+        with self._lock:
+            if self._phase != "unmask" or self.round_idx != armed_round:
+                return
+            if len(self.unmask_responses) < self.threshold:
+                logger.error(
+                    "secagg round %d: %d/%d unmask responses (< threshold "
+                    "%d) at timeout — aborting session", self.round_idx,
+                    len(self.unmask_responses), len(self._surviving),
+                    self.threshold)
+                self._phase = "done"
+                self.result = {"error": "secagg_unmask_timeout",
+                               "round": self.round_idx}
+                abort = True
+            else:
+                logger.warning(
+                    "secagg round %d: unmasking with %d/%d responses at "
+                    "timeout", self.round_idx, len(self.unmask_responses),
+                    len(self._surviving))
+                self._phase = "aggregate"
+                abort = False
+        if abort:
+            for rank in range(1, self.n_clients + 1):
+                self.send_message(Message(SAMessage.S2C_FINISH, 0, rank))
+            self.finish()
+            return
+        self._unmask_and_advance()
 
     def on_unmask_shares(self, msg: Message) -> None:
         with self._lock:
@@ -386,6 +438,9 @@ class SecAggServerManager(FedMLCommManager):
                 return
             if len(self.unmask_responses) < len(self._surviving):
                 return  # wait for all surviving (simplest consistent point)
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
             self._phase = "aggregate"
         self._unmask_and_advance()
 

@@ -6,11 +6,14 @@ counterparts here are first-class:
 
 - ``dense``: plain causal attention — XLA fuses this well for short
   sequences; the numerical golden for the other two.
-- ``flash``: a Pallas online-softmax kernel, blocked over the KV axis so
-  the [s, s] score matrix never materializes in HBM (the flash-attn
-  analogue on the MXU). Backward currently recomputes through the dense
-  path (documented trade-off; fine at the fine-tune lengths the reference
-  targets, ``DEFAULT_MAX_SEQ_LENGTH=1024``).
+- ``flash``: Pallas online-softmax kernels for BOTH directions — the
+  forward emits O and the per-query logsumexp; the backward recomputes
+  probabilities blockwise from (Q, K, LSE) in two kernels (dQ; dK/dV), so
+  the [s, s] score matrix never materializes in HBM in either direction
+  and training memory is O(s·d + s·block). Key-padding masks are
+  supported. This is the fwd+bwd fused flash-attn the reference gets from
+  its CUDA monkey-patch (``train/llm/models/attention.py:30-67``), built
+  for the MXU.
 - ``ring``: ring attention over the ``sp`` mesh axis — sequence shards
   rotate K/V via ``ppermute`` while accumulating online-softmax state, so
   context length scales with the number of chips (capability beyond the
@@ -49,10 +52,11 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      impl: str = "dense",
                      attn_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Dispatch. q/k/v: [b, s, h, d] → [b, s, h, d]."""
-    if impl in ("ring", "flash") and attn_mask is not None:
+    if impl == "ring" and attn_mask is not None:
         raise NotImplementedError(
-            f"attention_impl={impl!r} does not support key-padding masks "
-            "yet — use impl='dense', or pack sequences without padding")
+            "attention_impl='ring' does not support key-padding masks "
+            "yet — use impl='dense'/'flash', or pack sequences without "
+            "padding")
     if impl == "ring":
         ax = _RING_AXIS.get()
         if ax is None:
@@ -63,7 +67,7 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return ring_causal_attention(q, k, v, axis_name=ax[0],
                                      axis_size=ax[1])
     if impl == "flash":
-        return flash_causal_attention(q, k, v)
+        return flash_causal_attention(q, k, v, attn_mask=attn_mask)
     return dense_causal_attention(q, k, v, attn_mask=attn_mask)
 
 
@@ -84,12 +88,16 @@ def dense_causal_attention(q, k, v, attn_mask=None):
 
 
 # ---------------------------------------------------------------- flash ----
+# FlashAttention-2 style: the forward saves only (O, LSE); both backward
+# kernels recompute P = exp(QK^T·scale − LSE) blockwise in VMEM, so neither
+# direction materializes [s, s] in HBM. Key padding rides a [b, s] mask.
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      seq_len: int, scale: float):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                      block_k: int, seq_len: int, scale: float):
     """One (batch*head, q-block) program: online softmax over KV blocks.
 
-    q_ref: [block_q, d]; k_ref/v_ref: [s, d]; o_ref: [block_q, d].
+    q_ref: [block_q, d]; k_ref/v_ref: [s, d]; mask_ref: [s, 1];
+    o_ref: [block_q, d]; lse_ref: [block_q, 1].
     """
     import jax.experimental.pallas as pl
 
@@ -109,7 +117,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
                         preferred_element_type=jnp.float32)  # [bq, bk]
         k_pos = i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
-        s_blk = jnp.where(q_pos >= k_pos, s_blk, NEG_INF)
+        live = q_pos >= k_pos
+        kmask = mask_ref[pl.ds(i * block_k, block_k), 0]
+        live = jnp.logical_and(live, (kmask > 0)[None, :])
+        s_blk = jnp.where(live, s_blk, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s_blk, -1, keepdims=True))
         p = jnp.exp(s_blk - m_new)
         alpha = jnp.exp(m - m_new)
@@ -128,9 +139,97 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     o_acc, m, l = jax.lax.fori_loop(0, n_live, body, (o_acc, m0, l0))
     o_ref[:] = (o_acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd(q, k, v, block_q: int, block_k: int):
+def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, dd_ref,
+                     dq_ref, *, block_k: int, seq_len: int, scale: float):
+    """dQ for one q block: dS = P ∘ (dO·Vᵀ − D); dQ = scale · dS·K."""
+    import jax.experimental.pallas as pl
+
+    block_q, d = q_ref.shape
+    q_blk_idx = pl.program_id(1)
+    q_pos = q_blk_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]                      # [block_q, 1]
+    dd = dd_ref[:]                        # [block_q, 1]
+
+    def body(i, dq_acc):
+        k_blk = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s_blk = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        live = q_pos >= k_pos
+        kmask = mask_ref[pl.ds(i * block_k, block_k), 0]
+        live = jnp.logical_and(live, (kmask > 0)[None, :])
+        p = jnp.where(live, jnp.exp(s_blk - lse), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        return dq_acc + jnp.dot(ds, k_blk,
+                                preferred_element_type=jnp.float32)
+
+    n_k = pl.cdiv(seq_len, block_k)
+    n_live = jnp.minimum(
+        n_k, ((q_blk_idx + 1) * block_q + block_k - 1) // block_k)
+    dq = jax.lax.fori_loop(0, n_live, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(k_ref, v_ref, q_ref, mask_ref, do_ref, lse_ref,
+                      dd_ref, dk_ref, dv_ref, *, block_q: int, seq_len: int,
+                      scale: float):
+    """dK/dV for one kv block: dV = Pᵀ·dO; dK = scale · dSᵀ·Q."""
+    import jax.experimental.pallas as pl
+
+    block_k, d = k_ref.shape
+    k_blk_idx = pl.program_id(1)
+    k_pos = k_blk_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    kmask = (mask_ref[:, 0] > 0)[None, :]  # this kv block's slice via BlockSpec
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[pl.ds(j * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do_blk = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(j * block_q, block_q), :]
+        dd = dd_ref[pl.ds(j * block_q, block_q), :]
+        s_blk = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
+        q_pos = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        live = jnp.logical_and(q_pos >= k_pos, kmask)
+        p = jnp.where(live, jnp.exp(s_blk - lse), 0.0)       # [bq, bk]
+        dv_acc = dv_acc + jnp.dot(p.T, do_blk,
+                                  preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dk_acc = dk_acc + jnp.dot(ds.T, q_blk,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    n_q = pl.cdiv(seq_len, block_q)
+    # causal: q blocks strictly before this kv block see none of it
+    j0 = (k_blk_idx * block_k) // block_q
+    dk, dv = jax.lax.fori_loop(
+        j0, n_q, body, (jnp.zeros((block_k, d), jnp.float32),
+                        jnp.zeros((block_k, d), jnp.float32)))
+    # dk absorbs the q-side scale (q was pre-scaled), which equals the
+    # symmetric scale on s = scale·q·kᵀ
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _interp():
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd(q, k, v, mask, block_q: int, block_k: int):
     import jax.experimental.pallas as pl
 
     b, s, h, d = q.shape
@@ -139,7 +238,7 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     grid = (b * h, pl.cdiv(s, block_q))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k, seq_len=s,
                           scale=scale),
         grid=grid,
@@ -147,37 +246,123 @@ def _flash_fwd(q, k, v, block_q: int, block_k: int):
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda i, j, h=h: (i // h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
+        interpret=_interp(),
+    )(qf, kf, vf, mask)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd(q, k, v, mask, o, lse, g, block_q: int, block_k: int):
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    gf = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    of = o.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # D_i = Σ_d dO_i ∘ O_i — one cheap elementwise pass in XLA
+    dd = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                 axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_k=block_k, seq_len=s,
+                          scale=scale),
+        grid=(b * h, pl.cdiv(s, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda i, j, h=h: (i // h, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        interpret=jax.default_backend() != "tpu",
-    )(qf, kf, vf)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        interpret=_interp(),
+    )(qf, kf, vf, mask, gf, lse, dd)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q, seq_len=s,
+                          scale=scale),
+        grid=(b * h, pl.cdiv(s, block_k)),
+        in_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda i, j, h=h: (i // h, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        ],
+        interpret=_interp(),
+    )(kf, vf, qf, mask, gf, lse, dd)
+
+    unflat = lambda a: a.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return unflat(dq), unflat(dk), unflat(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_causal_attention(q, k, v, block_q: int = 128, block_k: int = 128):
-    """Pallas flash-attention forward; backward recomputes via the dense
-    path (activation-memory trade documented in the module docstring)."""
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
-    return _flash_fwd(q, k, v, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, mask, block_q: int, block_k: int):
+    return _flash_fwd(q, k, v, mask, block_q, block_k)[0]
 
 
-def _flash_fwd_rule(q, k, v, block_q, block_k):
-    bq = min(block_q, q.shape[1])
-    bk = min(block_k, k.shape[1])
-    return _flash_fwd(q, k, v, bq, bk), (q, k, v)
+def _flash_fwd_rule(q, k, v, mask, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, mask, block_q, block_k)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _flash_bwd_rule(block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(dense_causal_attention, q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, mask, out, lse, g, block_q, block_k)
+    return dq, dk, dv, jnp.zeros_like(mask)
 
 
-flash_causal_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _fit_block(s: int, want: int) -> int:
+    """Largest block size <= ``want`` that divides ``s``. Pallas dynamic
+    slices CLAMP out-of-bounds starts, so a partial trailing block would
+    silently read re-labeled K/V rows — block sizes must divide the
+    sequence length exactly."""
+    b = min(want, s)
+    while s % b:
+        b -= 1
+    return b
+
+
+def flash_causal_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                           attn_mask: Optional[jnp.ndarray] = None):
+    """Pallas flash attention, fused fwd+bwd (see module docstring).
+    ``attn_mask``: optional [b, s] key-padding mask (1 = real)."""
+    s = q.shape[1]
+    block_q = _fit_block(s, block_q)
+    block_k = _fit_block(s, block_k)
+    if attn_mask is None:
+        mask = jnp.ones((q.shape[0], s, 1), jnp.float32)
+    else:
+        mask = attn_mask.astype(jnp.float32)[:, :, None]
+    return _flash(q, k, v, mask, block_q, block_k)
 
 
 # ----------------------------------------------------------------- ring ----

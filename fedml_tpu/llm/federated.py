@@ -45,7 +45,12 @@ def llm_config_from_args(args) -> LLMConfig:
         num_kv_heads=getattr(args, "llm_num_kv_heads", None),
         max_seq_len=int(getattr(args, "llm_max_seq_len", 128)),
         dtype=dtype,
-        attention_impl=str(getattr(args, "llm_attention_impl", "dense")),
+        # default: the fused Pallas flash kernels on TPU (O(s·block) memory
+        # in both directions), dense elsewhere (interpret-mode flash is for
+        # tests, not training)
+        attention_impl=str(getattr(args, "llm_attention_impl", None)
+                           or ("flash" if jax.default_backend() == "tpu"
+                               else "dense")),
     )
 
 

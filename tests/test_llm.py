@@ -49,10 +49,63 @@ def test_flash_matches_dense():
     flash = flash_causal_attention(q, k, v, 8, 8)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
                                atol=1e-5)
-    # gradients flow (backward recomputes via dense path)
-    g = jax.grad(lambda q: flash_causal_attention(q, k, v, 8, 8).sum())(q)
-    gd = jax.grad(lambda q: dense_causal_attention(q, k, v).sum())(q)
-    np.testing.assert_allclose(np.asarray(g), np.asarray(gd), atol=1e-4)
+    # backward is the Pallas dQ/dKdV kernel pair — parity for ALL inputs
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) * jnp.cos(
+            jnp.arange(q.shape[-1], dtype=jnp.float32))).sum()
+    gd = jax.grad(loss(dense_causal_attention), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss(lambda q, k, v: flash_causal_attention(q, k, v, 8, 8)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_key_padding_mask():
+    """Flash supports key-padding masks in both directions; masked keys get
+    zero probability (fwd parity vs dense) and zero dK/dV rows."""
+    rng = jax.random.PRNGKey(1)
+    b, s, h, d = 2, 32, 2, 8
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (b, s, h, d))
+               for i in range(3))
+    mask = (jax.random.uniform(rng, (b, s)) > 0.3).astype(jnp.float32)
+    mask = mask.at[:, 0].set(1.0)  # row 0 live so no query sees zero keys
+    dense = dense_causal_attention(q, k, v, attn_mask=mask)
+    flash = flash_causal_attention(q, k, v, 8, 8, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
+                               atol=1e-5)
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+    gd = jax.grad(loss(lambda q, k, v: dense_causal_attention(
+        q, k, v, attn_mask=mask)), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss(lambda q, k, v: flash_causal_attention(
+        q, k, v, 8, 8, attn_mask=mask)), argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
+    # masked keys contribute nothing: their dK/dV rows are exactly zero
+    dk, dv = np.asarray(gf[1]), np.asarray(gf[2])
+    dead = np.asarray(mask) == 0
+    assert np.all(dk[dead] == 0) and np.all(dv[dead] == 0)
+
+
+def test_flash_bwd_never_materializes_scores():
+    """Training-memory property: at s=4096 the compiled fwd+bwd must not
+    allocate an [s, s] f32 buffer (64 MiB); flash peak temp stays under a
+    quarter of that. TPU-only — interpret mode has no memory contract."""
+    import pytest
+    if jax.default_backend() != "tpu":
+        pytest.skip("memory contract is a compiled-TPU property")
+    s, d = 4096, 64
+    q = jnp.zeros((1, s, 1, d), jnp.bfloat16)
+
+    def train_loss(q, k, v):
+        return flash_causal_attention(q, k, v).astype(jnp.float32).sum()
+
+    compiled = jax.jit(jax.grad(train_loss, argnums=(0, 1, 2))).lower(
+        q, q, q).compile()
+    mem = compiled.memory_analysis()
+    scores_bytes = s * s * 4
+    assert mem.temp_size_in_bytes < scores_bytes // 4, (
+        f"temp {mem.temp_size_in_bytes} vs scores {scores_bytes}")
 
 
 def test_ring_matches_dense_multidevice():
