@@ -1,0 +1,458 @@
+"""MLOps agent daemons over the pub/sub control plane.
+
+Parity target: the reference's scheduler agents — a slave agent binds to
+the platform, receives start/stop-run commands over MQTT topics, executes
+jobs, and streams status back through a message center with a retry queue
+(``computing/scheduler/scheduler_core/message_center.py:21,184``,
+``status_center.py:18,178``, ``slave/base_slave_protocol_manager.py``).
+
+TPU-native redesign, local-first: the transport is the repo's own stdlib
+pub/sub broker (``core/distributed/communication/pubsub``, the MQTT
+analogue with last-will), job execution is :mod:`fedml_tpu.api`'s run
+registry (subprocess + meta.json), and the daemons are threads or
+standalone processes (``python -m fedml_tpu.cli agent``).
+
+Topic scheme (reference ``flclient_agent/<edge>/start_train`` shape):
+
+- ``flclient_agent/<device>/start_train``  master -> slave: job spec
+- ``flclient_agent/<device>/stop_train``   master -> slave: stop a run
+- ``fl_client/mlops/status``               slave -> master: device/run status
+- ``fl_client/agent/online``               slave presence; last-will posts
+  the OFFLINE payload on abnormal disconnect
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.distributed.communication.pubsub import (_recv_frame,
+                                                     _send_frame)
+
+logger = logging.getLogger(__name__)
+
+# device statuses (reference status_center.py DeviceStatus, reduced to the
+# lifecycle a local-first deployment has)
+DEVICE_IDLE = "IDLE"
+DEVICE_RUNNING = "RUNNING"
+DEVICE_OFFLINE = "OFFLINE"
+
+# job statuses re-exported from the run registry plus the pre-launch one
+JOB_PROVISIONING = "PROVISIONING"
+JOB_RUNNING = "RUNNING"
+JOB_FINISHED = "FINISHED"
+JOB_FAILED = "FAILED"
+JOB_KILLED = "KILLED"
+
+TOPIC_STATUS = "fl_client/mlops/status"
+TOPIC_ONLINE = "fl_client/agent/online"
+
+
+def _topic_start(device_id: int) -> str:
+    return f"flclient_agent/{device_id}/start_train"
+
+
+def _topic_stop(device_id: int) -> str:
+    return f"flclient_agent/{device_id}/stop_train"
+
+
+class MessageCenter:
+    """Broker client with a durable sender: publishes ride a queue drained
+    by a sender thread with bounded retries, and sent/received records land
+    in JSONL files (reference ``message_center.py`` RETRY_COUNT=3 +
+    message-sent-records.log). Subscriptions dispatch to topic handlers on
+    a receive thread."""
+
+    RETRY_COUNT = 3
+    RETRY_DELAY_S = 0.5
+
+    def __init__(self, broker_host: str, broker_port: int,
+                 record_dir: Optional[str] = None,
+                 will_topic: Optional[str] = None,
+                 will_payload: Optional[dict] = None):
+        self._addr = (broker_host, int(broker_port))
+        self._handlers: Dict[str, Callable[[dict], None]] = {}
+        self._subs: List[str] = []
+        self._will = (will_topic, will_payload)
+        self._sock: Optional[socket.socket] = None
+        self._sock_lock = threading.Lock()
+        self._queue: List[dict] = []
+        self._queue_cv = threading.Condition()
+        self._running = False
+        self._record_dir = record_dir
+        if record_dir:
+            os.makedirs(record_dir, exist_ok=True)
+
+    # --- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._connect()
+        self._running = True
+        threading.Thread(target=self._recv_loop, daemon=True).start()
+        threading.Thread(target=self._send_loop, daemon=True).start()
+
+    def stop(self, graceful: bool = True) -> None:
+        self._running = False
+        with self._queue_cv:
+            self._queue_cv.notify_all()
+        with self._sock_lock:
+            if self._sock is not None:
+                try:
+                    if graceful:
+                        _send_frame(self._sock, {"kind": "disconnect"})
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(self._addr)
+        for topic in self._subs:
+            _send_frame(sock, {"kind": "sub", "topic": topic})
+        if self._will[0] is not None:
+            _send_frame(sock, {"kind": "lwt", "topic": self._will[0],
+                               "payload": json.dumps(self._will[1])})
+        self._sock = sock
+
+    # --- pub/sub -----------------------------------------------------------
+    def subscribe(self, topic: str, handler: Callable[[dict], None]) -> None:
+        self._handlers[topic] = handler
+        self._subs.append(topic)
+        with self._sock_lock:
+            if self._sock is not None:
+                _send_frame(self._sock, {"kind": "sub", "topic": topic})
+
+    def publish(self, topic: str, payload: dict) -> None:
+        """Enqueue for the durable sender (returns immediately)."""
+        with self._queue_cv:
+            self._queue.append({"topic": topic, "payload": payload,
+                                "id": uuid.uuid4().hex, "tries": 0})
+            self._queue_cv.notify()
+
+    def _record(self, name: str, entry: dict) -> None:
+        if not self._record_dir:
+            return
+        try:
+            with open(os.path.join(self._record_dir, name), "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError:
+            pass
+
+    def _send_loop(self) -> None:
+        while True:
+            with self._queue_cv:
+                while self._running and not self._queue:
+                    self._queue_cv.wait(timeout=1.0)
+                if not self._running:
+                    return
+                item = self._queue.pop(0)
+            self._record("message-sent-records.log",
+                         {"id": item["id"], "topic": item["topic"],
+                          "ts": time.time()})
+            ok = False
+            while item["tries"] < self.RETRY_COUNT and not ok:
+                item["tries"] += 1
+                try:
+                    with self._sock_lock:
+                        if self._sock is None:
+                            self._connect()
+                        _send_frame(self._sock, {
+                            "kind": "pub", "topic": item["topic"],
+                            "payload": json.dumps(item["payload"])})
+                    ok = True
+                except OSError as e:
+                    logger.warning("message center: publish failed "
+                                   "(try %d/%d): %s", item["tries"],
+                                   self.RETRY_COUNT, e)
+                    with self._sock_lock:
+                        self._sock = None
+                    time.sleep(self.RETRY_DELAY_S * item["tries"])
+            if ok:
+                self._record("message-sent-success-records.log",
+                             {"id": item["id"], "topic": item["topic"],
+                              "ts": time.time()})
+            else:
+                self._record("message-dropped-records.log",
+                             {"id": item["id"], "topic": item["topic"],
+                              "ts": time.time()})
+
+    def _recv_loop(self) -> None:
+        backoff = 0.2
+        while self._running:
+            with self._sock_lock:
+                sock = self._sock
+            if sock is None:
+                # reconnect here too: a recv-only agent (a slave waiting
+                # for commands) would otherwise go permanently deaf after
+                # a broker restart — _connect replays subscriptions + LWT
+                try:
+                    with self._sock_lock:
+                        if self._sock is None:
+                            self._connect()
+                    backoff = 0.2
+                except OSError:
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+                continue
+            try:
+                frame = _recv_frame(sock)
+            except OSError:
+                frame = None
+            if frame is None:
+                with self._sock_lock:
+                    if self._sock is sock:  # dead socket: force reconnect
+                        self._sock = None
+                if self._running:
+                    time.sleep(0.2)
+                continue
+            topic = frame.get("topic")
+            try:
+                payload = json.loads(frame.get("payload"))
+            except (TypeError, ValueError):
+                logger.warning("message center: undecodable payload on %r",
+                               topic)
+                continue
+            self._record("message-received-records.log",
+                         {"topic": topic, "ts": time.time()})
+            handler = self._handlers.get(topic)
+            if handler is None:
+                continue
+            try:
+                handler(payload)
+            except Exception:  # a bad handler must not kill the daemon
+                logger.exception("message center: handler for %r failed",
+                                 topic)
+
+
+class SlaveAgent:
+    """Compute-agent daemon (reference ``base_slave_protocol_manager``):
+    binds to the broker, executes start-train commands through the local
+    run registry, streams status transitions back, and dies loudly (the
+    broker fires its last-will) on abnormal disconnect."""
+
+    def __init__(self, device_id: int, broker_host: str, broker_port: int,
+                 poll_s: float = 0.5):
+        self.device_id = int(device_id)
+        self.poll_s = poll_s
+        from ..api import _runs_root
+        self.center = MessageCenter(
+            broker_host, broker_port,
+            record_dir=os.path.join(_runs_root(), f"agent_{device_id}"),
+            will_topic=TOPIC_ONLINE,
+            will_payload={"device_id": self.device_id,
+                          "status": DEVICE_OFFLINE})
+        # request run-id -> registry run-id (for stop routing)
+        self.runs: Dict[str, str] = {}
+        self._watchers: Dict[str, threading.Thread] = {}
+
+    def start(self) -> None:
+        c = self.center
+        c.subscribe(_topic_start(self.device_id), self._on_start)
+        c.subscribe(_topic_stop(self.device_id), self._on_stop)
+        c.start()
+        c.publish(TOPIC_ONLINE, {"device_id": self.device_id,
+                                 "status": DEVICE_IDLE})
+
+    def stop(self) -> None:
+        self.center.stop()
+
+    def _status(self, request_id: str, status: str, **extra) -> None:
+        self.center.publish(TOPIC_STATUS, {
+            "device_id": self.device_id, "request_id": request_id,
+            "status": status, "ts": time.time(), **extra})
+
+    def _on_start(self, payload: dict) -> None:
+        from .. import api
+        request_id = str(payload.get("request_id") or uuid.uuid4().hex)
+        self._status(request_id, JOB_PROVISIONING)
+        if "job_yaml_content" in payload:
+            # the master ships yaml CONTENT (master and agent need not
+            # share a filesystem); materialize a job dir that also serves
+            # as the default workspace
+            from ..api import _runs_root
+            jdir = os.path.join(_runs_root(), f"agent_{self.device_id}",
+                                "jobs", request_id)
+            os.makedirs(jdir, exist_ok=True)
+            yaml_file = os.path.join(
+                jdir, payload.get("job_yaml_name") or "job.yaml")
+            with open(yaml_file, "w") as f:
+                f.write(payload["job_yaml_content"])
+        else:  # same-host dispatch may still send a path
+            yaml_file = payload.get("job_yaml")
+        res = api.launch_job(yaml_file)
+        if res.result_code != 0:
+            self._status(request_id, JOB_FAILED,
+                         error=res.result_message)
+            return
+        self.runs[request_id] = res.run_id
+        self._status(request_id, JOB_RUNNING, run_id=res.run_id)
+        t = threading.Thread(target=self._watch, args=(request_id,
+                                                       res.run_id),
+                             daemon=True)
+        self._watchers[request_id] = t
+        t.start()
+
+    def _watch(self, request_id: str, run_id: str) -> None:
+        from .. import api
+        while True:
+            status = api.run_status(run_id)
+            if status is None:
+                self._status(request_id, JOB_FAILED, error="run lost")
+                return
+            if status != api.STATUS_RUNNING:
+                self._status(request_id, status, run_id=run_id,
+                             log_tail=api.run_logs(run_id, tail=5))
+                return
+            time.sleep(self.poll_s)
+
+    def _on_stop(self, payload: dict) -> None:
+        from .. import api
+        request_id = str(payload.get("request_id", ""))
+        run_id = self.runs.get(request_id)
+        if run_id is None:
+            self._status(request_id, JOB_FAILED, error="unknown run")
+            return
+        api.run_stop(run_id)
+        # the watcher thread reports the terminal KILLED status
+
+
+class MasterAgent:
+    """Server-side agent (reference master protocol manager + status
+    center): tracks the device table from presence/last-will messages and
+    the per-request job status FSM from the status topic; dispatches
+    start/stop commands."""
+
+    def __init__(self, broker_host: str, broker_port: int):
+        from ..api import _runs_root
+        self.center = MessageCenter(
+            broker_host, broker_port,
+            record_dir=os.path.join(_runs_root(), "agent_master"))
+        self.devices: Dict[int, Dict[str, Any]] = {}
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self._cv = threading.Condition()
+
+    def start(self) -> None:
+        self.center.subscribe(TOPIC_ONLINE, self._on_presence)
+        self.center.subscribe(TOPIC_STATUS, self._on_status)
+        self.center.start()
+
+    def stop(self) -> None:
+        self.center.stop()
+
+    def _on_presence(self, payload: dict) -> None:
+        with self._cv:
+            did = int(payload.get("device_id", -1))
+            self.devices[did] = {"status": payload.get("status"),
+                                 "ts": time.time()}
+            self._cv.notify_all()
+
+    def _on_status(self, payload: dict) -> None:
+        with self._cv:
+            rid = str(payload.get("request_id", ""))
+            job = self.jobs.setdefault(rid, {"history": []})
+            job["history"].append(payload)
+            job["status"] = payload.get("status")
+            job["device_id"] = payload.get("device_id")
+            if "run_id" in payload:
+                job["run_id"] = payload["run_id"]
+            did = int(payload.get("device_id", -1))
+            dev = self.devices.setdefault(did, {})
+            dev["status"] = (DEVICE_RUNNING
+                             if payload.get("status") == JOB_RUNNING
+                             else DEVICE_IDLE)
+            dev["ts"] = time.time()
+            self._cv.notify_all()
+
+    # --- commands ----------------------------------------------------------
+    def dispatch(self, device_id: int, job_yaml: str,
+                 request_id: Optional[str] = None) -> str:
+        """Send a start-train command; returns the request id used to track
+        the job on the status FSM. The yaml CONTENT is shipped (not the
+        path) so the agent can live on another machine; its workspace
+        defaults to the agent-side job dir."""
+        request_id = request_id or uuid.uuid4().hex
+        path = os.path.abspath(os.path.expanduser(job_yaml))
+        try:
+            with open(path) as f:
+                content = f.read()
+        except OSError as e:
+            # still dispatch: the slave reports the failure through the
+            # status FSM so the caller sees FAILED rather than an exception
+            content = None
+            logger.warning("dispatch: cannot read %s (%s); sending path",
+                           path, e)
+        msg = {"request_id": request_id}
+        if content is not None:
+            msg["job_yaml_content"] = content
+            msg["job_yaml_name"] = os.path.basename(path)
+        else:
+            msg["job_yaml"] = path
+        self.center.publish(_topic_start(device_id), msg)
+        with self._cv:
+            self.jobs.setdefault(request_id, {"history": []})[
+                "device_id"] = device_id
+        return request_id
+
+    def stop_job(self, request_id: str) -> None:
+        with self._cv:
+            device_id = self.jobs.get(request_id, {}).get("device_id")
+        if device_id is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        self.center.publish(_topic_stop(int(device_id)),
+                            {"request_id": request_id})
+
+    # --- queries -----------------------------------------------------------
+    def job_status(self, request_id: str) -> Optional[str]:
+        with self._cv:
+            return self.jobs.get(request_id, {}).get("status")
+
+    def wait_for_status(self, request_id: str, statuses,
+                        timeout_s: float = 60.0) -> Optional[str]:
+        if isinstance(statuses, str):
+            statuses = {statuses}
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while True:
+                cur = self.jobs.get(request_id, {}).get("status")
+                if cur in statuses:
+                    return cur
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return cur
+                self._cv.wait(timeout=min(remaining, 1.0))
+
+    def wait_for_device(self, device_id: int, status: str,
+                        timeout_s: float = 60.0) -> Optional[str]:
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while True:
+                cur = self.devices.get(int(device_id), {}).get("status")
+                if cur == status:
+                    return cur
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return cur
+                self._cv.wait(timeout=min(remaining, 1.0))
+
+
+def launch_job_remote(job_yaml: str, device_id: int, master: MasterAgent,
+                      timeout_s: float = 120.0) -> Dict[str, Any]:
+    """``fedml launch --remote`` analogue: dispatch through the master
+    agent's broker and wait for a terminal status."""
+    rid = master.dispatch(device_id, job_yaml)
+    final = master.wait_for_status(
+        rid, {JOB_FINISHED, JOB_FAILED, JOB_KILLED}, timeout_s=timeout_s)
+    with master._cv:
+        info = dict(master.jobs.get(rid, {}))
+    info["request_id"] = rid
+    info["status"] = final
+    return info

@@ -24,18 +24,65 @@ def login(api_key):
     sys.exit(rc)
 
 
+def _parse_hostport(value, flag):
+    host, _, port = value.partition(":")
+    if not host or not port or not port.isdigit():
+        click.echo(f"{flag} must be HOST:PORT, got {value!r}", err=True)
+        sys.exit(2)
+    return host, int(port)
+
+
 @cli.command("launch", help="Launch a job yaml (task job or training "
-                            "config) as a local run")
+                            "config) as a local run, or dispatch it to a "
+                            "remote agent over the broker with --remote")
 @click.argument("yaml_file")
 @click.option("--blocking", is_flag=True, default=False,
               help="wait for the job instead of detaching")
-def launch(yaml_file, blocking):
+@click.option("--remote", default=None, metavar="HOST:PORT",
+              help="dispatch via the pub/sub broker to an agent daemon")
+@click.option("--device-id", type=int, default=None,
+              help="target agent device id (required with --remote)")
+def launch(yaml_file, blocking, remote, device_id):
     from .. import api
+    if remote:
+        if device_id is None:
+            click.echo("--remote requires --device-id", err=True)
+            sys.exit(2)
+        from ..agents import MasterAgent, launch_job_remote
+        host, port = _parse_hostport(remote, "--remote")
+        master = MasterAgent(host, port)
+        master.start()
+        try:
+            info = launch_job_remote(yaml_file, device_id, master)
+        finally:
+            master.stop()
+        click.echo(f"{info.get('run_id', '?')} {info['status']}")
+        sys.exit(0 if info["status"] == "FINISHED" else 1)
     res = api.launch_job(yaml_file, detach=not blocking)
     if res.result_code != 0:
         click.echo(f"launch failed: {res.result_message}", err=True)
         sys.exit(1)
     click.echo(res.run_id)
+
+
+@cli.command("agent", help="Run the compute-agent daemon: binds to the "
+                           "broker, executes start-train commands, streams "
+                           "status back (reference slave agent)")
+@click.option("--broker", required=True, metavar="HOST:PORT")
+@click.option("--device-id", type=int, required=True)
+def agent(broker, device_id):
+    import signal
+    import threading
+    from ..agents import SlaveAgent
+    host, port = _parse_hostport(broker, "--broker")
+    daemon = SlaveAgent(device_id, host, port)
+    daemon.start()
+    click.echo(f"agent {device_id} bound to {broker}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    daemon.stop()
 
 
 @cli.group("run", help="Inspect and control runs")

@@ -243,7 +243,8 @@ class SecAggServerManager(FedMLCommManager):
         # liveness floor: even with round_timeout_s unset, a crashed peer
         # must eventually abort the session instead of deadlocking it —
         # generous so first-compile stalls (~40s tunneled) never trip it
-        self._leash_s = max(3.0 * self.round_timeout, 300.0)
+        self._leash_s = (3.0 * self.round_timeout if self.round_timeout > 0
+                         else 300.0)
 
     def register_message_receive_handlers(self) -> None:
         h = self.register_message_receive_handler

@@ -1,0 +1,146 @@
+"""MLOps agent daemons over the pub/sub broker: master dispatches
+start/stop-train over topics, slave executes via the run registry and
+streams status back, last-will flags dead agents."""
+
+import os
+import textwrap
+import time
+
+import pytest
+
+from fedml_tpu.agents import (DEVICE_IDLE, DEVICE_OFFLINE, JOB_FINISHED,
+                              JOB_KILLED, JOB_RUNNING, MasterAgent,
+                              SlaveAgent, launch_job_remote)
+from fedml_tpu.core.distributed.communication.pubsub import PubSubBroker
+
+
+@pytest.fixture()
+def registry(tmp_path, monkeypatch):
+    monkeypatch.setenv("FEDML_TPU_RUNS_DIR", str(tmp_path / "runs"))
+    return tmp_path
+
+
+@pytest.fixture()
+def cluster(registry):
+    broker = PubSubBroker()
+    master = MasterAgent("127.0.0.1", broker.port)
+    master.start()
+    slave = SlaveAgent(device_id=7, broker_host="127.0.0.1",
+                       broker_port=broker.port, poll_s=0.1)
+    slave.start()
+    assert master.wait_for_device(7, DEVICE_IDLE, timeout_s=10) == DEVICE_IDLE
+    yield broker, master, slave
+    slave.stop()
+    master.stop()
+    broker.stop()
+
+
+def _job_yaml(tmp_path, body: str, name="job.yaml") -> str:
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def test_remote_launch_to_finished(cluster, registry):
+    _, master, _ = cluster
+    yml = _job_yaml(registry, """
+        job: echo agent-ran > out.txt
+        workspace: .
+    """)
+    info = launch_job_remote(yml, device_id=7, master=master, timeout_s=30)
+    assert info["status"] == JOB_FINISHED, info
+    # the full FSM was streamed: PROVISIONING -> RUNNING -> FINISHED
+    seen = [h["status"] for h in info["history"]]
+    assert seen[0] == "PROVISIONING" and JOB_RUNNING in seen
+    # yaml CONTENT was shipped: the job ran in the AGENT's job dir, not in
+    # the master-side yaml's directory
+    out = (registry / "runs" / "agent_7" / "jobs" / info["request_id"]
+           / "out.txt")
+    assert out.read_text().strip() == "agent-ran"
+    assert not (registry / "out.txt").exists()
+
+
+def test_remote_stop_kills_run(cluster, registry):
+    _, master, _ = cluster
+    yml = _job_yaml(registry, """
+        job: sleep 60
+        workspace: .
+    """)
+    rid = master.dispatch(7, yml)
+    assert master.wait_for_status(rid, JOB_RUNNING, timeout_s=30) \
+        == JOB_RUNNING
+    master.stop_job(rid)
+    assert master.wait_for_status(rid, {JOB_KILLED}, timeout_s=30) \
+        == JOB_KILLED
+
+
+def test_bad_job_reports_failed(cluster, registry):
+    _, master, _ = cluster
+    info = launch_job_remote(str(registry / "missing.yaml"), device_id=7,
+                             master=master, timeout_s=30)
+    assert info["status"] == "FAILED"
+
+
+def test_last_will_marks_device_offline(registry):
+    broker = PubSubBroker()
+    master = MasterAgent("127.0.0.1", broker.port)
+    master.start()
+    slave = SlaveAgent(device_id=3, broker_host="127.0.0.1",
+                       broker_port=broker.port)
+    slave.start()
+    assert master.wait_for_device(3, DEVICE_IDLE, timeout_s=10) == DEVICE_IDLE
+    # abnormal disconnect (no goodbye): the broker fires the last-will
+    slave.center.stop(graceful=False)
+    assert master.wait_for_device(3, DEVICE_OFFLINE, timeout_s=10) \
+        == DEVICE_OFFLINE
+    master.stop()
+    broker.stop()
+
+
+def test_message_center_records_sent(cluster, registry):
+    _, master, slave = cluster
+    yml = _job_yaml(registry, """
+        job: "true"
+        workspace: .
+    """)
+    launch_job_remote(yml, device_id=7, master=master, timeout_s=30)
+    rec = registry / "runs" / "agent_7" / "message-sent-success-records.log"
+    deadline = time.time() + 5
+    while time.time() < deadline and not rec.exists():
+        time.sleep(0.1)
+    assert rec.exists() and rec.read_text().strip()
+
+
+def test_cli_agent_and_remote_launch(registry):
+    """Full process-level path: `fedml_tpu.cli agent` daemon subprocess +
+    `launch --remote` through the broker."""
+    import subprocess
+    import sys
+
+    broker = PubSubBroker()
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["FEDML_TPU_RUNS_DIR"] = os.environ["FEDML_TPU_RUNS_DIR"]
+    agent_proc = subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu.cli", "agent",
+         "--broker", f"127.0.0.1:{broker.port}", "--device-id", "9"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        yml = _job_yaml(registry, """
+            job: echo cli-remote-ok > cli_out.txt
+            workspace: .
+        """, name="cli_job.yaml")
+        out = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.cli", "launch", yml,
+             "--remote", f"127.0.0.1:{broker.port}", "--device-id", "9"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "FINISHED" in out.stdout
+        hits = list((registry / "runs" / "agent_9" / "jobs").glob(
+            "*/cli_out.txt"))
+        assert hits and hits[0].read_text().strip() == "cli-remote-ok"
+    finally:
+        agent_proc.terminate()
+        agent_proc.wait(timeout=10)
+        broker.stop()
