@@ -164,6 +164,10 @@ class MessageCenter:
                 try:
                     with self._sock_lock:
                         if self._sock is None:
+                            if not self._running:
+                                break  # stopped: don't resurrect the
+                                # socket (it would re-install the LWT and
+                                # later fire a spurious OFFLINE)
                             self._connect()
                         _send_frame(self._sock, {
                             "kind": "pub", "topic": item["topic"],
@@ -252,6 +256,7 @@ class SlaveAgent:
                           "status": DEVICE_OFFLINE})
         # request run-id -> registry run-id (for stop routing)
         self.runs: Dict[str, str] = {}
+        self._seen_requests = set()
         self._watchers: Dict[str, threading.Thread] = {}
 
     def start(self) -> None:
@@ -273,6 +278,15 @@ class SlaveAgent:
     def _on_start(self, payload: dict) -> None:
         from .. import api
         request_id = str(payload.get("request_id") or uuid.uuid4().hex)
+        # idempotency: the master re-publishes start_train until it sees a
+        # status (the broker has no retained messages, so a command sent
+        # before this agent subscribed is simply gone) — a duplicate must
+        # re-announce, never re-execute
+        if request_id in self._seen_requests:
+            self._status(request_id, JOB_RUNNING,
+                         run_id=self.runs.get(request_id))
+            return
+        self._seen_requests.add(request_id)
         self._status(request_id, JOB_PROVISIONING)
         if "job_yaml_content" in payload:
             # the master ships yaml CONTENT (master and agent need not
@@ -288,6 +302,12 @@ class SlaveAgent:
                 f.write(payload["job_yaml_content"])
         else:  # same-host dispatch may still send a path
             yaml_file = payload.get("job_yaml")
+        if not yaml_file:
+            # a malformed command must surface as FAILED, not stall the
+            # requester's FSM at PROVISIONING until their timeout
+            self._status(request_id, JOB_FAILED,
+                         error="start_train without job yaml")
+            return
         res = api.launch_job(yaml_file)
         if res.result_code != 0:
             self._status(request_id, JOB_FAILED,
@@ -358,17 +378,23 @@ class MasterAgent:
     def _on_status(self, payload: dict) -> None:
         with self._cv:
             rid = str(payload.get("request_id", ""))
+            status = payload.get("status")
             job = self.jobs.setdefault(rid, {"history": []})
             job["history"].append(payload)
-            job["status"] = payload.get("status")
+            job["status"] = status
             job["device_id"] = payload.get("device_id")
             if "run_id" in payload:
                 job["run_id"] = payload["run_id"]
             did = int(payload.get("device_id", -1))
             dev = self.devices.setdefault(did, {})
-            dev["status"] = (DEVICE_RUNNING
-                             if payload.get("status") == JOB_RUNNING
-                             else DEVICE_IDLE)
+            # a device is RUNNING while ANY of its jobs runs — one job's
+            # PROVISIONING/terminal status must not mark a busy device idle
+            running = dev.setdefault("running", set())
+            if status in (JOB_RUNNING, JOB_PROVISIONING):
+                running.add(rid)
+            else:
+                running.discard(rid)
+            dev["status"] = DEVICE_RUNNING if running else DEVICE_IDLE
             dev["ts"] = time.time()
             self._cv.notify_all()
 
@@ -445,12 +471,25 @@ class MasterAgent:
 
 
 def launch_job_remote(job_yaml: str, device_id: int, master: MasterAgent,
-                      timeout_s: float = 120.0) -> Dict[str, Any]:
+                      timeout_s: float = 120.0,
+                      redispatch_s: float = 3.0) -> Dict[str, Any]:
     """``fedml launch --remote`` analogue: dispatch through the master
-    agent's broker and wait for a terminal status."""
+    agent's broker and wait for a terminal status. The broker keeps no
+    retained messages, so until the FIRST status arrives the command is
+    re-published every ``redispatch_s`` (agents dedup by request id) —
+    an agent that subscribed a beat after the dispatch still gets it."""
     rid = master.dispatch(device_id, job_yaml)
+    deadline = time.time() + timeout_s
+    while (master.job_status(rid) is None
+           and time.time() < deadline):
+        master.wait_for_status(rid, {JOB_PROVISIONING, JOB_RUNNING,
+                                     JOB_FINISHED, JOB_FAILED, JOB_KILLED},
+                               timeout_s=redispatch_s)
+        if master.job_status(rid) is None:
+            master.dispatch(device_id, job_yaml, request_id=rid)
     final = master.wait_for_status(
-        rid, {JOB_FINISHED, JOB_FAILED, JOB_KILLED}, timeout_s=timeout_s)
+        rid, {JOB_FINISHED, JOB_FAILED, JOB_KILLED},
+        timeout_s=max(deadline - time.time(), 0.0))
     with master._cv:
         info = dict(master.jobs.get(rid, {}))
     info["request_id"] = rid

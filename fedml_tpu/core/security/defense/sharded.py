@@ -20,7 +20,7 @@ are replicated. Parity with the host path is asserted in tests.
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,15 +36,47 @@ _SHARDED = ("krum", "multi_krum", "coordinate_median", "median",
             "trimmed_mean", "mean", "three_sigma")
 
 
+def _apply_attack_shard(attack_type: str, mat_s, byz_mask, key, scale,
+                        axis: str):
+    """Model-poisoning injection on a FEATURE shard of the update matrix —
+    the on-device counterpart of FedMLAttacker.poison_updates. Row-wise
+    transforms (flip/zero/replacement) are shard-exact; stochastic attacks
+    fold the shard index into the key so noise differs per shard (the
+    stream therefore depends on the mesh layout, unlike the host path —
+    fine for attacks, which model an adversary, not a reproducible rng)."""
+    from ..attack import (byzantine_flip, byzantine_random, byzantine_zero,
+                          gaussian_noise, lazy_worker, model_replacement)
+    key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+    if attack_type == "byzantine_random":
+        return byzantine_random(mat_s, byz_mask, key, scale)
+    if attack_type == "byzantine_zero":
+        return byzantine_zero(mat_s, byz_mask)
+    if attack_type == "byzantine_flip":
+        return byzantine_flip(mat_s, byz_mask, scale)
+    if attack_type == "model_replacement":
+        boost = scale if scale != 1.0 else float(mat_s.shape[0])
+        return model_replacement(mat_s, byz_mask, boost)
+    if attack_type == "gaussian_noise":
+        return gaussian_noise(mat_s, key, scale)
+    if attack_type == "lazy_worker":
+        return lazy_worker(mat_s, byz_mask, key)
+    return mat_s
+
+
 @lru_cache(maxsize=32)
 def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
                       byzantine_count: int, multi_k: int,
-                      trim_fraction: float):
+                      trim_fraction: float,
+                      attack_type: Optional[str] = None,
+                      attack_scale: float = 1.0):
     """One compiled kernel per (mesh, defense, params); jit re-traces only
     on new shapes — without this cache every round would recompile."""
 
-    def body(mat_s, weights):
+    def body(mat_s, weights, byz_mask, key):
         # mat_s: [K, D/n] local shard
+        if attack_type is not None:
+            mat_s = _apply_attack_shard(attack_type, mat_s, byz_mask, key,
+                                        attack_scale, axis)
         if defense_type in ("coordinate_median", "median"):
             vec, _ = robust_agg.coordinate_median(mat_s, weights)
             return vec
@@ -69,7 +101,7 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
 
     return jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(None, axis), P()),
+        in_specs=(P(None, axis), P(), P(), P()),
         out_specs=P(axis),
         check_vma=False,
     ))
@@ -104,20 +136,34 @@ def defend_matrix_sharded(
     byzantine_count: int = 0,
     multi_k: int = 1,
     trim_fraction: float = 0.1,
+    attack_type: Optional[str] = None,
+    attack_scale: float = 1.0,
+    byz_mask: Optional[jnp.ndarray] = None,
+    attack_key: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """[K, D] (feature-sharded over ``axis``) -> defended aggregate [D]
-    (feature-sharded). The caller owns placement; this never gathers D."""
+    (feature-sharded). The caller owns placement; this never gathers D.
+    When ``attack_type`` is set, model poisoning is injected ON DEVICE on
+    the sharded matrix before the defense (the adversarial-evaluation
+    pipeline without any host round-trip)."""
     if not supports_sharded(defense_type):
         raise ValueError(f"{defense_type!r} has no sharded path; host "
                          f"fallback required (supported: {_SHARDED})")
 
     fn = _build_sharded_fn(mesh, axis, defense_type, byzantine_count,
-                           multi_k, float(trim_fraction))
+                           multi_k, float(trim_fraction),
+                           attack_type, float(attack_scale))
     n = mesh.shape[axis]
     d = mat.shape[1]
     pad = (-d) % n
     if pad:
         mat = jnp.pad(mat, ((0, 0), (0, pad)))
     mat = jax.device_put(mat, NamedSharding(mesh, P(None, axis)))
-    out = fn(mat, jnp.asarray(weights, jnp.float32))
+    k = mat.shape[0]
+    if byz_mask is None:
+        byz_mask = jnp.zeros(k, jnp.float32)
+    if attack_key is None:
+        attack_key = jax.random.PRNGKey(0)
+    out = fn(mat, jnp.asarray(weights, jnp.float32),
+             jnp.asarray(byz_mask, jnp.float32), attack_key)
     return out[:d]

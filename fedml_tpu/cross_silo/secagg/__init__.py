@@ -243,8 +243,11 @@ class SecAggServerManager(FedMLCommManager):
         # liveness floor: even with round_timeout_s unset, a crashed peer
         # must eventually abort the session instead of deadlocking it —
         # generous so first-compile stalls (~40s tunneled) never trip it
-        self._leash_s = (3.0 * self.round_timeout if self.round_timeout > 0
-                         else 300.0)
+        # 60s floor: first-round jit compiles stall ~40s on the tunneled
+        # chip; a 3x leash on a tight operator timeout must not abort a
+        # healthy session mid-compile
+        self._leash_s = (max(3.0 * self.round_timeout, 60.0)
+                         if self.round_timeout > 0 else 300.0)
 
     def register_message_receive_handlers(self) -> None:
         h = self.register_message_receive_handler

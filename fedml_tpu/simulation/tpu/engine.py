@@ -400,6 +400,22 @@ class TPUSimulator:
         )
         return jax.jit(shard_fn)
 
+    def _use_sharded_defense(self) -> bool:
+        """Sharded (feature-parallel, no host materialization) defense is
+        the DEFAULT whenever the configured defense supports it; set
+        ``sharded_defense: false`` to force the host path. Contribution
+        assessment and user ServerAggregators need the full matrix, so they
+        keep the host path."""
+        from ...core.security.defense import sharded
+        pref = str(getattr(self.args, "sharded_defense", "auto")
+                   or "auto").lower()
+        if pref in ("false", "0", "no", "host"):
+            return False
+        return (self.defender.is_defense_enabled()
+                and sharded.supports_sharded(self.defender.defense_type)
+                and self.server_aggregator is None
+                and not self.contribution.enabled)
+
     def _robust_aggregate(self, upd_stack, w_stack, sampled, n_slots,
                           round_key, round_idx):
         """Order the [D, S] update grid into sampled-client order, run
@@ -407,6 +423,7 @@ class TPUSimulator:
         SP golden path client-for-client)."""
         from ...core.security.defense import stack_to_matrix
         from ...core.security.defense.robust_agg import weighted_mean
+        from ...core.security.defense import sharded
         counts = [0] * self.n_devices
         rows = []
         for cid in sampled:
@@ -414,29 +431,67 @@ class TPUSimulator:
             rows.append(d * n_slots + counts[d])
             counts[d] += 1
         rows = jnp.asarray(np.asarray(rows, np.int32))
+        ids = np.asarray(sampled)
+
+        if self._use_sharded_defense():
+            # LLM-scale path: flatten + row-order INTO a feature-sharded
+            # layout (out_shardings makes XLA emit the all-to-all; the
+            # replicated [K, D] matrix never exists), inject the model
+            # attack on-device on the shards, defend, all without a host
+            # round-trip. The jitted builders are cached on the instance —
+            # fresh closures per round would recompile every round.
+            if not hasattr(self, "_to_matrix_fn"):
+                mat_sharding = NamedSharding(self.mesh,
+                                             P(None, AXIS_CLIENT))
+                n_dev = self.n_devices
+
+                def to_matrix(upd_stack, rows):
+                    flat = jax.tree_util.tree_map(
+                        lambda a: a.reshape((-1,) + a.shape[2:]), upd_stack)
+                    m = stack_to_matrix(flat)[rows]
+                    pad = (-m.shape[1]) % n_dev  # even feature shards
+                    return jnp.pad(m, ((0, 0), (0, pad))) if pad else m
+
+                self._to_matrix_fn = jax.jit(to_matrix,
+                                             out_shardings=mat_sharding)
+                self._row_select_fn = jax.jit(
+                    lambda ws, r: ws.reshape(-1)[r])
+
+            true_d = int(np.sum([np.prod(l.shape[2:]) for l in
+                                 jax.tree_util.tree_leaves(upd_stack)]))
+            mat = self._to_matrix_fn(upd_stack, rows)
+            w = self._row_select_fn(w_stack, rows)
+            attack_type = (self.attacker.attack_type
+                           if self.attacker.is_model_attack() else None)
+            byz_mask = (jnp.asarray(self.attacker.byzantine_mask(ids),
+                                    jnp.float32)
+                        if attack_type else None)
+            vec = sharded.defend_matrix_sharded(
+                self.mesh, AXIS_CLIENT, mat, w,
+                self.defender.defense_type,
+                byzantine_count=self.defender.byzantine_count,
+                multi_k=self.defender.krum_param_m,
+                trim_fraction=self.defender.trim_fraction,
+                attack_type=attack_type,
+                attack_scale=getattr(self.attacker, "attack_scale", 1.0),
+                byz_mask=byz_mask,
+                attack_key=jax.random.fold_in(round_key, ATTACK_FOLD))
+            agg = vector_to_tree_like(vec[:true_d], self.params)
+            if self.dp.is_global_dp_enabled():
+                agg = self.dp.add_global_noise(
+                    agg, jax.random.fold_in(round_key, DP_CDP_FOLD))
+            return agg
+
         flat = jax.tree_util.tree_map(
             lambda a: a.reshape((-1,) + a.shape[2:]), upd_stack)
         mat = stack_to_matrix(flat)[rows]
         w = w_stack.reshape(-1)[rows]
-        ids = np.asarray(sampled)
         if self.attacker.is_model_attack():
             mat = self.attacker.poison_updates(
                 mat, ids, jax.random.fold_in(round_key, ATTACK_FOLD))
         if self.defender.is_defense_enabled():
-            from ...core.security.defense import sharded
-            if (getattr(self.args, "sharded_defense", False)
-                    and sharded.supports_sharded(self.defender.defense_type)):
-                # LLM-scale path: the [K, D] matrix stays feature-sharded
-                # across the mesh; only [K, K] stats are replicated
-                vec = sharded.defend_matrix_sharded(
-                    self.mesh, AXIS_CLIENT, mat, w,
-                    self.defender.defense_type,
-                    byzantine_count=self.defender.byzantine_count,
-                    multi_k=self.defender.krum_param_m,
-                    trim_fraction=self.defender.trim_fraction)
-            else:
-                vec, _ = self.defender.defend_matrix(
-                    mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+            vec, _ = self.defender.defend_matrix(
+                mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
         elif self.server_aggregator is not None:
             # user-pluggable hook chain (reference server_aggregator.py
             # :44/:75/:90) on the stacked matrix

@@ -260,3 +260,47 @@ class TestShardedDefense:
         vec, info = wbc(mat, jnp.ones(8))
         assert float(info["kept"]) == 6.0
         np.testing.assert_allclose(np.asarray(vec), np.ones(10), atol=1e-5)
+
+
+class TestShardedDefault:
+    def test_sharded_defense_is_default_and_no_host_materialization(self):
+        """With a sharded-capable defense the engine must auto-select the
+        feature-sharded path and never pull the [K, D] update matrix to the
+        host: the whole robust aggregation runs under a device->host
+        transfer guard."""
+        import jax as _jax
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu.core.algframe.client_trainer import (
+            ClassificationTrainer)
+        from fedml_tpu.core.algframe.types import TrainHyper
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.optimizers.registry import create_optimizer
+        from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+        args = sim_args(enable_attack=True, attack_type="byzantine_flip",
+                        byzantine_client_num=2, attack_scale=5.0,
+                        enable_defense=True, defense_type="coordinate_median")
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        sim = TPUSimulator(args, fed, bundle,
+                           create_optimizer(args, spec), spec)
+        assert sim._use_sharded_defense()
+        hyper = TrainHyper(learning_rate=jnp.float32(0.1), epochs=1)
+        with _jax.transfer_guard_device_to_host("disallow"):
+            metrics = sim.run_round(0, hyper)
+        assert float(metrics["count"]) > 0  # readback OUTSIDE the guard
+
+    def test_sharded_path_matches_host_path(self):
+        """Auto-sharded defended round == forced-host defended round."""
+        kw = dict(enable_attack=True, attack_type="byzantine_flip",
+                  byzantine_client_num=2, attack_scale=5.0,
+                  enable_defense=True, defense_type="coordinate_median",
+                  comm_round=2)
+        r_auto = fedml_tpu.run_simulation(backend="tpu", args=sim_args(**kw))
+        r_host = fedml_tpu.run_simulation(
+            backend="tpu", args=sim_args(sharded_defense="false", **kw))
+        for a, b in zip(jax.tree_util.tree_leaves(r_auto["params"]),
+                        jax.tree_util.tree_leaves(r_host["params"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
