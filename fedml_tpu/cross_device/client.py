@@ -65,7 +65,14 @@ class DeviceClientManager(FedMLCommManager):
                                "falling back to jax engine", self.device_id)
                 self.engine = "jax"
             else:
-                self._native = native.NativeLinearTrainer()
+                # trainer chosen by the MODEL's param tree: the CNN engine
+                # for DeviceCNN-shaped trees, the linear engine otherwise
+                # (reference MobileNN dispatches MNN vs torch engines)
+                model = str(getattr(args, "model", "lr")).lower()
+                if model in ("device_cnn", "mobile_cnn"):
+                    self._native = native.NativeCNNTrainer()
+                else:
+                    self._native = native.NativeLinearTrainer()
 
     # --- FSM ---------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:

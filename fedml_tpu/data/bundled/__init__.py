@@ -1,0 +1,12 @@
+"""Small REAL data shards bundled with the framework.
+
+Downloads are environment-gated in many deployments, but several reference
+tasks need real data to be meaningful (BENCH real-data policy). This
+package carries tiny, redistributable shards: public-domain Shakespeare
+text for the LEAF next-word-prediction task (reference
+``data/fed_shakespeare``). Each shard materializes into the on-disk format
+its loader family expects — the Shakespeare shard becomes LEAF train/test
+JSON so it flows through the SAME reader as a full LEAF download.
+"""
+
+from .shakespeare import materialize_mini_shakespeare  # noqa: F401

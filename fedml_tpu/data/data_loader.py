@@ -122,7 +122,17 @@ def load(args) -> Tuple[FederatedDataset, int]:
         n_classes = {"femnist": 62, "celeba": 2, "sent140": 2}.get(name, 90)
         task = ("sequence" if name in ("shakespeare", "fed_shakespeare",
                                        "reddit") else "classification")
-        leaf = load_leaf_dataset(os.path.join(cache_dir, name), bs,
+        leaf_dir = os.path.join(cache_dir, name)
+        if (name in ("shakespeare", "fed_shakespeare")
+                and not os.path.isdir(os.path.join(leaf_dir, "train"))
+                and not raw_name.startswith("synthetic")):
+            # no full LEAF download on disk: materialize the bundled REAL
+            # mini-Shakespeare shard (public-domain text, client = role)
+            # so the NWP task runs on real language, not a stand-in
+            from .bundled import materialize_mini_shakespeare
+            leaf_dir = materialize_mini_shakespeare(
+                os.path.join(cache_dir, "bundled"))
+        leaf = load_leaf_dataset(leaf_dir, bs,
                                  n_classes, max_clients=num_clients,
                                  task=task)
         if leaf is not None:
@@ -210,6 +220,35 @@ def load(args) -> Tuple[FederatedDataset, int]:
             provenance = "real"
         fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
                                   n_classes, method, alpha, seed)
+        fed.provenance = provenance
+        return fed, n_classes
+    if name in ("lending_club", "lending_club_loan", "loan", "nus_wide"):
+        # finance / vertical-FL tables (reference data/lending_club_loan,
+        # data/NUS_WIDE): preprocessed CSVs from the disk cache; the
+        # feature order IS the vertical column split the VFL sims use
+        from . import finance
+        try:
+            if name == "nus_wide":
+                x, y = finance.load_nus_wide(cache_dir)
+            else:
+                x, y = finance.load_lending_club(cache_dir)
+            provenance = "real"
+        except (OSError, ValueError) as e:
+            logger.info("no cached %s (%s)", name, e)
+            _synthetic_fallback(args, raw_name, name)
+            if name == "nus_wide":
+                x, y = finance.synthetic_nus_wide(
+                    max(num_clients * 2 * bs, 2000) + 400, seed=seed)
+            else:
+                x, y = finance.synthetic_lending_club(
+                    max(num_clients * 2 * bs, 2000) + 400, seed=seed)
+            provenance = "synthetic"
+        n_classes = int(y.max()) + 1
+        n_test = max(len(x) // 6, 1)
+        xtr, ytr = _cap_train(x[:-n_test], y[:-n_test], args, seed)
+        fed = from_central_arrays(xtr, ytr, x[-n_test:], y[-n_test:],
+                                  num_clients, bs, n_classes, method, alpha,
+                                  seed)
         fed.provenance = provenance
         return fed, n_classes
     if name in ("pascal_voc", "coco_seg", "seg", "segmentation"):

@@ -53,3 +53,25 @@ class SimpleCNN(nn.Module):
         x = nn.relu(nn.Dense(384)(x))
         x = nn.relu(nn.Dense(192)(x))
         return nn.Dense(self.num_classes)(x)
+
+
+class DeviceCNN(nn.Module):
+    """LeNet-class CNN sized for on-device training, paired 1:1 with the
+    native C++ trainer (``native/mobilenn.cpp`` train_cnn_sgd): conv3x3 SAME
+    + relu + maxpool2, twice, then dense. The param tree (Conv_0/Conv_1/
+    Dense_0) and flatten order match the native layout exactly, so native
+    and JAX devices train the same model and aggregate interchangeably."""
+    num_classes: int = 10
+    features: tuple = (8, 16)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat input -> square single-channel image
+            side = int(round((x.shape[-1]) ** 0.5))
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.relu(nn.Conv(self.features[0], (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(self.features[1], (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)

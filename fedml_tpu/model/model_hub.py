@@ -81,6 +81,9 @@ def _create(args, output_dim: int):
         return ModelBundle(MLP(output_dim), name, _has_dropout=True)
     if name in ("cnn", "cnn_dropout", "femnist_cnn"):
         return ModelBundle(CNNFemnist(output_dim), name, _has_dropout=True)
+    if name in ("device_cnn", "mobile_cnn"):
+        from .cv.cnn import DeviceCNN
+        return ModelBundle(DeviceCNN(num_classes=output_dim), name)
     if name in ("simple_cnn", "cifar_cnn"):
         return ModelBundle(SimpleCNN(output_dim), name)
     if name in ("lenet", "lenet5", "mnn_lenet"):

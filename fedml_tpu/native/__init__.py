@@ -92,9 +92,25 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.unmask_vector.restype = None
         lib.unmask_vector.argtypes = [f32p, u32p, ctypes.c_int64,
                                       ctypes.c_float, ctypes.c_uint64]
+        lib.train_cnn_sgd.restype = ctypes.c_float
+        lib.train_cnn_sgd.argtypes = (
+            [f32p] * 6 + [f32p, i32p] + [ctypes.c_int32] * 9
+            + [ctypes.c_float, ctypes.c_uint64])
+        lib.eval_cnn.restype = ctypes.c_float
+        lib.eval_cnn.argtypes = ([f32p] * 6 + [f32p, i32p]
+                                 + [ctypes.c_int32] * 7)
+        lib.lsa_mask_encode.restype = ctypes.c_int32
+        lib.lsa_mask_encode.argtypes = [u32p, u32p, ctypes.c_int32,
+                                        ctypes.c_int32, ctypes.c_int32,
+                                        ctypes.c_int32, ctypes.c_uint64]
+        lib.csv_probe.restype = ctypes.c_int32
+        lib.csv_probe.argtypes = [ctypes.c_char_p, i32p, i32p]
+        lib.csv_read.restype = ctypes.c_int32
+        lib.csv_read.argtypes = [ctypes.c_char_p, f32p, i32p,
+                                 ctypes.c_int32, ctypes.c_int32]
         lib.mobilenn_abi_version.restype = ctypes.c_int32
         lib.mobilenn_abi_version.argtypes = []
-        assert lib.mobilenn_abi_version() == 1
+        assert lib.mobilenn_abi_version() == 2
         _lib = lib
         return _lib
 
@@ -175,3 +191,107 @@ def unmask_vector(masked: np.ndarray, scale: float, seed: int) -> np.ndarray:
     lib.unmask_vector(_f32p(out), _u32p(masked), np.int64(masked.size),
                       np.float32(scale), np.uint64(seed))
     return out
+
+
+class NativeCNNTrainer:
+    """Device-side CNN trainer over the native core — the MNN-LeNet-engine
+    analogue (reference ``FedMLMNNTrainer.cpp``). Param tree matches the
+    flax ``DeviceCNN`` bundle ({'Conv_0','Conv_1','Dense_0'}), so the
+    server aggregates native-CNN and JAX-CNN device updates
+    interchangeably."""
+
+    def __init__(self):
+        self.lib = _load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable (no g++?)")
+
+    @staticmethod
+    def _unpack(params: Dict):
+        k1 = np.ascontiguousarray(
+            np.asarray(params["Conv_0"]["kernel"], np.float32))
+        b1 = np.ascontiguousarray(
+            np.asarray(params["Conv_0"]["bias"], np.float32))
+        k2 = np.ascontiguousarray(
+            np.asarray(params["Conv_1"]["kernel"], np.float32))
+        b2 = np.ascontiguousarray(
+            np.asarray(params["Conv_1"]["bias"], np.float32))
+        wd = np.ascontiguousarray(
+            np.asarray(params["Dense_0"]["kernel"], np.float32))
+        bd = np.ascontiguousarray(
+            np.asarray(params["Dense_0"]["bias"], np.float32))
+        return k1, b1, k2, b2, wd, bd
+
+    @staticmethod
+    def _image(x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        if x.ndim == 2:  # flat -> square single-channel (DeviceCNN parity)
+            side = int(round(x.shape[-1] ** 0.5))
+            x = x.reshape(len(x), side, side, 1)
+        return np.ascontiguousarray(x)
+
+    def train(self, params: Dict, x: np.ndarray, y: np.ndarray,
+              epochs: int, batch_size: int, lr: float, seed: int):
+        k1, b1, k2, b2, wd, bd = self._unpack(params)
+        x4 = self._image(x)
+        y2 = np.ascontiguousarray(np.asarray(y, np.int32))
+        n, H, W, cin = x4.shape
+        c1, c2, k = k1.shape[-1], k2.shape[-1], bd.shape[0]
+        loss = self.lib.train_cnn_sgd(
+            _f32p(k1), _f32p(b1), _f32p(k2), _f32p(b2), _f32p(wd),
+            _f32p(bd), _f32p(x4), _i32p(y2),
+            np.int32(n), np.int32(H), np.int32(W), np.int32(cin),
+            np.int32(c1), np.int32(c2), np.int32(k), np.int32(epochs),
+            np.int32(batch_size), np.float32(lr), np.uint64(seed))
+        return ({"Conv_0": {"kernel": k1, "bias": b1},
+                 "Conv_1": {"kernel": k2, "bias": b2},
+                 "Dense_0": {"kernel": wd, "bias": bd}}, float(loss))
+
+    def evaluate(self, params: Dict, x: np.ndarray, y: np.ndarray) -> float:
+        k1, b1, k2, b2, wd, bd = self._unpack(params)
+        x4 = self._image(x)
+        y2 = np.ascontiguousarray(np.asarray(y, np.int32))
+        n, H, W, cin = x4.shape
+        return float(self.lib.eval_cnn(
+            _f32p(k1), _f32p(b1), _f32p(k2), _f32p(b2), _f32p(wd),
+            _f32p(bd), _f32p(x4), _i32p(y2),
+            np.int32(n), np.int32(H), np.int32(W), np.int32(cin),
+            np.int32(k1.shape[-1]), np.int32(k2.shape[-1]),
+            np.int32(bd.shape[0])))
+
+
+def lsa_mask_encode(z: np.ndarray, n_clients: int, privacy_t: int,
+                    split_t: int, seed: int) -> np.ndarray:
+    """Native LightSecAgg Lagrange encoding of a field mask ``z`` into
+    ``n_clients`` coded sub-masks — decodes with the Python
+    ``core.mpc.lightsecagg.decode_aggregate_mask`` (same points, same
+    field)."""
+    lib = _load()
+    z = np.ascontiguousarray(z, np.uint32)
+    if len(z) % split_t:
+        raise ValueError("mask length must divide split_t")
+    out = np.empty((n_clients, len(z) // split_t), np.uint32)
+    rc = lib.lsa_mask_encode(_u32p(out), _u32p(z), np.int32(len(z)),
+                             np.int32(n_clients), np.int32(privacy_t),
+                             np.int32(split_t), np.uint64(seed))
+    if rc != 0:
+        raise ValueError(f"lsa_mask_encode failed (rc={rc})")
+    return out
+
+
+def read_csv(path: str):
+    """Native CSV dataset reader (label in the last column); returns
+    (x [n, d] float32, y [n] int32)."""
+    lib = _load()
+    rows = np.zeros(1, np.int32)
+    cols = np.zeros(1, np.int32)
+    rc = lib.csv_probe(path.encode(), _i32p(rows), _i32p(cols))
+    if rc != 0:
+        raise OSError(f"csv_probe({path!r}) failed (rc={rc})")
+    r, c = int(rows[0]), int(cols[0])
+    x = np.empty((r, c - 1), np.float32)
+    y = np.empty(r, np.int32)
+    rc = lib.csv_read(path.encode(), _f32p(x), _i32p(y), np.int32(r),
+                      np.int32(c))
+    if rc != 0:
+        raise OSError(f"csv_read({path!r}) failed (rc={rc})")
+    return x, y

@@ -160,6 +160,368 @@ void unmask_vector(float* out, const uint32_t* masked, int64_t n,
   }
 }
 
-int32_t mobilenn_abi_version() { return 1; }
+int32_t mobilenn_abi_version() { return 2; }
+
+}  // extern "C"
+
+// ===================== CNN trainer (LeNet-class) ============================
+//
+// Mirror of the flax DeviceCNN (model/cv/cnn.py): conv3x3 SAME (C1) + relu +
+// maxpool2 + conv3x3 SAME (C2) + relu + maxpool2 + dense + softmax CE.
+// Layouts match flax exactly: x NHWC, conv kernels [3,3,Cin,Cout], dense
+// kernel [features, k], flatten order (h*W + w)*C + c — so native and JAX
+// devices train the SAME param tree and the server aggregates them
+// interchangeably (reference: MobileNN's MNN LeNet engine,
+// FedMLMNNTrainer.cpp).
+
+namespace {
+
+struct ConvShape {
+  int32_t H, W, Cin, Cout;
+};
+
+// y[b] = relu(conv3x3_same(x)) ; x: [H,W,Cin], k: [3,3,Cin,Cout]
+void conv3x3_fwd(const float* x, const float* k, const float* bias, float* y,
+                 const ConvShape& s) {
+  for (int32_t h = 0; h < s.H; ++h)
+    for (int32_t w = 0; w < s.W; ++w)
+      for (int32_t co = 0; co < s.Cout; ++co) {
+        float acc = bias[co];
+        for (int32_t dh = -1; dh <= 1; ++dh)
+          for (int32_t dw = -1; dw <= 1; ++dw) {
+            int32_t ih = h + dh, iw = w + dw;
+            if (ih < 0 || ih >= s.H || iw < 0 || iw >= s.W) continue;
+            const float* xp = x + (ih * s.W + iw) * s.Cin;
+            const float* kp = k + (((dh + 1) * 3 + (dw + 1)) * s.Cin) * s.Cout
+                              + co;
+            for (int32_t ci = 0; ci < s.Cin; ++ci)
+              acc += xp[ci] * kp[ci * s.Cout];
+          }
+        y[(h * s.W + w) * s.Cout + co] = acc;
+      }
+}
+
+// backward of conv3x3_same: accumulates gk/gb, writes gx (may be null)
+void conv3x3_bwd(const float* x, const float* k, const float* gy, float* gx,
+                 float* gk, float* gb, const ConvShape& s) {
+  if (gx) std::memset(gx, 0, sizeof(float) * s.H * s.W * s.Cin);
+  for (int32_t h = 0; h < s.H; ++h)
+    for (int32_t w = 0; w < s.W; ++w)
+      for (int32_t co = 0; co < s.Cout; ++co) {
+        float g = gy[(h * s.W + w) * s.Cout + co];
+        if (g == 0.0f) continue;
+        gb[co] += g;
+        for (int32_t dh = -1; dh <= 1; ++dh)
+          for (int32_t dw = -1; dw <= 1; ++dw) {
+            int32_t ih = h + dh, iw = w + dw;
+            if (ih < 0 || ih >= s.H || iw < 0 || iw >= s.W) continue;
+            const float* xp = x + (ih * s.W + iw) * s.Cin;
+            size_t kbase = (((dh + 1) * 3 + (dw + 1)) * s.Cin) * s.Cout + co;
+            for (int32_t ci = 0; ci < s.Cin; ++ci) {
+              gk[kbase + static_cast<size_t>(ci) * s.Cout] += xp[ci] * g;
+              if (gx)
+                gx[(ih * s.W + iw) * s.Cin + ci] +=
+                    k[kbase + static_cast<size_t>(ci) * s.Cout] * g;
+            }
+          }
+      }
+}
+
+// 2x2 maxpool stride 2 (floor); argmax saved for backward
+void pool2_fwd(const float* x, float* y, int32_t* arg, int32_t H, int32_t W,
+               int32_t C) {
+  int32_t Ho = H / 2, Wo = W / 2;
+  for (int32_t h = 0; h < Ho; ++h)
+    for (int32_t w = 0; w < Wo; ++w)
+      for (int32_t c = 0; c < C; ++c) {
+        float best = -1e30f;
+        int32_t bi = 0;
+        for (int32_t dh = 0; dh < 2; ++dh)
+          for (int32_t dw = 0; dw < 2; ++dw) {
+            int32_t idx = ((h * 2 + dh) * W + (w * 2 + dw)) * C + c;
+            if (x[idx] > best) { best = x[idx]; bi = idx; }
+          }
+        y[(h * Wo + w) * C + c] = best;
+        arg[(h * Wo + w) * C + c] = bi;
+      }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Train the DeviceCNN with SGD. Params updated in place:
+//   k1 [3,3,Cin,C1] b1 [C1]  k2 [3,3,C1,C2] b2 [C2]
+//   Wd [feat, k]    bd [k]   with feat = (H/4)*(W/4)*C2
+// x: [n, H, W, Cin] NHWC, y: [n]. Returns mean loss of the last epoch.
+float train_cnn_sgd(float* k1, float* b1, float* k2, float* b2, float* Wd,
+                    float* bd, const float* x, const int32_t* y, int32_t n,
+                    int32_t H, int32_t W, int32_t Cin, int32_t C1, int32_t C2,
+                    int32_t nclass, int32_t epochs, int32_t batch, float lr,
+                    uint64_t seed) {
+  if (n <= 0 || H < 4 || W < 4 || batch <= 0) return -1.0f;
+  const int32_t H2 = H / 2, W2 = W / 2, H4 = H2 / 2, W4 = W2 / 2;
+  const int32_t feat = H4 * W4 * C2;
+  ConvShape s1{H, W, Cin, C1}, s2{H2, W2, C1, C2};
+  std::vector<int32_t> order(n);
+  for (int32_t i = 0; i < n; ++i) order[i] = i;
+
+  // activations (per sample)
+  std::vector<float> a1(H * W * C1), p1(H2 * W2 * C1);
+  std::vector<int32_t> arg1(H2 * W2 * C1);
+  std::vector<float> a2(H2 * W2 * C2), p2(feat);
+  std::vector<int32_t> arg2(feat);
+  std::vector<float> logits(nclass), probs(nclass);
+  // grads (per batch)
+  std::vector<float> gk1(9 * static_cast<size_t>(Cin) * C1), gb1(C1);
+  std::vector<float> gk2(9 * static_cast<size_t>(C1) * C2), gb2(C2);
+  std::vector<float> gWd(static_cast<size_t>(feat) * nclass), gbd(nclass);
+  // per-sample backward scratch
+  std::vector<float> gp2(feat), ga2(H2 * W2 * C2), gp1(H2 * W2 * C1),
+      ga1(H * W * C1);
+
+  float last_epoch_loss = 0.0f;
+  for (int32_t e = 0; e < epochs; ++e) {
+    uint64_t rng = seed + static_cast<uint64_t>(e) * 0x51ED2701ULL;
+    for (int32_t i = n - 1; i > 0; --i) {
+      int32_t j = static_cast<int32_t>(splitmix64(rng) % (i + 1));
+      int32_t t = order[i]; order[i] = order[j]; order[j] = t;
+    }
+    float epoch_loss = 0.0f;
+    int32_t seen = 0;
+    for (int32_t start = 0; start < n; start += batch) {
+      int32_t bs = (start + batch <= n) ? batch : (n - start);
+      std::memset(gk1.data(), 0, gk1.size() * sizeof(float));
+      std::memset(gb1.data(), 0, gb1.size() * sizeof(float));
+      std::memset(gk2.data(), 0, gk2.size() * sizeof(float));
+      std::memset(gb2.data(), 0, gb2.size() * sizeof(float));
+      std::memset(gWd.data(), 0, gWd.size() * sizeof(float));
+      std::memset(gbd.data(), 0, gbd.size() * sizeof(float));
+      for (int32_t bi = 0; bi < bs; ++bi) {
+        const float* xi = x + static_cast<size_t>(order[start + bi]) * H * W
+                          * Cin;
+        int32_t yi = y[order[start + bi]];
+        // ---- forward
+        conv3x3_fwd(xi, k1, b1, a1.data(), s1);
+        for (auto& v : a1) v = v > 0 ? v : 0;
+        pool2_fwd(a1.data(), p1.data(), arg1.data(), H, W, C1);
+        conv3x3_fwd(p1.data(), k2, b2, a2.data(), s2);
+        for (auto& v : a2) v = v > 0 ? v : 0;
+        pool2_fwd(a2.data(), p2.data(), arg2.data(), H2, W2, C2);
+        float maxl = -1e30f;
+        for (int32_t c = 0; c < nclass; ++c) {
+          float acc = bd[c];
+          for (int32_t f = 0; f < feat; ++f)
+            acc += p2[f] * Wd[static_cast<size_t>(f) * nclass + c];
+          logits[c] = acc;
+          if (acc > maxl) maxl = acc;
+        }
+        float denom = 0.0f;
+        for (int32_t c = 0; c < nclass; ++c) {
+          probs[c] = std::exp(logits[c] - maxl);
+          denom += probs[c];
+        }
+        for (int32_t c = 0; c < nclass; ++c) probs[c] /= denom;
+        epoch_loss += -std::log(probs[yi] > 1e-12f ? probs[yi] : 1e-12f);
+        ++seen;
+        // ---- backward
+        std::memset(gp2.data(), 0, gp2.size() * sizeof(float));
+        for (int32_t c = 0; c < nclass; ++c) {
+          float dl = probs[c] - (c == yi ? 1.0f : 0.0f);
+          gbd[c] += dl;
+          for (int32_t f = 0; f < feat; ++f) {
+            gWd[static_cast<size_t>(f) * nclass + c] += p2[f] * dl;
+            gp2[f] += Wd[static_cast<size_t>(f) * nclass + c] * dl;
+          }
+        }
+        std::memset(ga2.data(), 0, ga2.size() * sizeof(float));
+        for (int32_t i2 = 0; i2 < feat; ++i2) ga2[arg2[i2]] = gp2[i2];
+        for (size_t i2 = 0; i2 < ga2.size(); ++i2)
+          if (a2[i2] <= 0) ga2[i2] = 0;  // relu'
+        conv3x3_bwd(p1.data(), k2, ga2.data(), gp1.data(), gk2.data(),
+                    gb2.data(), s2);
+        std::memset(ga1.data(), 0, ga1.size() * sizeof(float));
+        for (int32_t i1 = 0; i1 < H2 * W2 * C1; ++i1)
+          ga1[arg1[i1]] = gp1[i1];
+        for (size_t i1 = 0; i1 < ga1.size(); ++i1)
+          if (a1[i1] <= 0) ga1[i1] = 0;
+        conv3x3_bwd(xi, k1, ga1.data(), nullptr, gk1.data(), gb1.data(), s1);
+      }
+      const float scale = lr / static_cast<float>(bs);
+      for (size_t i2 = 0; i2 < gk1.size(); ++i2) k1[i2] -= scale * gk1[i2];
+      for (int32_t c = 0; c < C1; ++c) b1[c] -= scale * gb1[c];
+      for (size_t i2 = 0; i2 < gk2.size(); ++i2) k2[i2] -= scale * gk2[i2];
+      for (int32_t c = 0; c < C2; ++c) b2[c] -= scale * gb2[c];
+      for (size_t i2 = 0; i2 < gWd.size(); ++i2) Wd[i2] -= scale * gWd[i2];
+      for (int32_t c = 0; c < nclass; ++c) bd[c] -= scale * gbd[c];
+    }
+    last_epoch_loss = seen ? epoch_loss / seen : 0.0f;
+  }
+  return last_epoch_loss;
+}
+
+// Forward-only accuracy for the DeviceCNN.
+float eval_cnn(const float* k1, const float* b1, const float* k2,
+               const float* b2, const float* Wd, const float* bd,
+               const float* x, const int32_t* y, int32_t n, int32_t H,
+               int32_t W, int32_t Cin, int32_t C1, int32_t C2,
+               int32_t nclass) {
+  if (n <= 0) return 0.0f;
+  const int32_t H2 = H / 2, W2 = W / 2, H4 = H2 / 2, W4 = W2 / 2;
+  const int32_t feat = H4 * W4 * C2;
+  ConvShape s1{H, W, Cin, C1}, s2{H2, W2, C1, C2};
+  std::vector<float> a1(H * W * C1), p1(H2 * W2 * C1), a2(H2 * W2 * C2),
+      p2(feat);
+  std::vector<int32_t> arg1(H2 * W2 * C1), arg2(feat);
+  int32_t correct = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    const float* xi = x + static_cast<size_t>(i) * H * W * Cin;
+    conv3x3_fwd(xi, k1, b1, a1.data(), s1);
+    for (auto& v : a1) v = v > 0 ? v : 0;
+    pool2_fwd(a1.data(), p1.data(), arg1.data(), H, W, C1);
+    conv3x3_fwd(p1.data(), k2, b2, a2.data(), s2);
+    for (auto& v : a2) v = v > 0 ? v : 0;
+    pool2_fwd(a2.data(), p2.data(), arg2.data(), H2, W2, C2);
+    int32_t best = 0;
+    float bestv = -1e30f;
+    for (int32_t c = 0; c < nclass; ++c) {
+      float acc = bd[c];
+      for (int32_t f = 0; f < feat; ++f)
+        acc += p2[f] * Wd[static_cast<size_t>(f) * nclass + c];
+      if (acc > bestv) { bestv = acc; best = c; }
+    }
+    if (best == y[i]) ++correct;
+  }
+  return static_cast<float>(correct) / static_cast<float>(n);
+}
+
+// ================= LightSecAgg Lagrange mask encoding =======================
+//
+// Native counterpart of core/mpc/lightsecagg.py mask_encoding (reference
+// MobileNN/src/security/LightSecAgg.cpp): identical evaluation points and
+// field math, so natively-encoded sub-masks decode with the Python
+// decode_aggregate_mask. The privacy padding rows come from the device's own
+// splitmix64 stream (padding values are arbitrary randomness; only the
+// coding must match).
+
+namespace {
+
+inline uint64_t gf_mul(uint64_t a, uint64_t b) { return (a * b) % kPrime; }
+
+uint64_t gf_pow(uint64_t base, uint64_t exp) {
+  uint64_t r = 1;
+  base %= kPrime;
+  while (exp) {
+    if (exp & 1) r = gf_mul(r, base);
+    base = gf_mul(base, base);
+    exp >>= 1;
+  }
+  return r;
+}
+
+inline uint64_t gf_inv(uint64_t a) { return gf_pow(a, kPrime - 2); }
+
+// Lagrange basis coefficients l_k(xq) on source points src[0..m)
+void lagrange_at(const uint64_t* src, int32_t m, uint64_t xq, uint64_t* out) {
+  for (int32_t k = 0; k < m; ++k) {
+    uint64_t num = 1, den = 1;
+    for (int32_t j = 0; j < m; ++j) {
+      if (j == k) continue;
+      num = gf_mul(num, (xq + kPrime - src[j]) % kPrime);
+      den = gf_mul(den, (src[k] + kPrime - src[j]) % kPrime);
+    }
+    out[k] = gf_mul(num, gf_inv(den));
+  }
+}
+
+}  // namespace
+
+// z: [d] field elements (uint32 < p), d % split_t == 0.
+// out: [n_clients, d / split_t]. Returns 0 on success.
+int32_t lsa_mask_encode(uint32_t* out, const uint32_t* z, int32_t d,
+                        int32_t n_clients, int32_t privacy_t, int32_t split_t,
+                        uint64_t seed) {
+  if (d <= 0 || split_t <= 0 || d % split_t != 0) return -1;
+  const int32_t l = d / split_t;
+  const int32_t m = split_t + privacy_t;
+  // source points: betas 1..split_t, gammas split_t+1..split_t+privacy_t
+  std::vector<uint64_t> src(m);
+  for (int32_t i = 0; i < m; ++i) src[i] = static_cast<uint64_t>(i + 1);
+  // data rows: z split into split_t rows, then privacy_t random rows
+  std::vector<uint64_t> pad(static_cast<size_t>(privacy_t) * l);
+  uint64_t rng = seed;
+  for (auto& v : pad) v = splitmix64(rng) % kPrime;
+  std::vector<uint64_t> coeff(m);
+  for (int32_t c = 0; c < n_clients; ++c) {
+    uint64_t alpha = static_cast<uint64_t>(m + 1 + c);
+    lagrange_at(src.data(), m, alpha, coeff.data());
+    uint32_t* dst = out + static_cast<size_t>(c) * l;
+    for (int32_t col = 0; col < l; ++col) {
+      uint64_t acc = 0;
+      for (int32_t row = 0; row < split_t; ++row)
+        acc = (acc + gf_mul(coeff[row],
+                            z[static_cast<size_t>(row) * l + col])) % kPrime;
+      for (int32_t row = 0; row < privacy_t; ++row)
+        acc = (acc + gf_mul(coeff[split_t + row],
+                            pad[static_cast<size_t>(row) * l + col]))
+              % kPrime;
+      dst[col] = static_cast<uint32_t>(acc);
+    }
+  }
+  return 0;
+}
+
+// ========================= native dataset reader ============================
+//
+// CSV reader (label in the LAST column — the reference device SDK ships
+// per-engine dataset readers; this is the transport-agnostic one). Two-call
+// pattern: probe for shape, then fill caller-allocated buffers.
+
+#include <cstdio>
+#include <cstdlib>
+
+int32_t csv_probe(const char* path, int32_t* rows, int32_t* cols) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) return -1;
+  int32_t r = 0, c = 0, cur_cols = 1;
+  int ch, prev = '\n';
+  while ((ch = std::fgetc(f)) != EOF) {
+    if (ch == ',') ++cur_cols;
+    if (ch == '\n') {
+      if (prev != '\n') {  // skip blank lines
+        if (c == 0) c = cur_cols;
+        else if (cur_cols != c) { std::fclose(f); return -2; }
+        ++r;
+      }
+      cur_cols = 1;
+    }
+    prev = ch;
+  }
+  if (prev != '\n' && prev != EOF) { if (c == 0) c = cur_cols; ++r; }
+  std::fclose(f);
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
+// x: [rows, cols-1] features; y: [rows] labels from the last column.
+int32_t csv_read(const char* path, float* x, int32_t* y, int32_t rows,
+                 int32_t cols) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) return -1;
+  for (int32_t r = 0; r < rows; ++r) {
+    for (int32_t c = 0; c < cols; ++c) {
+      double v;
+      if (std::fscanf(f, "%lf", &v) != 1) { std::fclose(f); return -2; }
+      if (c < cols - 1) x[static_cast<size_t>(r) * (cols - 1) + c] =
+          static_cast<float>(v);
+      else y[r] = static_cast<int32_t>(v);
+      int ch = std::fgetc(f);  // consume , or newline
+      (void)ch;
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
 
 }  // extern "C"

@@ -110,6 +110,73 @@ class ReplicaSet:
         with self._lock:
             return [r.port for r in self.replicas]
 
+    # --- health + rolling update (reference
+    # ``device_replica_controller.py``: health-based replacement, one-at-a-
+    # time rollout) -------------------------------------------------------
+    def _probe(self, port: int, timeout: float = 2.0) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready", timeout=timeout) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    def _start_ready(self, wait_s: float = 10.0):
+        """Start a fresh replica and wait until it answers /ready —
+        traffic must never be pointed at a cold server."""
+        runner = self._runner_cls(self.predictor_factory())
+        runner.start()
+        deadline = time.time() + wait_s
+        while time.time() < deadline:
+            if self._probe(runner.port, timeout=1.0):
+                return runner
+            time.sleep(0.05)
+        runner.stop()
+        raise RuntimeError("replacement replica never became ready")
+
+    def health_check(self) -> int:
+        """Probe every replica; replace dead ones with fresh ready servers.
+        Returns the number replaced. The autoscaler calls this each step —
+        the set HEALS, it does not just resize."""
+        with self._lock:
+            snapshot = list(enumerate(self.replicas))
+        replaced = 0
+        for i, runner in snapshot:
+            if self._probe(runner.port):
+                continue
+            logger.warning("replica on :%d failed health check — replacing",
+                           runner.port)
+            fresh = self._start_ready()
+            with self._lock:
+                if i < len(self.replicas) and self.replicas[i] is runner:
+                    self.replicas[i] = fresh
+                    replaced += 1
+                else:  # set changed underneath (scale event): discard
+                    fresh.stop()
+                    continue
+            try:
+                runner.stop()
+            except Exception:
+                pass
+        return replaced
+
+    def rolling_update(self, predictor_factory) -> None:
+        """Replace every replica with one built from the new factory,
+        one at a time, new-up-and-ready before old-down — the gateway keeps
+        serving throughout (reference rolling-upgrade flow)."""
+        self.predictor_factory = predictor_factory
+        with self._lock:
+            n = len(self.replicas)
+        for i in range(n):
+            fresh = self._start_ready()
+            with self._lock:
+                if i >= len(self.replicas):  # shrunk mid-rollout
+                    fresh.stop()
+                    return
+                old = self.replicas[i]
+                self.replicas[i] = fresh
+            old.stop()
+
     def stop(self) -> None:
         with self._lock:
             for r in self.replicas:
@@ -182,8 +249,10 @@ class Autoscaler:
         self._thread: Optional[threading.Thread] = None
 
     def step(self) -> int:
-        """One evaluation: metrics -> desired -> scale. Returns the new
-        replica count (also usable directly, without the daemon thread)."""
+        """One evaluation: heal -> metrics -> desired -> scale. Returns the
+        new replica count (also usable directly, without the daemon
+        thread)."""
+        self.gateway.replica_set.health_check()
         qps, lat = self.gateway.metrics()
         desired = self.policy.desired_replicas(
             qps, lat, len(self.gateway.replica_set))

@@ -72,3 +72,72 @@ def test_replicaset_gateway_and_autoscaler_end_to_end():
         assert n == 1
     finally:
         rs.stop()
+
+
+class _VersionedPredictor:
+    def __init__(self, version):
+        self.version = version
+
+    def predict(self, request):
+        return {"version": self.version}
+
+    def ready(self):
+        return True
+
+
+class TestReplicaHealth:
+    def test_dead_replica_is_replaced(self):
+        rs = ReplicaSet(lambda: _EchoPredictor(), min_replicas=2,
+                        max_replicas=4)
+        gw = Gateway(rs)
+        try:
+            # simulate a crash: stop one replica's server out-of-band
+            victim = rs.replicas[0]
+            victim.stop()
+            replaced = rs.health_check()
+            assert replaced == 1
+            assert len(rs) == 2
+            # every replica answers again, including the replacement slot
+            for _ in range(4):
+                assert "echo" in gw.predict({"x": 1})
+        finally:
+            rs.stop()
+
+    def test_autoscaler_step_heals(self):
+        rs = ReplicaSet(lambda: _EchoPredictor(), min_replicas=2,
+                        max_replicas=4)
+        gw = Gateway(rs)
+        scaler = Autoscaler(gw, EWMPolicy(target_qps_per_replica=1000.0))
+        try:
+            rs.replicas[1].stop()
+            scaler.step()
+            for _ in range(4):
+                assert "echo" in gw.predict({"x": 2})
+        finally:
+            rs.stop()
+
+    def test_rolling_update_zero_downtime(self):
+        rs = ReplicaSet(lambda: _VersionedPredictor("v1"), min_replicas=3,
+                        max_replicas=4)
+        gw = Gateway(rs)
+        try:
+            import threading
+            errors, versions = [], []
+
+            def traffic():
+                for _ in range(60):
+                    try:
+                        versions.append(gw.predict({})["version"])
+                    except Exception as e:  # any failed request = downtime
+                        errors.append(e)
+
+            t = threading.Thread(target=traffic)
+            t.start()
+            rs.rolling_update(lambda: _VersionedPredictor("v2"))
+            t.join()
+            assert not errors, errors[:3]
+            # rollout completed: fresh traffic is all v2
+            assert all(gw.predict({})["version"] == "v2" for _ in range(3))
+            assert "v1" in versions  # traffic overlapped the rollout
+        finally:
+            rs.stop()

@@ -231,3 +231,124 @@ def test_dead_round_leash_zero_arrivals(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(jax.tree_util.tree_leaves(before)[0]),
         np.asarray(jax.tree_util.tree_leaves(after)[0]))
+
+
+class TestNativeCNN:
+    """The native LeNet-class engine (train_cnn_sgd) against its flax twin
+    DeviceCNN: full-batch step parity, learning on real digits, and a mixed
+    native+JAX federated session."""
+
+    def _digits(self):
+        from sklearn.datasets import load_digits
+        ds = load_digits()
+        x = (ds.images / 16.0).astype(np.float32)[..., None]  # [n, 8, 8, 1]
+        return x, ds.target.astype(np.int32)
+
+    def _init_params(self, output_dim=10):
+        import jax
+        from fedml_tpu.model import create as create_model
+        bundle = create_model(make_args(model="device_cnn"), output_dim)
+        x0 = np.zeros((1, 8, 8, 1), np.float32)
+        return bundle, jax.device_get(
+            bundle.init(jax.random.PRNGKey(0), x0))
+
+    def test_native_cnn_fullbatch_gradients_match_jax(self):
+        """One full-batch step at lr=1 recovers the native gradient; it must
+        equal the flax DeviceCNN gradient to float tolerance. (A small batch
+        keeps post-relu zero TIES out of the max-pool windows — tie-broken
+        gradient routing legitimately differs between implementations.)"""
+        if not native.available():
+            pytest.skip("no native toolchain")
+        import jax
+        import jax.numpy as jnp
+        import optax
+        bundle, params = self._init_params()
+        x, y = self._digits()
+        x, y = x[:8], y[:8]
+        t = native.NativeCNNTrainer()
+        nat, _ = t.train(jax.tree_util.tree_map(np.copy, params), x, y,
+                         epochs=1, batch_size=len(x), lr=1.0, seed=0)
+
+        def loss(p):
+            logits = bundle.apply(p, jnp.asarray(x))
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, jnp.asarray(y)))
+
+        g = jax.grad(loss)(params)
+        for layer in ("Conv_0", "Conv_1", "Dense_0"):
+            for leaf in ("kernel", "bias"):
+                g_nat = (np.asarray(params[layer][leaf])
+                         - np.asarray(nat[layer][leaf]))  # lr=1 step
+                np.testing.assert_allclose(
+                    g_nat, np.asarray(g[layer][leaf]),
+                    rtol=1e-4, atol=1e-5, err_msg=f"{layer}/{leaf}")
+
+    def test_native_cnn_learns_real_digits(self):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        _, params = self._init_params()
+        x, y = self._digits()
+        t = native.NativeCNNTrainer()
+        params, loss = t.train(params, x[:1400], y[:1400], epochs=6,
+                               batch_size=32, lr=0.1, seed=1)
+        acc = t.evaluate(params, x[1400:], y[1400:])
+        assert acc > 0.85, (acc, loss)
+
+    def test_mixed_native_jax_cnn_federation(self, tmp_path):
+        """One native-CNN device + two JAX devices train digits federated:
+        the server aggregates their updates interchangeably."""
+        if not native.available():
+            pytest.skip("no native toolchain")
+        args = make_args(model="device_cnn", dataset="digits",
+                         comm_round=4, learning_rate=0.2,
+                         model_file_cache_dir=str(tmp_path))
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        result = run_cross_device_inproc(args, fed, bundle,
+                                         engines=["native", None, None])
+        assert result is not None
+        assert result["final_test_acc"] > 0.7, result["history"]
+
+
+class TestNativeLSAandReader:
+    def test_native_lsa_encode_decodes_with_python_pipeline(self):
+        """Native Lagrange-coded sub-masks from several devices must decode
+        to the exact aggregate mask with the Python server math."""
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from fedml_tpu.core.mpc.lightsecagg import decode_aggregate_mask
+        P = native.PRIME
+        n, privacy_t, split_t, d = 4, 1, 2, 12
+        rng = np.random.RandomState(0)
+        zs = [rng.randint(0, P, size=d).astype(np.uint32) for _ in range(n)]
+        encs = [native.lsa_mask_encode(z, n, privacy_t, split_t, seed=50 + i)
+                for i, z in enumerate(zs)]
+        # every client sums the sub-masks addressed to it (all survive)
+        responses = []
+        for j in range(n):
+            acc = np.zeros(d // split_t, np.uint64)
+            for i in range(n):
+                acc = (acc + encs[i][j].astype(np.uint64)) % P
+            responses.append(acc)
+        need = split_t + privacy_t
+        z_sum = decode_aggregate_mask(responses[:need], list(range(need)),
+                                      n, privacy_t, split_t, d)
+        want = np.zeros(d, np.uint64)
+        for z in zs:
+            want = (want + z.astype(np.uint64)) % P
+        np.testing.assert_array_equal(np.asarray(z_sum, np.uint64) % P, want)
+
+    def test_native_csv_reader(self, tmp_path):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        rng = np.random.RandomState(1)
+        x = rng.randn(17, 5).astype(np.float32)
+        y = rng.randint(0, 3, size=17)
+        path = tmp_path / "data.csv"
+        with open(path, "w") as f:
+            for xi, yi in zip(x, y):
+                f.write(",".join(f"{v:.6f}" for v in xi) + f",{yi}\n")
+        rx, ry = native.read_csv(str(path))
+        np.testing.assert_allclose(rx, x, atol=1e-5)
+        np.testing.assert_array_equal(ry, y)

@@ -134,3 +134,127 @@ class TestRealDataPolicy:
                          data_cache_dir=str(tmp_path), random_seed=0)
         r = fedml_tpu.run_simulation(backend="tpu", args=args)
         assert r["final_test_acc"] > 0.8
+
+
+class TestBundledShakespeare:
+    def test_mini_shakespeare_materializes_and_loads(self, tmp_path):
+        """Bundled REAL Shakespeare -> LEAF JSON -> LEAF reader: client =
+        speaking role, x/y = 80-char windows shifted by one."""
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu import data as data_mod
+        args = Arguments(dataset="shakespeare", model="rnn",
+                         client_num_in_total=10, batch_size=16,
+                         data_cache_dir=str(tmp_path))
+        fed, n_classes = data_mod.load(args)
+        assert getattr(fed, "provenance", "real") == "real"
+        assert n_classes == 90
+        assert fed.num_clients == 10  # one client per role
+        x = np.asarray(fed.train.x)
+        y = np.asarray(fed.train.y)
+        assert x.shape[-1] == 80 and y.shape[-1] == 80
+        # y is x shifted by one character wherever both are real text
+        m = np.asarray(fed.train.mask)[0].reshape(-1) > 0
+        xf = x[0].reshape(-1, 80)[m]
+        yf = y[0].reshape(-1, 80)[m]
+        np.testing.assert_array_equal(xf[0, 1:], yf[0, :-1])
+        # the LEAF dir was materialized on disk in the cache
+        assert (tmp_path / "bundled" / "shakespeare" / "train").is_dir()
+
+
+class TestFinanceLoaders:
+    def test_lending_club_from_cache(self, tmp_path):
+        """A cached loan.csv with the reference schema loads as real."""
+        import csv as _csv
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu import data as data_mod
+        from fedml_tpu.data.finance import LENDING_CLUB_FEATURES
+        d = tmp_path / "lending_club"
+        d.mkdir()
+        rng = np.random.RandomState(0)
+        with open(d / "loan.csv", "w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(list(LENDING_CLUB_FEATURES) + ["loan_status"])
+            for i in range(600):
+                row = [f"{v:.3f}" for v in rng.randn(
+                    len(LENDING_CLUB_FEATURES))]
+                w.writerow(row + (["Fully Paid"] if i % 3 else
+                                  ["Charged Off"]))
+        args = Arguments(dataset="lending_club", model="lr",
+                         client_num_in_total=4, batch_size=32,
+                         data_cache_dir=str(tmp_path))
+        fed, n_classes = data_mod.load(args)
+        assert fed.provenance == "real"
+        assert n_classes == 2
+        assert np.asarray(fed.train.x).shape[-1] == len(
+            LENDING_CLUB_FEATURES)
+
+    def test_nus_wide_synthetic_feeds_vertical_fl(self):
+        """The two-block NUS-WIDE stand-in trains a 2-party vertical FL
+        model better than either party could alone (label depends on both
+        blocks)."""
+        import fedml_tpu
+        from fedml_tpu.arguments import Arguments
+        args = Arguments(dataset="nus_wide", model="lr",
+                         federated_optimizer="vfl", party_num=2,
+                         client_num_in_total=2, client_num_per_round=2,
+                         comm_round=25, batch_size=64, learning_rate=0.1,
+                         random_seed=0, allow_synthetic=True,
+                         frequency_of_the_test=5)
+        r = fedml_tpu.run_simulation(backend="sp", args=args)
+        assert r["final_test_acc"] > 0.5, r["history"][-3:]
+
+
+class TestEdgeCaseBackdoor:
+    def test_edge_case_attack_raises_asr(self):
+        """Edge-case poisoning (reference data/edge_case_examples shape):
+        byzantine clients train transformed source-class samples with the
+        TARGET label; the poisoned global model's attack success rate on
+        HELD-OUT edge cases rises well above the clean model's, while main
+        accuracy survives."""
+        import jax
+        import jax.numpy as jnp
+        import fedml_tpu
+        from fedml_tpu.arguments import Arguments
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.data.edge_case import (attack_success_rate,
+                                              build_edge_case_set,
+                                              inject_edge_cases)
+
+        def run(poison):
+            args = Arguments(dataset="digits", model="lr",
+                             client_num_in_total=8, client_num_per_round=8,
+                             comm_round=10, batch_size=32,
+                             learning_rate=0.3, random_seed=1,
+                             frequency_of_the_test=5)
+            fed, output_dim = data_mod.load(args)
+            bundle = model_mod.create(args, output_dim)
+            x_all = np.asarray(fed.train.x).reshape(
+                (-1,) + np.asarray(fed.train.x).shape[3:])
+            y_all = np.asarray(fed.train.y).reshape(-1)
+            m_all = np.asarray(fed.train.mask).reshape(-1) > 0
+            edge = build_edge_case_set(x_all[m_all], y_all[m_all],
+                                       source_label=7, target_label=2)
+            if poison:
+                byz = np.zeros(fed.num_clients)
+                byz[:3] = 1.0
+                fed = inject_edge_cases(fed, edge, byz)
+            from fedml_tpu.core.algframe.client_trainer import (
+                ClassificationTrainer)
+            from fedml_tpu.optimizers.registry import create_optimizer
+            from fedml_tpu.simulation.tpu.engine import TPUSimulator
+            spec = ClassificationTrainer(bundle.apply)
+            sim = TPUSimulator(args, fed, bundle,
+                               create_optimizer(args, spec), spec)
+            out = sim.run(comm_round=10)
+
+            def predict(x):
+                logits = bundle.apply(out["params"], jnp.asarray(x))
+                return np.asarray(jnp.argmax(logits, -1))
+
+            return (attack_success_rate(predict, edge),
+                    out["final_test_acc"])
+
+        asr_clean, acc_clean = run(poison=False)
+        asr_poisoned, acc_poisoned = run(poison=True)
+        assert asr_poisoned > asr_clean + 0.3, (asr_clean, asr_poisoned)
+        assert acc_poisoned > acc_clean - 0.1, (acc_clean, acc_poisoned)

@@ -1,0 +1,44 @@
+"""Quick-gate WAN + optimizer coverage: the full cross-silo FSM and
+optimizer SP<->TPU parity suites are slow-tier, but the quick gate must
+exercise at least one real session and one parity case so a regression in
+either pillar cannot slip through a fast CI pass (VERDICT r2 #10)."""
+
+import jax
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu import data as data_mod
+from fedml_tpu import model as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.cross_silo.horizontal.runner import run_cross_silo_inproc
+
+
+def test_minimal_cross_silo_session():
+    """2 silos x 2 rounds over the in-proc broker: the client/server FSMs,
+    wire codec, and weighted aggregation all fire."""
+    args = Arguments(dataset="synthetic_mnist", model="lr",
+                     client_num_in_total=2, client_num_per_round=2,
+                     comm_round=2, epochs=1, batch_size=32,
+                     learning_rate=0.1, random_seed=5,
+                     training_type="cross_silo")
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    result = run_cross_silo_inproc(args, fed, bundle)
+    assert result is not None
+    assert result["rounds"] == 2
+    assert result["final_test_acc"] > 0.3  # 2 rounds of lr on easy data
+
+
+def test_scaffold_sp_tpu_parity_quick():
+    """One stateful-optimizer parity case (SCAFFOLD carries control
+    variates through client state — the hardest state plumbing)."""
+    kw = dict(dataset="synthetic_mnist", model="lr",
+              client_num_in_total=4, client_num_per_round=3,
+              comm_round=2, epochs=1, batch_size=32, learning_rate=0.1,
+              random_seed=11, federated_optimizer="scaffold")
+    r_sp = fedml_tpu.run_simulation(backend="sp", args=Arguments(**kw))
+    r_tpu = fedml_tpu.run_simulation(backend="tpu", args=Arguments(**kw))
+    for a, b in zip(jax.tree_util.tree_leaves(r_sp["params"]),
+                    jax.tree_util.tree_leaves(r_tpu["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
