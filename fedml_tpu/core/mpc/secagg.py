@@ -65,24 +65,19 @@ def shamir_reconstruct(shares: Sequence[Tuple[int, int]]) -> int:
 # ---------------------------------------------------------------------------
 
 def expand_mask(seed: int, length: int) -> np.ndarray:
-    """Expand a field-element seed into ``length`` field elements. SHA-256
-    counter mode — deterministic across hosts, no RNG-state coupling."""
+    """Expand a seed (any width up to 256 bits — field element or the
+    128-bit seeds from ``channels``) into ``length`` field elements.
+    SHA-256 counter mode — deterministic across hosts, no RNG-state
+    coupling."""
     out = np.empty(length, np.uint32)
     n_blocks = -(-length // 8)  # 8 uint32 per 32-byte digest
     buf = np.empty(n_blocks * 8, np.uint32)
-    sbytes = int(seed).to_bytes(8, "little")
+    sbytes = int(seed).to_bytes(32, "little")
     for b in range(n_blocks):
         d = hashlib.sha256(sbytes + b.to_bytes(4, "little")).digest()
         buf[b * 8:(b + 1) * 8] = np.frombuffer(d, np.uint32)
     out[:] = buf[:length] % np.uint32(_P_I)
     return out
-
-
-def salt_seed(seed: int, round_idx: int) -> int:
-    """Derive a per-round seed so masks differ across FL rounds while the
-    shared/Shamir-protected base seed is exchanged once."""
-    d = hashlib.sha256(f"{int(seed)}@{int(round_idx)}".encode()).digest()
-    return int.from_bytes(d[:8], "little") % _P_I
 
 
 def pairwise_seed(secret_i: int, public_j: int) -> int:

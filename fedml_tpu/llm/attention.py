@@ -15,9 +15,10 @@ counterparts here are first-class:
   its CUDA monkey-patch (``train/llm/models/attention.py:30-67``), built
   for the MXU.
 - ``ring``: ring attention over the ``sp`` mesh axis — sequence shards
-  rotate K/V via ``ppermute`` while accumulating online-softmax state, so
-  context length scales with the number of chips (capability beyond the
-  reference; SURVEY §5.7 flags this as the TPU equivalent to build).
+  rotate K/V (and the key-padding mask) via ``ppermute`` while
+  accumulating online-softmax state, so context length scales with the
+  number of chips (capability beyond the reference; SURVEY §5.7 flags
+  this as the TPU equivalent to build).
 """
 
 from __future__ import annotations
@@ -52,11 +53,6 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      impl: str = "dense",
                      attn_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Dispatch. q/k/v: [b, s, h, d] → [b, s, h, d]."""
-    if impl == "ring" and attn_mask is not None:
-        raise NotImplementedError(
-            "attention_impl='ring' does not support key-padding masks "
-            "yet — use impl='dense'/'flash', or pack sequences without "
-            "padding")
     if impl == "ring":
         ax = _RING_AXIS.get()
         if ax is None:
@@ -65,7 +61,7 @@ def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 "context (fedml_tpu.llm.attention.ring_axis) — wrap the "
                 "forward in shard_map over the 'sp' axis")
         return ring_causal_attention(q, k, v, axis_name=ax[0],
-                                     axis_size=ax[1])
+                                     axis_size=ax[1], attn_mask=attn_mask)
     if impl == "flash":
         return flash_causal_attention(q, k, v, attn_mask=attn_mask)
     return dense_causal_attention(q, k, v, attn_mask=attn_mask)
@@ -122,7 +118,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
         live = jnp.logical_and(live, (kmask > 0)[None, :])
         s_blk = jnp.where(live, s_blk, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s_blk, -1, keepdims=True))
-        p = jnp.exp(s_blk - m_new)
+        # gate on `live`, not just the exp: for a row with NO live keys
+        # m_new stays NEG_INF, so exp(s_blk - m_new) = exp(0) = 1 at every
+        # masked position and O would silently become an unmasked average
+        # of V; gating keeps l = 0 so the row's output is exactly zero and
+        # its stored LSE ≈ NEG_INF (flagging the row) instead
+        p = jnp.where(live, jnp.exp(s_blk - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, -1, keepdims=True)
         o_new = o_acc * alpha + jnp.dot(p, v_blk,
@@ -340,72 +341,101 @@ def _flash_bwd_rule(block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _fit_block(s: int, want: int) -> int:
-    """Largest block size <= ``want`` that divides ``s``. Pallas dynamic
-    slices CLAMP out-of-bounds starts, so a partial trailing block would
-    silently read re-labeled K/V rows — block sizes must divide the
-    sequence length exactly."""
-    b = min(want, s)
-    while s % b:
-        b -= 1
-    return b
-
-
 def flash_causal_attention(q, k, v, block_q: int = 128, block_k: int = 128,
                            attn_mask: Optional[jnp.ndarray] = None):
     """Pallas flash attention, fused fwd+bwd (see module docstring).
-    ``attn_mask``: optional [b, s] key-padding mask (1 = real)."""
-    s = q.shape[1]
-    block_q = _fit_block(s, block_q)
-    block_k = _fit_block(s, block_k)
+    ``attn_mask``: optional [b, s] key-padding mask (1 = real).
+
+    Sequences are padded up to a multiple of 128 so every Pallas block is
+    lane/sublane-aligned on real TPU hardware (a non-power-of-two s like
+    1000 would otherwise pick a 125-row block). Pallas dynamic slices
+    CLAMP out-of-bounds starts, so blocks MUST divide the padded length
+    exactly — padding then slicing is the safe shape-independent recipe.
+    Padded keys are masked out; padded query rows are sliced away.
+    """
+    b, s, h, d = q.shape
+    s_pad = -(-s // 128) * 128
     if attn_mask is None:
-        mask = jnp.ones((q.shape[0], s, 1), jnp.float32)
+        mask = jnp.ones((b, s, 1), jnp.float32)
     else:
         mask = attn_mask.astype(jnp.float32)[:, :, None]
-    return _flash(q, k, v, mask, block_q, block_k)
+    if s_pad != s:
+        pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        mask = jnp.pad(mask, [(0, 0), (0, s_pad - s), (0, 0)])
+    out = _flash(q, k, v, mask, _fit_block(s_pad, block_q),
+                 _fit_block(s_pad, block_k))
+    return out[:, :s] if s_pad != s else out
+
+
+def _fit_block(s_pad: int, want: int) -> int:
+    """Largest 128-multiple block <= ``want`` that divides ``s_pad``
+    (itself a 128-multiple) — lane-aligned AND exactly tiling."""
+    b = max(128, (min(want, s_pad) // 128) * 128)
+    while s_pad % b:
+        b -= 128
+    return b
 
 
 # ----------------------------------------------------------------- ring ----
 
 def ring_causal_attention(q, k, v, axis_name: str = "sp",
-                          axis_size: int = 1) -> jnp.ndarray:
+                          axis_size: int = 1,
+                          attn_mask: Optional[jnp.ndarray] = None
+                          ) -> jnp.ndarray:
     """Causal attention with the sequence sharded over ``axis_name``.
 
     Must be traced inside ``shard_map``: q/k/v are the local shards
     [b, s_loc, h, d]; K/V rotate around the ring via ``ppermute`` while each
     device folds the visiting block into its online-softmax accumulator.
     Communication rides ICI; peak memory per device is O(s_loc² + s_loc·d).
+
+    ``attn_mask``: optional [b, s_loc] key-padding shard (1 = real key),
+    sharded over ``axis_name`` the same way as k/v. It rotates around the
+    ring alongside the K/V block it describes, so every device masks the
+    *visiting* block's padded keys (the varlen/unpad story of the
+    reference's flash patch, ``train/llm/models/attention.py:68``).
+    A query row whose visible keys are all padded yields exactly zero.
     """
     b, s_loc, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
     my_idx = jax.lax.axis_index(axis_name)
     q_pos = my_idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+    kmask0 = (jnp.ones((b, s_loc), bool) if attn_mask is None
+              else attn_mask.astype(bool))
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def fold(carry, xs):
-        o_acc, m, l, k_cur, v_cur = carry
+        o_acc, m, l, k_cur, v_cur, km_cur = carry
         step = xs
         kv_idx = (my_idx - step) % axis_size
         kv_pos = kv_idx * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
         s_blk = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                            k_cur.astype(jnp.float32)) * scale
-        mask = q_pos[:, None] >= kv_pos[None, :]
-        s_blk = jnp.where(mask[None, None], s_blk, NEG_INF)
+        causal = q_pos[:, None] >= kv_pos[None, :]          # [s_loc, s_loc]
+        live = causal[None, None] & km_cur[:, None, None, :]  # [b,1,q,k]
+        s_blk = jnp.where(live, s_blk, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s_blk, -1))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s_blk - m_new[..., None])
+        # gate on `live` (not just the exp): a row with no live keys has
+        # m_new = NEG_INF and exp(NEG_INF - NEG_INF) = 1 everywhere, which
+        # would silently average V; gating keeps l = 0 -> output 0
+        p = jnp.where(live, jnp.exp(s_blk - m_new[..., None]), 0.0)
         l_new = l * alpha + jnp.sum(p, -1)
         o_new = (o_acc * alpha[..., None] +
                  jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), ()
+        km_nxt = jax.lax.ppermute(km_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt, km_nxt), ()
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
-    (o, m, l, _, _), _ = jax.lax.scan(
-        fold, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        fold, (o0, m0, l0, k, v, kmask0), jnp.arange(axis_size))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -118,19 +118,28 @@ def make_ring_forward(model_apply: Callable, mesh: Mesh,
                       axis_name: str = AXIS_SEQ) -> Callable:
     """Sequence-parallel forward: tokens [b, S] sharded over ``sp``; each
     shard runs the decoder on its sequence slice with ring attention
-    rotating K/V over ICI. Returns ``fwd(params, tokens) -> logits``
-    (sharded on the sequence axis)."""
+    rotating K/V over ICI. ``model_apply(params, tokens, attn_mask)`` runs
+    on local shards. Returns ``fwd(params, tokens, attn_mask=None) ->
+    logits`` (sharded on the sequence axis); ``attn_mask`` is a [b, S]
+    key-padding mask (1 = real token) sharded over ``sp`` alongside the
+    tokens — it rotates with K/V inside ring attention."""
     from jax import shard_map
 
     size = mesh.shape[axis_name]
 
-    def local_fwd(params, tokens):
+    def local_fwd(params, tokens, attn_mask):
         with ring_axis(axis_name, size):
-            return model_apply(params, tokens)
+            return model_apply(params, tokens, attn_mask)
 
     fwd = shard_map(
         local_fwd, mesh=mesh,
-        in_specs=(P(), P(None, axis_name)),
+        in_specs=(P(), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name, None),
         check_vma=False)
-    return fwd
+
+    def call(params, tokens, attn_mask=None):
+        if attn_mask is None:
+            attn_mask = jnp.ones(tokens.shape, jnp.int32)
+        return fwd(params, tokens, attn_mask)
+
+    return call

@@ -87,6 +87,51 @@ def test_flash_key_padding_mask():
     assert np.all(dk[dead] == 0) and np.all(dv[dead] == 0)
 
 
+def test_flash_all_masked_row_is_zero():
+    """A query row whose every visible key is masked (mid-sequence key
+    mask covering its own diagonal) must output exactly zero — not an
+    unmasked average of V (ADVICE r3: exp(NEG_INF - NEG_INF) = 1)."""
+    rng = jax.random.PRNGKey(2)
+    b, s, h, d = 1, 16, 1, 8
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (b, s, h, d))
+               for i in range(3))
+    mask = jnp.ones((b, s), jnp.float32).at[:, :4].set(0.0)
+    out = flash_causal_attention(q, k, v, 8, 8, attn_mask=mask)
+    # queries 0..3 see only keys 0..q (all masked) -> exact zeros
+    assert np.all(np.asarray(out)[:, :4] == 0.0)
+    # live rows still match dense
+    dense = dense_causal_attention(q, k, v, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(dense)[:, 4:],
+                               np.asarray(out)[:, 4:], atol=1e-5)
+    # same contract for ring attention (mask rotates with K/V)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from fedml_tpu.core.mesh import build_mesh
+    mesh = build_mesh({"sp": 4}, devices=jax.devices()[:4])
+    ring = shard_map(
+        lambda q, k, v, m: ring_causal_attention(q, k, v, "sp", 4,
+                                                 attn_mask=m),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, "sp"), check_vma=False)(q, k, v, mask)
+    assert np.all(np.asarray(ring)[:, :4] == 0.0)
+    np.testing.assert_allclose(np.asarray(dense)[:, 4:],
+                               np.asarray(ring)[:, 4:], atol=1e-5)
+
+
+def test_nonaligned_seq_len_pads_to_lane_multiple():
+    """s=100 (not a multiple of 128) must be handled by pad+slice, matching
+    dense exactly on the real rows (ADVICE r3: 125-row blocks are not
+    lane-aligned on hardware)."""
+    rng = jax.random.PRNGKey(3)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (2, 100, 2, 8))
+               for i in range(3))
+    dense = dense_causal_attention(q, k, v)
+    flash = flash_causal_attention(q, k, v)
+    assert flash.shape == dense.shape
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(flash),
+                               atol=1e-5)
+
+
 def test_flash_bwd_never_materializes_scores():
     """Training-memory property: at s=4096 the compiled fwd+bwd must not
     allocate an [s, s] f32 buffer (64 MiB); flash peak temp stays under a
@@ -143,9 +188,22 @@ def test_ring_forward_full_model():
 
     mesh = build_mesh({"sp": 4}, devices=jax.devices()[:4])
     fwd = make_ring_forward(
-        lambda p, t: model_ring.apply({"params": p}, t), mesh)
+        lambda p, t, m: model_ring.apply({"params": p}, t, attn_mask=m),
+        mesh)
     got = fwd(params, tokens)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-4)
+
+    # key-padding: last 8 tokens of row 0 are pad. Ring must agree with the
+    # dense forward on the real positions (padded-row logits are garbage in
+    # both and excluded).
+    mask = np.ones((2, 32), np.int32)
+    mask[0, 24:] = 0
+    want_m = model_dense.apply({"params": params}, tokens,
+                               attn_mask=jnp.asarray(mask))
+    got_m = fwd(params, tokens, jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(want_m)[mask.astype(bool)],
+                               np.asarray(got_m)[mask.astype(bool)],
+                               atol=2e-4)
 
 
 def test_lora_zero_init_and_delta(small_lm):

@@ -103,6 +103,114 @@ def test_secagg_dropout_recovery():
                                    rtol=1e-3, atol=1e-3)
 
 
+def test_client_refuses_active_server_unmask_attack():
+    """A deviating server listing a client as BOTH surviving and dropped
+    would collect that client's self-mask seed AND mask key — enough to
+    strip both masks and recover its individual update (ADVICE r3 medium).
+    The client must refuse. Cross-round replays get nothing either: each
+    round has fresh secrets, and the client answers once then wipes."""
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.secagg import SAMessage, SecAggClientManager
+
+    args = make_args()
+    args.inproc_broker = InProcBroker()
+    c = SecAggClientManager(args, trainer=None, rank=1, size=5,
+                            backend="INPROC")
+    c._round = {"round": 0,
+                "held": {i: ([[1, 2]] * 6, [[1, 2]] * 11) for i in range(4)}}
+    sent = []
+    c.send_message = sent.append
+    c.finish = lambda: None
+
+    # same index in both lists -> refuse outright
+    msg = Message(SAMessage.S2C_UNMASK_REQUEST, 0, 1)
+    msg.add_params(SAMessage.KEY_ROUND, 0)
+    msg.add_params(SAMessage.KEY_SURVIVING, [0, 1, 2])
+    msg.add_params(SAMessage.KEY_DROPPED, [2, 3])
+    c.on_unmask_request(msg)
+    assert sent == [], "client revealed shares under an overlapping request"
+
+    # legitimate request for round 0 -> answered once
+    c._round = {"round": 0,
+                "held": {i: ([[1, 2]] * 6, [[1, 2]] * 11) for i in range(4)}}
+    msg = Message(SAMessage.S2C_UNMASK_REQUEST, 0, 1)
+    msg.add_params(SAMessage.KEY_ROUND, 0)
+    msg.add_params(SAMessage.KEY_SURVIVING, [0, 1, 2])
+    msg.add_params(SAMessage.KEY_DROPPED, [3])
+    c.on_unmask_request(msg)
+    assert len(sent) == 1
+    # secrets are wiped after the answer — a replayed/altered request for
+    # the same round reveals nothing
+    assert c._round is None
+    c._round = {"round": 0,
+                "held": {i: ([[1, 2]] * 6, [[1, 2]] * 11) for i in range(4)}}
+    msg = Message(SAMessage.S2C_UNMASK_REQUEST, 0, 1)
+    msg.add_params(SAMessage.KEY_ROUND, 0)
+    msg.add_params(SAMessage.KEY_SURVIVING, [0, 1])
+    msg.add_params(SAMessage.KEY_DROPPED, [2])
+    c.on_unmask_request(msg)
+    assert len(sent) == 1, "client answered the same round twice"
+
+
+def test_secagg_dropout_after_shares_reconstructs_masks():
+    """A silo that completes key+share distribution but never submits its
+    masked model is in the mask cohort: survivors' masked vectors carry
+    pairwise masks with it. The server must reconstruct its mask key from
+    Shamir shares, cancel the residual masks, and produce EXACTLY the
+    survivors-only aggregate — this is the Bonawitz recovery path proper."""
+    from fedml_tpu.cross_silo.secagg import (SecAggClientManager,
+                                             run_secagg_inproc)
+
+    DROP_RANK = 2  # client idx 1
+
+    class DropAfterShares(SecAggClientManager):
+        def on_routed_shares(self, msg):
+            return  # dies between share distribution and masking
+
+    args = make_args(comm_round=1, round_timeout_s=10.0)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+
+    def factory(rank, a, trainer):
+        cls = DropAfterShares if rank == DROP_RANK else SecAggClientManager
+        return cls(a, trainer, rank=rank, size=5, backend="INPROC")
+
+    result = run_secagg_inproc(args, fed, bundle, client_factory=factory)
+    assert result is not None and "error" not in result, result
+    assert len(result["history"]) == 1
+
+    # expected: plain weighted FedAvg over survivors 0, 2, 3 only
+    from fedml_tpu.cross_silo.horizontal.runner import _build_spec
+    from fedml_tpu.cross_silo.client.trainer import SiloTrainer
+    from fedml_tpu.optimizers.registry import create_optimizer
+    args2 = make_args(comm_round=1)
+    fed2, output_dim2 = data_mod.load(args2)
+    bundle2 = model_mod.create(args2, output_dim2)
+    spec = _build_spec(fed2, bundle2, None)
+    rng = jax.random.PRNGKey(int(args2.random_seed))
+    init_rng, _ = jax.random.split(rng)
+    params = bundle2.init(init_rng, fed2.train.x[0, 0])
+    deltas, ws = [], []
+    for idx in [0, 2, 3]:
+        opt = create_optimizer(args2, spec)
+        tr = SiloTrainer(args2, fed2, bundle2, spec, opt)
+        new_p, n, _ = tr.train(params, idx, 0)
+        deltas.append(jax.tree_util.tree_map(
+            lambda a, b: np.asarray(a) - np.asarray(b), new_p, params))
+        ws.append(n)
+    wsum = sum(ws)
+    agg = jax.tree_util.tree_map(
+        lambda *ds: sum(w * d for w, d in zip(ws, ds)) / wsum, *deltas)
+    expect = jax.tree_util.tree_map(
+        lambda p, u: np.asarray(p) + u, params, agg)
+
+    for a, b in zip(jax.tree_util.tree_leaves(expect),
+                    jax.tree_util.tree_leaves(result["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
 def test_server_relays_only_ciphertext():
     """What the server sees of the routed shares must be AEAD ciphertext it
     cannot open: no plaintext share bytes, and decryption without the
@@ -144,4 +252,5 @@ def test_server_relays_only_ciphertext():
             with pytest.raises(channels.DecryptError):
                 channels.open_sealed(
                     eve_sk, _eve_pk, blob,
-                    aad=channels.pair_aad(int(owner), int(j), b"sa-setup"))
+                    aad=channels.pair_aad(int(owner), int(j),
+                                          b"sa-round-0"))
