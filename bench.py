@@ -194,6 +194,122 @@ def bench_time_to_acc(target_acc=0.90, max_rounds=80):
     }), flush=True)
 
 
+def bench_shakespeare_fedopt(rounds=12, target_acc=0.21):
+    """BASELINE.json config 3: FedOpt + LSTM next-character prediction on
+    REAL text — the bundled role-partitioned Shakespeare shard (public
+    domain, client = speaking role, same natural partition as LEAF
+    fed_shakespeare). Reports round throughput and accuracy vs the
+    majority-character baseline (~0.19)."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.core.algframe.client_trainer import make_trainer_spec
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    args = Arguments(
+        dataset="shakespeare", model="rnn", client_num_in_total=10,
+        client_num_per_round=10, comm_round=rounds, epochs=2,
+        batch_size=16, learning_rate=0.4, federated_optimizer="fedopt",
+        server_optimizer="sgd", server_lr=1.0, server_momentum=0.9,
+        frequency_of_the_test=10_000, random_seed=0)
+    fed, output_dim = load(args)
+    provenance = getattr(fed, "provenance", "real")
+    bundle = create(args, output_dim)
+    spec = make_trainer_spec(fed, bundle)
+    opt = create_optimizer(args, spec)
+    sim = TPUSimulator(args, fed, bundle, opt, spec)
+    hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                       epochs=int(args.epochs))
+
+    sim.run_round(0, hyper)  # compile warmup
+    _force(sim.params)
+    # rounds/hour times run_round ALONE; time-to-target runs on its own
+    # wall clock that legitimately includes the per-round eval cost
+    # (mirrors bench_time_to_acc) — mixing them would let the eval passes
+    # before the target hit contaminate the throughput headline
+    train_s = 0.0
+    t0 = time.perf_counter()
+    t_hit, hit_round = None, None
+    for round_idx in range(1, rounds):
+        r0 = time.perf_counter()
+        sim.run_round(round_idx, hyper)
+        _force(sim.params)
+        train_s += time.perf_counter() - r0
+        if t_hit is None:
+            stats = sim._evaluate(sim.params, sim.fed.test["x"],
+                                  sim.fed.test["y"], sim.fed.test["mask"])
+            acc = float(stats["correct"]) / max(float(stats["count"]), 1.0)
+            if acc >= target_acc:
+                t_hit, hit_round = time.perf_counter() - t0, round_idx
+    dt = train_s / (rounds - 1)
+    stats = sim._evaluate(sim.params, sim.fed.test["x"],
+                          sim.fed.test["y"], sim.fed.test["mask"])
+    acc = float(stats["correct"]) / max(float(stats["count"]), 1.0)
+    print(json.dumps({
+        "metric": "fedopt_shakespeare_rnn_rounds_per_hour",
+        "value": round(3600.0 / dt, 1),
+        "unit": "rounds/hour (10 roles, LSTM NWP, FedOpt momentum server)",
+        "vs_baseline": None,
+        "round_s": round(dt, 4),
+        "final_acc": round(acc, 4),
+        "target_acc": target_acc,
+        "time_to_target_s": round(t_hit, 2) if t_hit else None,
+        "rounds_to_target": hit_round,
+        "data_provenance": provenance,
+    }), flush=True)
+
+
+def bench_federated_lora(rounds=4):
+    """BASELINE.json config 4 as a *federated round* (not just one train
+    step): two silos LoRA-fine-tune a causal LM on REAL bundled text; each
+    round ships only the adapter tree. Reports federated round latency."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.llm.federated import build_llm
+    from fedml_tpu.llm.lora import lora_param_count
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    args = Arguments(
+        dataset="llm", model="causal_lm", precision="bfloat16",
+        client_num_in_total=2, client_num_per_round=2, comm_round=rounds,
+        epochs=1, batch_size=8, learning_rate=1e-3,
+        federated_optimizer="fedavg", frequency_of_the_test=10_000,
+        random_seed=0, llm_corpus_fallback="shakespeare",
+        llm_hidden_size=512, llm_intermediate_size=1408, llm_num_layers=4,
+        llm_num_heads=8, llm_max_seq_len=256, lora_rank=8)
+    fed, bundle, spec, _ = build_llm(args)
+    provenance = getattr(fed, "provenance", "synthetic")
+    opt = create_optimizer(args, spec)
+    sim = TPUSimulator(args, fed, bundle, opt, spec)
+    hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                       epochs=1)
+    sim.run_round(0, hyper)  # compile warmup
+    _force(sim.params)
+    t0 = time.perf_counter()
+    for round_idx in range(1, rounds):
+        sim.run_round(round_idx, hyper)
+        _force(sim.params)
+    dt = (time.perf_counter() - t0) / (rounds - 1)
+    adapters = lora_param_count(sim.params)
+    print(json.dumps({
+        "metric": "fedllm_lora_federated_round_s",
+        "value": round(dt, 4),
+        "unit": "s/round (2 silos, LoRA r8 adapters only on the wire, "
+                "bf16 causal LM, seq 256)",
+        "vs_baseline": None,
+        "rounds_per_hour": round(3600.0 / dt, 1),
+        "adapter_params": int(adapters),
+        "data_provenance": provenance,
+    }), flush=True)
+
+
 def bench_llm_mfu(steps=16):
     """Single-chip causal-LM train-step MFU: the FedLLM hot loop with
     MXU-sized matmuls (d_model 1024). Demonstrates the runtime's ceiling
@@ -264,16 +380,18 @@ def bench_llm_mfu(steps=16):
 
 def run():
     bench_flagship()
-    try:
-        bench_time_to_acc()
-    except Exception as e:  # accuracy line must never mask the flagship line
-        print(json.dumps({"metric": "fedavg_digits_time_to_90pct_s",
-                          "error": f"{type(e).__name__}: {e}"}), flush=True)
-    try:
-        bench_llm_mfu()
-    except Exception as e:
-        print(json.dumps({"metric": "llm_train_step_mfu",
-                          "error": f"{type(e).__name__}: {e}"}), flush=True)
+    for name, fn in (
+            ("fedavg_digits_time_to_90pct_s", bench_time_to_acc),
+            ("fedopt_shakespeare_rnn_rounds_per_hour",
+             bench_shakespeare_fedopt),
+            ("fedllm_lora_federated_round_s", bench_federated_lora),
+            ("llm_train_step_mfu", bench_llm_mfu)):
+        try:  # a broken line must never mask the others
+            fn()
+        except Exception as e:
+            print(json.dumps({"metric": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
 
 
 if __name__ == "__main__":

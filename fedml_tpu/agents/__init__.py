@@ -33,7 +33,9 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.distributed.communication.pubsub import (_recv_frame,
-                                                     _send_frame)
+                                                     _send_frame,
+                                                     broker_secret,
+                                                     client_connect)
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +54,45 @@ JOB_KILLED = "KILLED"
 
 TOPIC_STATUS = "fl_client/mlops/status"
 TOPIC_ONLINE = "fl_client/agent/online"
+
+
+def agent_secret() -> Optional[bytes]:
+    """Shared bind token for job dispatch (``FEDML_TPU_AGENT_SECRET``).
+    Independent of the broker secret: even a peer that can reach the
+    broker cannot start jobs without it. None = open (local-first
+    default). Reference counterpart: device binding through the account
+    manager (``scheduler_core/account_manager.py:1-469``)."""
+    s = os.environ.get("FEDML_TPU_AGENT_SECRET", "")
+    return s.encode() if s else None
+
+
+def _job_mac(secret: bytes, payload: dict) -> str:
+    """HMAC over the canonical job command (everything except the mac
+    itself), binding request id, target and yaml content."""
+    import hashlib
+    import hmac as _hmac
+    body = json.dumps({k: v for k, v in sorted(payload.items())
+                       if k != "auth"}, sort_keys=True,
+                      separators=(",", ":"))
+    return _hmac.new(secret, body.encode(), hashlib.sha256).hexdigest()
+
+
+def sign_job(payload: dict, secret: Optional[bytes] = None) -> dict:
+    secret = secret if secret is not None else agent_secret()
+    if secret is not None:
+        payload = dict(payload)
+        payload["auth"] = _job_mac(secret, payload)
+    return payload
+
+
+def verify_job(payload: dict, secret: Optional[bytes] = None) -> bool:
+    import hmac as _hmac
+    secret = secret if secret is not None else agent_secret()
+    if secret is None:
+        return True  # open deployment
+    mac = payload.get("auth")
+    return bool(mac) and _hmac.compare_digest(
+        str(mac), _job_mac(secret, payload))
 
 
 def _topic_start(device_id: int) -> str:
@@ -115,7 +156,7 @@ class MessageCenter:
                 self._sock = None
 
     def _connect(self) -> None:
-        sock = socket.create_connection(self._addr)
+        sock = client_connect(self._addr[0], self._addr[1])
         for topic in self._subs:
             _send_frame(sock, {"kind": "sub", "topic": topic})
         if self._will[0] is not None:
@@ -278,6 +319,15 @@ class SlaveAgent:
     def _on_start(self, payload: dict) -> None:
         from .. import api
         request_id = str(payload.get("request_id") or uuid.uuid4().hex)
+        if not verify_job(payload):
+            # refuse unauthenticated job dispatch outright — and say so on
+            # the status topic so the (possibly legitimate, misconfigured)
+            # sender is not left waiting at PROVISIONING
+            logger.error("agent %s: REFUSING start_train %s — bad or "
+                         "missing bind token", self.device_id, request_id)
+            self._status(request_id, JOB_FAILED,
+                         error="start_train refused: bad bind token")
+            return
         # idempotency: the master re-publishes start_train until it sees a
         # status (the broker has no retained messages, so a command sent
         # before this agent subscribed is simply gone) — a duplicate must
@@ -337,6 +387,10 @@ class SlaveAgent:
     def _on_stop(self, payload: dict) -> None:
         from .. import api
         request_id = str(payload.get("request_id", ""))
+        if not verify_job(payload):
+            logger.error("agent %s: REFUSING stop_train %s — bad or "
+                         "missing bind token", self.device_id, request_id)
+            return
         run_id = self.runs.get(request_id)
         if run_id is None:
             self._status(request_id, JOB_FAILED, error="unknown run")
@@ -422,7 +476,7 @@ class MasterAgent:
             msg["job_yaml_name"] = os.path.basename(path)
         else:
             msg["job_yaml"] = path
-        self.center.publish(_topic_start(device_id), msg)
+        self.center.publish(_topic_start(device_id), sign_job(msg))
         with self._cv:
             self.jobs.setdefault(request_id, {"history": []})[
                 "device_id"] = device_id
@@ -434,7 +488,7 @@ class MasterAgent:
         if device_id is None:
             raise KeyError(f"unknown request {request_id!r}")
         self.center.publish(_topic_stop(int(device_id)),
-                            {"request_id": request_id})
+                            sign_job({"request_id": request_id}))
 
     # --- queries -----------------------------------------------------------
     def job_status(self, request_id: str) -> Optional[str]:

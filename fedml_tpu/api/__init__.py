@@ -152,9 +152,18 @@ def launch_job(yaml_file: str, api_key: Optional[str] = None,
         "workspace": workspace, "pid": proc.pid,
         "started": time.time(), "status": STATUS_RUNNING,
     })
+    # remote observability: ship this run's log to the configured log
+    # server (reference mlops_runtime_log_daemon.py:333 tails + uploads)
+    log_url = os.environ.get("FEDML_TPU_LOG_SERVER_URL")
+    shipper = None
+    if log_url:
+        from ..core.mlops.log_daemon import start_log_shipper
+        shipper = start_log_shipper(log_path, log_url, run_id=run_id)
     if not detach:
         rc = proc.wait()
         _finalize(run_id, rc)
+        if shipper is not None:  # final flush, don't leak the poll thread
+            shipper.stop()
         return LaunchResult(run_id, 0 if rc == 0 else -1,
                             f"exit code {rc}", proc.pid)
     return LaunchResult(run_id, 0, "launched", proc.pid)

@@ -18,7 +18,10 @@ parity, not MQTT wire compatibility.
 
 from __future__ import annotations
 
+import hmac
+import hashlib
 import logging
+import os
 import socket
 import struct
 import threading
@@ -31,6 +34,53 @@ from ..message import Message
 from ...distributed_storage import LocalObjectStorage
 
 logger = logging.getLogger(__name__)
+
+
+def broker_secret() -> Optional[bytes]:
+    """Deployment-wide shared secret for broker authentication, from
+    ``FEDML_TPU_BROKER_SECRET``. None = open broker (local-first default).
+    The reference binds devices through its account manager
+    (``scheduler_core/account_manager.py:1-469``); this is the local
+    equivalent: no secret, no pub/sub."""
+    s = os.environ.get("FEDML_TPU_BROKER_SECRET", "")
+    return s.encode() if s else None
+
+
+def _challenge_mac(secret: bytes, nonce: bytes) -> str:
+    return hmac.new(secret, b"fedml-tpu/broker-auth" + nonce,
+                    hashlib.sha256).hexdigest()
+
+
+def client_connect(host: str, port: int,
+                   secret: Optional[bytes] = None) -> socket.socket:
+    """Connect to a PubSubBroker and complete its hello/challenge
+    handshake. The broker always speaks first (a ``hello`` frame); when it
+    demands auth the client must answer the nonce with an HMAC under the
+    shared secret before any sub/pub/lwt is accepted."""
+    sock = socket.create_connection((host, int(port)))
+    hello = _recv_frame(sock)
+    if not isinstance(hello, dict) or hello.get("kind") != "hello":
+        sock.close()
+        raise ConnectionError("broker did not send hello frame")
+    if hello.get("auth_required"):
+        if secret is None:
+            secret = broker_secret()
+        if secret is None:
+            sock.close()
+            raise PermissionError(
+                "broker requires authentication; set "
+                "FEDML_TPU_BROKER_SECRET or pass secret=")
+        _send_frame(sock, {"kind": "auth", "mac": _challenge_mac(
+            secret, bytes.fromhex(hello["nonce"]))})
+        # the broker acks the handshake so a wrong secret surfaces HERE as
+        # PermissionError, not later as an unexplained dead connection
+        ack = _recv_frame(sock)
+        if (not isinstance(ack, dict) or ack.get("kind") != "auth_result"
+                or not ack.get("ok")):
+            sock.close()
+            raise PermissionError(
+                "broker rejected authentication (wrong shared secret?)")
+    return sock
 
 
 def _send_frame(sock: socket.socket, obj) -> None:
@@ -57,12 +107,18 @@ def _recv_frame(sock: socket.socket):
 
 class PubSubBroker:
     """Topic broker: SUB/PUB/LWT frames over TCP. One per deployment (the
-    MQTT broker analogue)."""
+    MQTT broker analogue). With ``secret`` set (default: the
+    ``FEDML_TPU_BROKER_SECRET`` env), every connection must answer a fresh
+    HMAC challenge before any frame is honored — an unauthenticated peer
+    that reaches the socket cannot publish ``start_train`` (or anything
+    else)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[bytes] = None):
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self.host = host
+        self.secret = secret if secret is not None else broker_secret()
         self._subs: Dict[str, List[socket.socket]] = {}
         self._wills: Dict[socket.socket, Tuple[str, dict]] = {}
         self._lock = threading.Lock()
@@ -71,6 +127,28 @@ class PubSubBroker:
         self._send_locks: Dict[socket.socket, threading.Lock] = {}
         self._running = True
         threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _handshake(self, conn: socket.socket) -> bool:
+        """Broker speaks first: hello (+nonce). With a secret configured,
+        the first client frame must be the HMAC answer."""
+        nonce = os.urandom(16)
+        _send_frame(conn, {"kind": "hello",
+                           "auth_required": self.secret is not None,
+                           "nonce": nonce.hex()})
+        if self.secret is None:
+            return True
+        frame = _recv_frame(conn)
+        ok = (isinstance(frame, dict) and frame.get("kind") == "auth"
+              and hmac.compare_digest(
+                  str(frame.get("mac", "")),
+                  _challenge_mac(self.secret, nonce)))
+        try:
+            _send_frame(conn, {"kind": "auth_result", "ok": bool(ok)})
+        except OSError:
+            return False
+        if not ok:
+            logger.warning("broker: rejecting unauthenticated connection")
+        return ok
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -83,6 +161,12 @@ class PubSubBroker:
 
     def _serve(self, conn: socket.socket) -> None:
         try:
+            try:
+                if not self._handshake(conn):
+                    conn.close()
+                    return
+            except OSError:
+                return
             while True:
                 frame = _recv_frame(conn)
                 if frame is None:
@@ -141,13 +225,14 @@ class PubSubStorageCommManager(BaseCommunicationManager):
     def __init__(self, rank: int, broker_host: str = "127.0.0.1",
                  broker_port: int = 0, run_id: str = "0",
                  storage: Optional[LocalObjectStorage] = None,
-                 offload_threshold: int = 4096):
+                 offload_threshold: int = 4096,
+                 secret: Optional[bytes] = None):
         super().__init__()
         self.rank = int(rank)
         self.run_id = run_id
         self.storage = storage or LocalObjectStorage()
         self.offload_threshold = int(offload_threshold)
-        self._sock = socket.create_connection((broker_host, broker_port))
+        self._sock = client_connect(broker_host, broker_port, secret)
         self._running = False
         self._lock = threading.Lock()
         # subscribe to every topic addressed to me: fedml_<run>_*_<me>

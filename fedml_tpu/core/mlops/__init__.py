@@ -70,6 +70,20 @@ def init(args) -> None:
         logger.warning("mlops sink unavailable (%s); tracking disabled", e)
         _state["sink"] = None
         _state["enabled"] = False
+    # remote half of observability: tail+POST the run's JSONL to a log
+    # server when configured (reference mlops_runtime_log_daemon.py:219).
+    # A re-init for a new run stops (and flushes) the previous shipper —
+    # otherwise every init leaks a polling thread for the process lifetime.
+    prev_shipper = _state.pop("shipper", None)
+    if prev_shipper is not None:
+        prev_shipper.stop()
+    log_url = (getattr(args, "log_server_url", None)
+               or os.environ.get("FEDML_TPU_LOG_SERVER_URL"))
+    if log_url and _state["sink"] is not None:
+        from .log_daemon import start_log_shipper
+        _state["shipper"] = start_log_shipper(
+            path, log_url, run_id=_state["run_id"],
+            device_id=str(getattr(args, "device_id", 0)))
     if bool(getattr(args, "sys_perf_profiling", False)):
         start_sys_perf()
 

@@ -159,16 +159,98 @@ _RECIPES = {
 BUNDLED_REAL = ("digits", "wine", "breast_cancer")
 
 
+# raw-archive filenames recognized by the offline import path, per dataset
+_ARCHIVE_NAMES = {
+    "cifar10": ("cifar-10-python.tar.gz", "cifar10.tar.gz"),
+    "cifar100": ("cifar-100-python.tar.gz", "cifar100.tar.gz"),
+    "mnist": ("mnist.npz",),
+    "fashionmnist": ("fashionmnist.npz",),
+}
+
+
+def _parse_local_archive(name: str, path: str) -> Arrays:
+    """Parse a locally-provided raw archive (same formats the network
+    recipes download) into arrays."""
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return ((z["x_train"], z["y_train"]), (z["x_test"], z["y_test"]))
+    if name in ("cifar10", "cifar100"):
+        label_key = b"fine_labels" if name == "cifar100" else b"labels"
+        xs_tr, ys_tr = [], []
+        x_te = y_te = None
+        with tarfile.open(path, mode="r:gz") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                is_train = base.startswith("data_batch") or base == "train"
+                is_test = base.startswith("test_batch") or base == "test"
+                if not (is_train or is_test):
+                    continue
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                y = np.asarray(d[label_key], np.int64)
+                if is_train:
+                    xs_tr.append(x)
+                    ys_tr.append(y)
+                else:
+                    x_te, y_te = x, y
+        return ((np.concatenate(xs_tr), np.concatenate(ys_tr)),
+                (x_te, y_te))
+    raise IOError(f"no offline parser for {name} archive {path!r}")
+
+
+def import_archive(name: str, path: str,
+                   cache_dir: Optional[str] = None) -> str:
+    """OFFLINE dataset import: cache a locally-provided raw archive (the
+    same file the network recipe would download — e.g. CIFAR-10's
+    ``cifar-10-python.tar.gz`` — or a pre-built ``.npz``) so every later
+    ``load()`` treats the dataset as real, no egress needed. Airgapped
+    counterpart of the reference's download-at-load
+    (``data/data_loader.py:262-448``). Returns the cached npz path."""
+    from .data_loader import default_cache_dir
+    cache_dir = os.path.expanduser(cache_dir or default_cache_dir())
+    (xtr, ytr), (xte, yte) = _parse_local_archive(name, path)
+    os.makedirs(cache_dir, exist_ok=True)
+    out = os.path.join(cache_dir, f"{name}.npz")
+    tmp = out + ".tmp.npz"
+    np.savez_compressed(tmp, x_train=xtr, y_train=ytr, x_test=xte,
+                        y_test=yte)
+    os.replace(tmp, out)
+    logger.info("imported %s archive %s -> %s", name, path, out)
+    return out
+
+
+def _find_local_archive(name: str, cache_dir: str) -> Optional[str]:
+    """Look for a user-provided raw archive in the offline drop dirs:
+    ``$FEDML_TPU_OFFLINE_DIR`` (if set) and the cache dir itself."""
+    dirs = [d for d in (os.environ.get("FEDML_TPU_OFFLINE_DIR"), cache_dir)
+            if d]
+    for d in dirs:
+        for fname in _ARCHIVE_NAMES.get(name, ()):
+            p = os.path.join(os.path.expanduser(d), fname)
+            if os.path.exists(p):
+                return p
+    return None
+
+
 def acquire(name: str, cache_dir: str) -> Optional[str]:
     """Materialize dataset ``name`` as ``<cache_dir>/<name>.npz``; returns the
     path, or None if the dataset has no recipe or acquisition failed (the
-    caller decides how loudly to fall back)."""
-    if name not in _RECIPES:
-        return None
+    caller decides how loudly to fall back). A raw archive dropped in
+    ``$FEDML_TPU_OFFLINE_DIR`` (or the cache dir) is imported without any
+    network — see :func:`import_archive`."""
     cache_dir = os.path.expanduser(cache_dir or ".")
     path = os.path.join(cache_dir, f"{name}.npz")
     if os.path.exists(path):
         return path
+    local = _find_local_archive(name, cache_dir)
+    if local is not None:
+        try:
+            return import_archive(name, local, cache_dir)
+        except Exception as e:
+            logger.warning("offline archive %s for %s unusable: %s",
+                           local, name, e)
+    if name not in _RECIPES:
+        return None
     recipe, _ = _RECIPES[name]
     try:
         (xtr, ytr), (xte, yte) = recipe()

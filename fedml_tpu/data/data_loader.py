@@ -37,6 +37,14 @@ class DatasetUnavailableError(FileNotFoundError):
     pass
 
 
+def default_cache_dir() -> str:
+    """The cache dir used when no ``data_cache_dir`` is configured — also
+    the default target of the offline archive import (``acquire.
+    import_archive``)."""
+    return os.path.expanduser(os.environ.get(
+        "FEDML_TPU_DATA_DIR", "~/.cache/fedml_tpu/data"))
+
+
 def _synthetic_allowed(args, raw_name: str) -> bool:
     if raw_name.startswith("synthetic"):
         return True
@@ -115,6 +123,16 @@ def load(args) -> Tuple[FederatedDataset, int]:
 
     cache_dir = os.path.expanduser(getattr(args, "data_cache_dir", None)
                                    or ".")
+    # TFF HDF5 formats (the reference's fed_cifar100 / stackoverflow
+    # shards) read from a local cache dir when the files are present
+    if name in ("fed_cifar100", "stackoverflow_nwp", "stackoverflow_lr") \
+            and not raw_name.startswith("synthetic"):
+        from .tff_h5 import load_tff_dataset
+        got = load_tff_dataset(name, os.path.join(cache_dir, name), bs,
+                               max_clients=num_clients)
+        if got is not None:
+            return got
+
     # LEAF-format natural partitions take precedence when present on disk
     if name in ("femnist", "shakespeare", "fed_shakespeare", "celeba",
                 "sent140", "reddit"):

@@ -65,10 +65,33 @@ def synthetic_instruction_corpus(n: int, seed: int = 0
     return out
 
 
+def shakespeare_instruction_corpus(window: int = 96,
+                                   stride: int = 48
+                                   ) -> List[Dict[str, str]]:
+    """REAL-language instruction corpus built from the bundled
+    public-domain Shakespeare passages (``data/bundled/shakespeare.py``):
+    each row asks the model to continue a text window — a completion task
+    over genuine natural language, the zero-egress counterpart of the
+    dolly corpus the reference's UnitedLLM pipeline downloads."""
+    from ..data.bundled.shakespeare import PASSAGES
+    rows = []
+    for role, text in PASSAGES.items():
+        for start in range(0, max(len(text) - window, 1), stride):
+            chunk = text[start:start + window]
+            cut = max(window // 3, 1)
+            rows.append({"instruction": f"Continue: {chunk[:cut]}",
+                         "response": chunk[cut:]})
+    return rows
+
+
 def load_instruction_corpus(path: Optional[str], n_fallback: int = 256,
-                            seed: int = 0) -> List[Dict[str, str]]:
+                            seed: int = 0,
+                            fallback: str = "synthetic"
+                            ) -> List[Dict[str, str]]:
     """jsonl with instruction/response (dolly schema: ``instruction`` +
-    ``response``); falls back to the synthetic corpus with a loud notice."""
+    ``response``). No file: ``fallback='shakespeare'`` uses the bundled
+    REAL text corpus; ``'synthetic'`` (default) uses the toy generator
+    with a loud notice."""
     if path and os.path.exists(path):
         rows = []
         with open(path) as f:
@@ -78,6 +101,8 @@ def load_instruction_corpus(path: Optional[str], n_fallback: int = 256,
                     rows.append({"instruction": r["instruction"],
                                  "response": r["response"]})
         return rows
+    if fallback == "shakespeare":
+        return shakespeare_instruction_corpus()
     import logging
     logging.getLogger(__name__).warning(
         "no instruction corpus at %r — using the SYNTHETIC fallback corpus",
@@ -120,7 +145,8 @@ def build_llm_federated(args, n_silos: int, seq_len: int,
     corpus = load_instruction_corpus(
         getattr(args, "llm_corpus_path", None),
         n_fallback=int(getattr(args, "llm_corpus_size", 256)),
-        seed=int(getattr(args, "random_seed", 0)))
+        seed=int(getattr(args, "random_seed", 0)),
+        fallback=str(getattr(args, "llm_corpus_fallback", "synthetic")))
     x, y = tokenize_examples(corpus, tokenizer, seq_len)
     n = x.shape[0]
     rng = np.random.RandomState(int(getattr(args, "random_seed", 0)))
@@ -134,4 +160,9 @@ def build_llm_federated(args, n_silos: int, seq_len: int,
         client_x, client_y, x[test_idx], y[test_idx],
         batch_size=int(getattr(args, "batch_size", 8)),
         num_classes=tokenizer.vocab_size, dtype=np.int32, task="llm")
+    corpus_path = getattr(args, "llm_corpus_path", None)
+    fed.provenance = (
+        "real" if (corpus_path and os.path.exists(corpus_path))
+        or str(getattr(args, "llm_corpus_fallback", "synthetic"))
+        == "shakespeare" else "synthetic")
     return fed, tokenizer

@@ -172,15 +172,14 @@ class TPUSimulator:
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
-        # "scan" (default): slots run sequentially per chip — minimal
-        # memory. "vmap": slots train in LOCKSTEP per chip in chunks of
-        # ``client_vmap_chunk`` (scan over chunks, vmap within) — the small
-        # per-client matmuls batch across clients and feed the MXU at
-        # chunk-multiplied width, with activation memory bounded by the
-        # chunk size.
-        vmap_mode = (str(getattr(self.args, "client_parallelism", "scan"))
-                     .lower() == "vmap")
-        vmap_chunk = int(getattr(self.args, "client_vmap_chunk", 8) or 8)
+        # Schedule slots run SEQUENTIALLY per chip (lax.scan) with full
+        # per-op batches. A client-lockstep vmap mode was built and
+        # measured in rounds 3-4 (scripts/vmap_vs_scan.py): XLA lowers
+        # per-client-weight batched convs to per-group execution with a
+        # fixed ~10-25 us/group overhead, and the mode LOST to scan on
+        # every shipped model — 16..64-channel ResNet-56 (r3) AND
+        # MXU-wide ResNet-18 (r4: 0.70x at chunk 8, 0.68x at chunk 4) —
+        # so it was deleted rather than kept as a footgun.
 
         def round_body(params, server_state, local_data, local_states,
                        sched_idx, sched_active, round_key, hyper):
@@ -199,11 +198,9 @@ class TPUSimulator:
                             "count": jnp.float32(0)}
 
             def run_slot(states, li, active):
-                """Train one schedule slot (shared by the scan and vmap
-                paths — any drift between them would silently break their
-                bit-exact parity). CDP soundness note: the per-client
-                sensitivity bound (clip) must hold before aggregation even
-                though noise is added centrally."""
+                """Train one schedule slot. CDP soundness note: the
+                per-client sensitivity bound (clip) must hold before
+                aggregation even though noise is added centrally."""
                 cdata = jax.tree_util.tree_map(lambda a: a[li], local_data)
                 cstate = jax.tree_util.tree_map(lambda a: a[li], states)
                 gcid = dev * cpd + li
@@ -241,56 +238,6 @@ class TPUSimulator:
 
             init = (local_states, zero_update, zero_extras,
                     jnp.float32(0), zero_metrics)
-
-            if vmap_mode:
-                s_total = sched_idx.shape[0]
-                chunk = max(min(vmap_chunk, s_total), 1)
-                n_chunks = -(-s_total // chunk)
-                padded = n_chunks * chunk
-                # pad the schedule with inactive slots; index 0 is a safe
-                # dummy gather target (weight-gated to zero)
-                pad_idx = jnp.concatenate(
-                    [sched_idx, jnp.zeros(padded - s_total,
-                                          sched_idx.dtype)])
-                pad_act = jnp.concatenate(
-                    [sched_active, jnp.zeros(padded - s_total,
-                                             sched_active.dtype)])
-                chunks_idx = pad_idx.reshape(n_chunks, chunk)
-                chunks_act = pad_act.reshape(n_chunks, chunk)
-
-                def chunk_body(carry, inp):
-                    states, acc_u, acc_ex, acc_w, acc_m = carry
-                    lis, acts = inp
-                    upds, extras, ws, mets, new_states = jax.vmap(
-                        run_slot, in_axes=(None, 0, 0))(states, lis, acts)
-                    acc_u = jax.tree_util.tree_map(
-                        lambda acc, u: acc + jnp.tensordot(
-                            ws.astype(u.dtype), u, axes=1), acc_u, upds)
-                    acc_ex = jax.tree_util.tree_map(
-                        lambda acc, e: acc + jnp.tensordot(
-                            ws.astype(e.dtype), e, axes=1), acc_ex, extras)
-                    acc_w = acc_w + jnp.sum(ws)
-                    acc_m = jax.tree_util.tree_map(
-                        lambda acc, m: acc + jnp.sum(
-                            m * acts.astype(m.dtype)), acc_m, mets)
-                    # scatter updated client states. ACTIVE slot indices are
-                    # distinct per device (build_schedule), but zero-padded
-                    # inactive slots alias index 0 — scatter order with
-                    # duplicate indices is undefined, so route inactive
-                    # slots out of bounds and drop them instead of gating
-                    # by value.
-                    safe_lis = jnp.where(acts > 0, lis,
-                                         jnp.int32(cpd))  # OOB -> dropped
-
-                    def scatter(st, ns):
-                        return st.at[safe_lis].set(ns, mode="drop")
-                    states = jax.tree_util.tree_map(scatter, states,
-                                                    new_states)
-                    return (states, acc_u, acc_ex, acc_w, acc_m), None
-
-                (states, acc_u, acc_ex, acc_w, acc_m), _ = jax.lax.scan(
-                    chunk_body, init, (chunks_idx, chunks_act))
-                return finish(states, acc_u, acc_ex, acc_w, acc_m)
 
             def slot(carry, s):
                 states, acc_u, acc_ex, acc_w, acc_m = carry

@@ -1,0 +1,83 @@
+"""Generate the tiny checked-in dataset-format fixtures under
+``tests/fixtures/`` — files in the EXACT on-disk layout of the reference's
+TFF HDF5 datasets (fed_cifar100, stackoverflow NWP/LR), small enough to
+commit (a few KB) but structurally faithful so the readers in
+``fedml_tpu/data/tff_h5.py`` are pinned to the real format.
+
+Deterministic: re-running reproduces byte-identical content modulo HDF5
+metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import h5py
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures")
+
+WORDS = ("the to how do in a i is of and python file java use using get "
+         "from code for data can if with on error not you this my it "
+         "function").split()
+TAGS = ["python", "java", "javascript", "android", "c#", "php", "jquery",
+        "html"]
+
+
+def fed_cifar100(dirpath: str) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for split, n_clients, n_img in (("train", 4, 12), ("test", 2, 8)):
+        path = os.path.join(dirpath, f"fed_cifar100_{split}.h5")
+        with h5py.File(path, "w") as f:
+            ex = f.create_group("examples")
+            for c in range(n_clients):
+                g = ex.create_group(f"client_{c:02d}")
+                g.create_dataset(
+                    "image", data=rng.randint(0, 256, (n_img, 32, 32, 3),
+                                              np.uint8))
+                g.create_dataset(
+                    "label", data=rng.randint(0, 100, (n_img, 1), np.int64))
+
+
+def _sentences(rng, n):
+    return [" ".join(rng.choice(WORDS, rng.randint(3, 12)))
+            for _ in range(n)]
+
+
+def stackoverflow(dirpath: str) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(1)
+    for split, n_clients, n_rows in (("train", 4, 10), ("test", 2, 6)):
+        path = os.path.join(dirpath, f"stackoverflow_{split}.h5")
+        with h5py.File(path, "w") as f:
+            ex = f.create_group("examples")
+            for c in range(n_clients):
+                g = ex.create_group(f"user_{c:02d}")
+                sents = _sentences(rng, n_rows)
+                tags = ["|".join(rng.choice(TAGS, rng.randint(1, 3),
+                                            replace=False))
+                        for _ in range(n_rows)]
+                st = h5py.string_dtype()
+                g.create_dataset("tokens", data=sents, dtype=st)
+                g.create_dataset("title", data=sents, dtype=st)
+                g.create_dataset("tags", data=tags, dtype=st)
+    # vocab: word + count, most frequent first (reference word_count format)
+    with open(os.path.join(dirpath, "stackoverflow.word_count"), "w") as f:
+        for i, w in enumerate(WORDS):
+            f.write(f"{w} {1000 - i}\n")
+    # tags: json ordered dict tag -> count
+    with open(os.path.join(dirpath, "stackoverflow.tag_count"), "w") as f:
+        json.dump({t: 500 - i for i, t in enumerate(TAGS)}, f)
+
+
+def main() -> None:
+    fed_cifar100(os.path.join(ROOT, "fed_cifar100"))
+    stackoverflow(os.path.join(ROOT, "stackoverflow_nwp"))
+    stackoverflow(os.path.join(ROOT, "stackoverflow_lr"))
+    print("fixtures written under", os.path.abspath(ROOT))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Worker for test_multiprocess_mesh: one HOST of a two-process silo.
+
+Each OS process owns 4 virtual CPU devices; ``init_silo_process_group``
+(the torchrun-env contract) joins them into ONE 8-device JAX runtime, and
+the hierarchical silo trainer then runs its data-parallel local step over
+the GLOBAL mesh — the same program a real multi-host TPU silo runs. Rank 0
+writes the round result to ``sys.argv[1]`` for the pytest process to
+compare against the single-process golden.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    out_path = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fedml_tpu.cross_silo.hierarchical.process_group import (
+        init_silo_process_group)
+    assert init_silo_process_group(), "WORLD_SIZE env contract not seen"
+    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 8, f"global mesh is {len(jax.devices())}"
+
+    import numpy as np
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import make_trainer_spec
+    from fedml_tpu.cross_silo.hierarchical.trainer import (
+        HierarchicalSiloTrainer)
+    from fedml_tpu.optimizers.registry import create_optimizer
+
+    args = Arguments(dataset="digits", model="lr", client_num_in_total=2,
+                     client_num_per_round=2, comm_round=1, epochs=1,
+                     batch_size=32, learning_rate=0.1, random_seed=7,
+                     training_type="cross_silo")
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    spec = make_trainer_spec(fed, bundle)
+    opt = create_optimizer(args, spec)
+    # the silo's mesh = the GLOBAL device list spanning both processes
+    trainer = HierarchicalSiloTrainer(args, fed, bundle, spec, opt,
+                                      devices=jax.devices())
+    params = trainer.params_template
+
+    # one FedAvg round across 2 clients, both trained by this silo program
+    deltas, ws = [], []
+    for cid in range(2):
+        new_p, n, _ = trainer.train(params, cid, 0)
+        deltas.append(jax.tree_util.tree_map(
+            lambda a, b: np.asarray(a) - np.asarray(b), new_p, params))
+        ws.append(n)
+    wsum = sum(ws)
+    agg = jax.tree_util.tree_map(
+        lambda *ds: sum(w * d for w, d in zip(ws, ds)) / wsum, *deltas)
+    new_params = jax.tree_util.tree_map(
+        lambda p, u: np.asarray(p) + u, params, agg)
+
+    if jax.process_index() == 0:
+        flat = np.concatenate([np.asarray(l).ravel() for l in
+                               jax.tree_util.tree_leaves(new_params)])
+        with open(out_path, "w") as f:
+            json.dump({"n_global_devices": len(jax.devices()),
+                       "n_processes": jax.process_count(),
+                       "weights": ws,
+                       "params_sum": float(flat.sum()),
+                       "params": flat[:4096].tolist()}, f)
+    # all processes must reach teardown together
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,10 @@ def test_mask_seed_symmetric_and_pair_specific():
     s_ab = channels.mask_seed(sk_a, pk_b)
     s_ba = channels.mask_seed(sk_b, pk_a)
     assert s_ab == s_ba  # ECDH symmetry: both ends derive the same seed
-    assert 0 <= s_ab < int(channels.P)
+    # 128-bit seed space: the PRG expands outputs in GF(2^31-1) but the
+    # seed itself must not collapse to 31 bits (ADVICE r3)
+    assert 0 <= s_ab < (1 << 128)
+    assert s_ab.bit_length() > 64
     assert channels.mask_seed(sk_a, pk_c) != s_ab
 
 

@@ -161,6 +161,57 @@ class TestBundledShakespeare:
         assert (tmp_path / "bundled" / "shakespeare" / "train").is_dir()
 
 
+class TestTFFFormats:
+    """The reference's TFF HDF5 on-disk formats load from a local cache —
+    checked-in fixtures (scripts/make_fixtures.py) pin the exact layout
+    (reference data/fed_cifar100/data_loader.py:1-202,
+    data/stackoverflow_nwp/data_loader.py:1-207, data/stackoverflow_lr/)."""
+
+    FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+    def _args(self, name, model, n):
+        return Arguments(dataset=name, model=model, client_num_in_total=n,
+                         client_num_per_round=n, comm_round=1, epochs=1,
+                         batch_size=8, learning_rate=0.1, random_seed=0,
+                         data_cache_dir=self.FIXTURES)
+
+    def test_fed_cifar100_h5(self):
+        fed, out = data_mod.load(self._args("fed_cifar100", "resnet18", 4))
+        assert out == 100 and fed.provenance == "real"
+        assert fed.num_clients == 4
+        x = np.asarray(fed.train.x)
+        assert x.shape[-3:] == (32, 32, 3) and 0.0 <= x.min() <= x.max() <= 1.0
+
+    def test_stackoverflow_nwp_h5(self):
+        fed, out = data_mod.load(self._args("stackoverflow_nwp", "rnn", 4))
+        assert fed.provenance == "real" and fed.num_clients == 4
+        x = np.asarray(fed.train.x)
+        y = np.asarray(fed.train.y)
+        assert x.shape[-1] == 20 and y.shape[-1] == 20
+        # next-word labels: y is x shifted by one on real rows
+        m = np.asarray(fed.train.mask)[0].reshape(-1) > 0
+        xf, yf = x[0].reshape(-1, 20)[m], y[0].reshape(-1, 20)[m]
+        np.testing.assert_array_equal(xf[0, 1:], yf[0, :-1])
+        assert xf[0, 0] == out - 3  # bos = len(vocab) - 2 of vocab+oov ids
+
+    def test_stackoverflow_lr_h5(self):
+        fed, out = data_mod.load(self._args("stackoverflow_lr", "lr", 4))
+        assert out == 8 and fed.provenance == "real"  # fixture tag count
+        x = np.asarray(fed.train.x)
+        y = np.asarray(fed.train.y)
+        # bag-of-words rows sum to <= 1 (mean one-hot, oov column dropped)
+        m = np.asarray(fed.train.mask)[0].reshape(-1) > 0
+        rows = x[0].reshape(-1, x.shape[-1])[m]
+        assert rows.sum(-1).max() <= 1.0 + 1e-6
+        assert set(np.unique(y)) <= {0.0, 1.0}
+
+    def test_stackoverflow_lr_trains_one_round(self):
+        import fedml_tpu
+        args = self._args("stackoverflow_lr", "lr", 4)
+        r = fedml_tpu.run_simulation(backend="sp", args=args)
+        assert "final_test_acc" in r
+
+
 class TestFinanceLoaders:
     def test_lending_club_from_cache(self, tmp_path):
         """A cached loan.csv with the reference schema loads as real."""

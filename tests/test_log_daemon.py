@@ -1,0 +1,136 @@
+"""Remote log shipping (VERDICT r3 item 8 / inventory row 65): the runtime
+log daemon tails per-run files and POSTs batches to an HTTP log server —
+with retry on transient failures and rotation awareness — completing the
+remote half of observability (reference mlops_runtime_log_daemon.py)."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from fedml_tpu.core.mlops.log_daemon import LogShipper
+
+
+class _Collector(BaseHTTPRequestHandler):
+    fail_next = 0
+    received = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        if _Collector.fail_next > 0:
+            _Collector.fail_next -= 1
+            self.send_response(500)
+            self.end_headers()
+            return
+        _Collector.received.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def log_server():
+    _Collector.received = []
+    _Collector.fail_next = 0
+    srv = HTTPServer(("127.0.0.1", 0), _Collector)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}/logs", _Collector
+    srv.shutdown()
+
+
+def test_batching_and_metadata(tmp_path, log_server):
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "w") as f:
+        for i in range(250):
+            f.write(f"line {i}\n")
+    s = LogShipper(path, url, run_id="r1", device_id="7", batch_lines=100)
+    shipped = s.pump_once()
+    assert shipped == 250
+    assert [len(b["log_lines"]) for b in col.received] == [100, 100, 50]
+    assert col.received[0]["run_id"] == "r1"
+    assert col.received[0]["device_id"] == "7"
+    assert [b["seq"] for b in col.received] == [0, 1, 2]
+    # nothing new -> nothing shipped
+    assert s.pump_once() == 0
+    # appended lines ship incrementally; a partial line waits for its \n
+    with open(path, "a") as f:
+        f.write("more A\nmore B\npartial")
+    assert s.pump_once() == 2
+    with open(path, "a") as f:
+        f.write(" done\n")
+    assert s.pump_once() == 1
+    assert col.received[-1]["log_lines"] == ["partial done"]
+
+
+def test_retry_on_transient_failure(tmp_path, log_server):
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "w") as f:
+        f.write("hello\n")
+    col.fail_next = 2  # two 500s, then healthy
+    s = LogShipper(path, url, retries=4)
+    assert s.pump_once() == 1
+    assert s.failed_batches == 0
+    assert col.received[-1]["log_lines"] == ["hello"]
+
+
+def test_rotation_awareness(tmp_path, log_server):
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "w") as f:
+        f.write("old 1\nold 2\n")
+    s = LogShipper(path, url)
+    assert s.pump_once() == 2
+    # rotate: move the old file away, create a fresh one at the same path
+    os.replace(path, str(tmp_path / "job.log.1"))
+    with open(path, "w") as f:
+        f.write("new 1\n")
+    assert s.pump_once() == 1
+    assert col.received[-1]["log_lines"] == ["new 1"]
+    # truncation (copytruncate-style rotation) also re-tails. Detection is
+    # size-based, so the shrunken file must actually be shorter than the
+    # old offset — an equal-size rewrite is indistinguishable by stat.
+    with open(path, "w") as f:
+        f.write("hi\n")
+    assert s.pump_once() == 1
+    assert col.received[-1]["log_lines"] == ["hi"]
+
+
+def test_background_thread_ships_and_flushes_on_stop(tmp_path, log_server):
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "w") as f:
+        f.write("a\n")
+    s = LogShipper(path, url, interval_s=0.05).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and s.shipped_lines < 1:
+        time.sleep(0.05)
+    assert s.shipped_lines == 1
+    with open(path, "a") as f:
+        f.write("b\n")
+    s.stop()  # final flush must pick up 'b'
+    assert s.shipped_lines == 2
+
+
+def test_wired_into_mlops_init(tmp_path, log_server, monkeypatch):
+    url, col = log_server
+    from fedml_tpu.core import mlops
+    from fedml_tpu.core.mlops import log_daemon
+    from fedml_tpu.arguments import Arguments
+
+    args = Arguments(dataset="digits", model="lr", run_id="ship1",
+                     log_file_dir=str(tmp_path), log_server_url=url)
+    mlops.init(args)
+    mlops.log({"acc": 0.5}, step=0)
+    for s in log_daemon._shippers:
+        s.pump_once()
+    log_daemon.stop_all_shippers()
+    mine = [b for b in col.received if b["run_id"] == "ship1"]
+    assert mine and any("acc" in ln for b in mine for ln in b["log_lines"])

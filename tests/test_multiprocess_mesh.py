@@ -1,0 +1,106 @@
+"""Two-OS-process mesh execution (VERDICT r3 item 3): two separate
+interpreters form ONE JAX runtime via ``jax.distributed.initialize``
+(through the repo's torchrun-env bootstrap, ``init_silo_process_group``),
+run a hierarchical-silo federated round over the global 8-device mesh, and
+the result matches the single-process 8-device run — converting "on real
+hardware each silo is its own host" from a claim into a tested property.
+
+Reference counterpart: multi-node-without-a-cluster smoke tests
+(``tests/smoke_test/simulation_mpi/mpi_host_file``, torchrun
+``--nproc_per_node=5``)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multiproc_silo_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _golden():
+    """Same round on THIS process's own 8-device CPU mesh."""
+    import jax
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import make_trainer_spec
+    from fedml_tpu.cross_silo.hierarchical.trainer import (
+        HierarchicalSiloTrainer)
+    from fedml_tpu.optimizers.registry import create_optimizer
+
+    args = Arguments(dataset="digits", model="lr", client_num_in_total=2,
+                     client_num_per_round=2, comm_round=1, epochs=1,
+                     batch_size=32, learning_rate=0.1, random_seed=7,
+                     training_type="cross_silo")
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    spec = make_trainer_spec(fed, bundle)
+    opt = create_optimizer(args, spec)
+    trainer = HierarchicalSiloTrainer(args, fed, bundle, spec, opt,
+                                      devices=jax.devices()[:8])
+    params = trainer.params_template
+    deltas, ws = [], []
+    for cid in range(2):
+        new_p, n, _ = trainer.train(params, cid, 0)
+        deltas.append(jax.tree_util.tree_map(
+            lambda a, b: np.asarray(a) - np.asarray(b), new_p, params))
+        ws.append(n)
+    wsum = sum(ws)
+    agg = jax.tree_util.tree_map(
+        lambda *ds: sum(w * d for w, d in zip(ws, ds)) / wsum, *deltas)
+    out = jax.tree_util.tree_map(
+        lambda p, u: np.asarray(p) + u, params, agg)
+    flat = np.concatenate([np.asarray(l).ravel() for l in
+                           jax.tree_util.tree_leaves(out)])
+    return ws, flat
+
+
+def test_two_process_mesh_round_matches_single_process(tmp_path):
+    port = _free_port()
+    out_path = str(tmp_path / "result.json")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+        env.update({
+            "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port),
+            "WORLD_SIZE": "2", "RANK": str(rank),
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, out_path], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process mesh round timed out")
+        outs.append(out.decode(errors="replace"))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    with open(out_path) as f:
+        got = json.load(f)
+    assert got["n_processes"] == 2
+    assert got["n_global_devices"] == 8
+
+    ws, flat = _golden()
+    assert got["weights"] == ws
+    np.testing.assert_allclose(np.asarray(got["params"]),
+                               flat[:4096], rtol=1e-5, atol=1e-6)
+    assert abs(got["params_sum"] - float(flat.sum())) < 1e-3
