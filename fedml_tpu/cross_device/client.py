@@ -113,7 +113,11 @@ class DeviceClientManager(FedMLCommManager):
             return
         cdata = jax.tree_util.tree_map(
             lambda a: a[silo_idx % self.fed.num_clients], self.fed.train)
+        eval_acc = None
         if self.engine == "native":
+            # on-device eval of the received global model BEFORE training
+            # (the MobileNN on-device test path) — reported to the server
+            eval_acc = self._eval_native(params, cdata)
             new_params, n, loss = self._train_native(params, cdata,
                                                      round_idx)
         else:
@@ -128,6 +132,8 @@ class DeviceClientManager(FedMLCommManager):
         reply.add_params(DeviceMessage.ARG_ROUND_IDX, round_idx)
         reply.add_params(DeviceMessage.ARG_NUM_SAMPLES, n)
         reply.add_params(DeviceMessage.ARG_TRAIN_LOSS, loss)
+        if eval_acc is not None:
+            reply.add_params(DeviceMessage.ARG_DEVICE_EVAL_ACC, eval_acc)
         self.send_message(reply)
 
     def handle_finish(self, msg: Message) -> None:
@@ -158,13 +164,21 @@ class DeviceClientManager(FedMLCommManager):
         return (jax.device_get(new_params), n,
                 float(metrics["loss_sum"]) / cnt)
 
-    def _train_native(self, params, cdata, round_idx: int):
-        # flatten padded batches back to the real sample list
+    @staticmethod
+    def _flatten_real(cdata):
         x = np.asarray(cdata.x)
         y = np.asarray(cdata.y)
         mask = np.asarray(cdata.mask).reshape(-1) > 0
-        x = x.reshape((-1,) + x.shape[2:])[mask]
-        y = y.reshape(-1)[mask].astype(np.int32)
+        return (x.reshape((-1,) + x.shape[2:])[mask],
+                y.reshape(-1)[mask].astype(np.int32))
+
+    def _eval_native(self, params, cdata) -> float:
+        x, y = self._flatten_real(cdata)
+        return float(self._native.evaluate(params, x, y))
+
+    def _train_native(self, params, cdata, round_idx: int):
+        # flatten padded batches back to the real sample list
+        x, y = self._flatten_real(cdata)
         new_params, loss = self._native.train(
             params, x, y, epochs=int(self.args.epochs),
             batch_size=int(self.args.batch_size),

@@ -24,6 +24,9 @@ class DeviceMessage:
     ARG_DATA_SILO_IDX = "data_silo_idx"
     ARG_NUM_SAMPLES = "num_samples"
     ARG_TRAIN_LOSS = "train_loss"
+    # on-device eval of the received GLOBAL model on the device's shard
+    # (reference: MobileNN's on-device test path; reported natively)
+    ARG_DEVICE_EVAL_ACC = "device_eval_acc"
 
     STATUS_ONLINE = "ONLINE"
     STATUS_FINISHED = "FINISHED"

@@ -112,6 +112,9 @@ class DeviceServerManager(FedMLCommManager):
         # round's collection closes, so a timer thread that was already
         # blocked on the lock bails instead of double-advancing
         self._round_closed = False
+        # did -> on-device accuracy of the round's global model (native
+        # devices report it; cleared per round)
+        self._device_accs: dict = {}
 
     # --- FSM ---------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -217,6 +220,9 @@ class DeviceServerManager(FedMLCommManager):
             self.aggregator.add_device_result(
                 did, path,
                 float(msg.get(DeviceMessage.ARG_NUM_SAMPLES, 1.0)))
+            acc = msg.get(DeviceMessage.ARG_DEVICE_EVAL_ACC)
+            if acc is not None:  # on-device eval of the global model
+                self._device_accs[did] = float(acc)
             if not self.aggregator.all_received():
                 if (self.round_timeout_s > 0
                         and len(self.aggregator.model_files) == 1):
@@ -254,6 +260,11 @@ class DeviceServerManager(FedMLCommManager):
         if stats:
             rec.update(stats)
             logger.info("server round %d: %s", self.round_idx, stats)
+        if self._device_accs:  # on-device evals of this round's global
+            rec["device_eval_acc"] = (sum(self._device_accs.values())
+                                      / len(self._device_accs))
+            rec["device_eval_count"] = len(self._device_accs)
+            self._device_accs = {}
         self.history.append(rec)
         mlops.log_round_info(self.round_num, self.round_idx)
         self.round_idx += 1

@@ -35,6 +35,10 @@ _build_failed = False
 
 PRIME = 2147483647  # 2^31 - 1, matches core/mpc/field_ops.py
 
+# callback signatures of the C ABI (include/fedml_client.h)
+PROGRESS_CB = ctypes.CFUNCTYPE(None, ctypes.c_float)
+LOSS_CB = ctypes.CFUNCTYPE(None, ctypes.c_int32, ctypes.c_float)
+
 
 def _cache_path() -> str:
     with open(_SRC, "rb") as f:
@@ -108,9 +112,56 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.csv_read.restype = ctypes.c_int32
         lib.csv_read.argtypes = [ctypes.c_char_p, f32p, i32p,
                                  ctypes.c_int32, ctypes.c_int32]
+        # model artifact codec (serialized-model handling)
+        lib.artifact_open.restype = ctypes.c_void_p
+        lib.artifact_open.argtypes = [ctypes.c_char_p]
+        lib.artifact_count.restype = ctypes.c_int32
+        lib.artifact_count.argtypes = [ctypes.c_void_p]
+        lib.artifact_key.restype = ctypes.c_int32
+        lib.artifact_key.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                     ctypes.c_char_p, ctypes.c_int32]
+        lib.artifact_elems.restype = ctypes.c_int64
+        lib.artifact_elems.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.artifact_shape.restype = ctypes.c_int32
+        lib.artifact_shape.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       i32p, ctypes.c_int32]
+        lib.artifact_read_f32.restype = ctypes.c_int64
+        lib.artifact_read_f32.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          f32p, ctypes.c_int64]
+        lib.artifact_close.restype = None
+        lib.artifact_close.argtypes = [ctypes.c_void_p]
+        lib.artifact_save.restype = ctypes.c_int32
+        lib.artifact_save.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(f32p), i32p, i32p, ctypes.c_int32]
+        # client manager session (FedMLClientManager analogue)
+        lib.fedml_client_create.restype = ctypes.c_void_p
+        lib.fedml_client_create.argtypes = []
+        lib.fedml_client_release.restype = None
+        lib.fedml_client_release.argtypes = [ctypes.c_void_p]
+        lib.fedml_client_init.restype = ctypes.c_int32
+        lib.fedml_client_init.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int32, ctypes.c_float, ctypes.c_int32,
+            ctypes.c_uint64]
+        lib.fedml_client_set_callbacks.restype = None
+        lib.fedml_client_set_callbacks.argtypes = [ctypes.c_void_p,
+                                                   PROGRESS_CB, LOSS_CB]
+        lib.fedml_client_train.restype = ctypes.c_float
+        lib.fedml_client_train.argtypes = [ctypes.c_void_p]
+        lib.fedml_client_get_epoch_and_loss.restype = ctypes.c_int32
+        lib.fedml_client_get_epoch_and_loss.argtypes = [
+            ctypes.c_void_p, i32p, f32p]
+        lib.fedml_client_stop_training.restype = ctypes.c_int32
+        lib.fedml_client_stop_training.argtypes = [ctypes.c_void_p]
+        lib.fedml_client_evaluate.restype = ctypes.c_float
+        lib.fedml_client_evaluate.argtypes = [ctypes.c_void_p]
+        lib.fedml_client_save_model.restype = ctypes.c_int32
+        lib.fedml_client_save_model.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_char_p]
         lib.mobilenn_abi_version.restype = ctypes.c_int32
         lib.mobilenn_abi_version.argtypes = []
-        assert lib.mobilenn_abi_version() == 2
+        assert lib.mobilenn_abi_version() == 3
         _lib = lib
         return _lib
 
@@ -295,3 +346,119 @@ def read_csv(path: str):
     if rc != 0:
         raise OSError(f"csv_read({path!r}) failed (rc={rc})")
     return x, y
+
+
+# ---------------------------------------------------------------------------
+# model artifact access (serialized-model handling) — the native codec for
+# the framework's msgpack artifact format (serving.save_model/load_model)
+
+
+def load_artifact_native(path: str) -> Dict[str, np.ndarray]:
+    """Parse a model artifact with the NATIVE codec (no Python msgpack):
+    returns {slash/path: float32 ndarray}. Raises on parse failure."""
+    lib = _load()
+    h = lib.artifact_open(path.encode())
+    if not h:
+        raise ValueError(f"{path}: not a parseable fedml_tpu artifact")
+    try:
+        out: Dict[str, np.ndarray] = {}
+        buf = ctypes.create_string_buffer(4096)
+        for i in range(lib.artifact_count(h)):
+            lib.artifact_key(h, np.int32(i), buf, np.int32(len(buf)))
+            key = buf.value.decode()
+            dims = np.zeros(16, np.int32)
+            nd = lib.artifact_shape(h, key.encode(), _i32p(dims),
+                                    np.int32(16))
+            shape = tuple(int(d) for d in dims[:nd])
+            n = lib.artifact_elems(h, key.encode())
+            arr = np.empty(int(n), np.float32)
+            got = lib.artifact_read_f32(h, key.encode(), _f32p(arr),
+                                        np.int64(n))
+            if got != n:
+                raise ValueError(f"{path}: short read on {key}")
+            out[key] = arr.reshape(shape)
+        return out
+    finally:
+        lib.artifact_close(h)
+
+
+def save_artifact_native(leaves: Dict[str, np.ndarray], path: str) -> None:
+    """Write {slash/path: float32 array} as a nested model artifact,
+    byte-compatible with ``serving.load_model``."""
+    lib = _load()
+    items = sorted(leaves.items())
+    keys = (ctypes.c_char_p * len(items))(
+        *[k.encode() for k, _ in items])
+    arrays = [np.ascontiguousarray(v, np.float32) for _, v in items]
+    data = (ctypes.POINTER(ctypes.c_float) * len(items))(
+        *[_f32p(a) for a in arrays])
+    ndims = np.asarray([a.ndim for a in arrays], np.int32)
+    shapes = np.asarray(sum([list(a.shape) for a in arrays], []), np.int32)
+    rc = lib.artifact_save(path.encode(), keys, data, _i32p(ndims),
+                           _i32p(shapes), np.int32(len(items)))
+    if rc != 0:
+        raise OSError(f"artifact_save({path!r}) failed (rc={rc})")
+
+
+class NativeClientManager:
+    """The FedMLClientManager analogue over the C ABI
+    (``include/fedml_client.h``; reference
+    ``MobileNN/includes/FedMLClientManager.h`` +
+    ``JniFedMLClientManager.cpp``): init(model artifact, CSV shard) ->
+    train -> evaluate/save, with progress/loss callbacks."""
+
+    def __init__(self):
+        self.lib = _load()
+        if self.lib is None:
+            raise RuntimeError("native core unavailable (no g++?)")
+        self._h = self.lib.fedml_client_create()
+        self._cbs = []  # keep ctypes callbacks alive for the session
+
+    def init(self, model_path: str, data_path: str, batch_size: int = 32,
+             learning_rate: float = 0.1, epochs: int = 1,
+             seed: int = 0) -> None:
+        rc = self.lib.fedml_client_init(
+            self._h, model_path.encode(), data_path.encode(),
+            np.int32(batch_size), np.float32(learning_rate),
+            np.int32(epochs), np.uint64(seed))
+        if rc != 0:
+            raise RuntimeError(f"fedml_client_init failed (rc={rc})")
+
+    def set_callbacks(self, on_progress=None, on_loss=None) -> None:
+        p = PROGRESS_CB(on_progress) if on_progress else PROGRESS_CB()
+        l = LOSS_CB(on_loss) if on_loss else LOSS_CB()
+        self._cbs = [p, l]  # keep alive: C holds these pointers
+        self.lib.fedml_client_set_callbacks(self._h, p, l)
+
+    def train(self) -> float:
+        return float(self.lib.fedml_client_train(self._h))
+
+    def get_epoch_and_loss(self):
+        e = np.zeros(1, np.int32)
+        lo = np.zeros(1, np.float32)
+        self.lib.fedml_client_get_epoch_and_loss(self._h, _i32p(e),
+                                                 _f32p(lo))
+        return int(e[0]), float(lo[0])
+
+    def stop_training(self) -> None:
+        self.lib.fedml_client_stop_training(self._h)
+
+    def evaluate(self) -> float:
+        return float(self.lib.fedml_client_evaluate(self._h))
+
+    def save_model(self, path: str) -> None:
+        rc = self.lib.fedml_client_save_model(self._h, path.encode())
+        if rc != 0:
+            raise OSError(f"fedml_client_save_model failed (rc={rc})")
+
+    def close(self) -> None:
+        if self._h:
+            self.lib.fedml_client_release(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

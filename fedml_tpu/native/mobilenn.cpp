@@ -160,7 +160,7 @@ void unmask_vector(float* out, const uint32_t* masked, int64_t n,
   }
 }
 
-int32_t mobilenn_abi_version() { return 2; }
+int32_t mobilenn_abi_version() { return 3; }
 
 }  // extern "C"
 
@@ -522,6 +522,518 @@ int32_t csv_read(const char* path, float* x, int32_t* y, int32_t rows,
   }
   std::fclose(f);
   return 0;
+}
+
+}  // extern "C"
+
+// ===================== model artifact codec (msgpack) =======================
+//
+// Reads/writes the framework's model artifact format natively: the
+// "FMTPU1\n" magic followed by a msgpack map tree whose leaves are
+// ext-42 numpy arrays (head = packed (dtype_str, shape), then raw bytes)
+// — the exact bytes `serving.save_model` / `load_model` produce, so a
+// device can consume the server's global model and produce an update the
+// server loads with zero Python on the device (reference counterpart: the
+// MNN/torch serialized-model handling in FedMLMNNTrainer.cpp /
+// FedMLTorchTrainer.cpp). Subset codec: maps, strings, arrays,
+// non-negative ints, ext — everything a param-tree artifact contains.
+
+#include <map>
+#include <memory>
+#include <string>
+
+namespace artifact {
+
+constexpr char kMagic[] = "FMTPU1\n";
+constexpr size_t kMagicLen = 7;
+constexpr int8_t kNpExt = 42;
+
+struct Leaf {
+  std::vector<int32_t> shape;
+  std::vector<float> data;
+};
+
+struct Store {
+  std::map<std::string, Leaf> leaves;  // "a/b/c" slash paths, sorted
+};
+
+// ---- reader ----------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  uint8_t u8() {
+    if (p >= end) { fail = true; return 0; }
+    return *p++;
+  }
+  uint64_t be(int n) {
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 8) | u8();
+    return v;
+  }
+  const uint8_t* raw(size_t n) {
+    // compare against the remaining size, not p + n (a crafted huge n
+    // would overflow the pointer arithmetic — UB — before the check)
+    if (n > static_cast<size_t>(end - p)) { fail = true; return nullptr; }
+    const uint8_t* r = p;
+    p += n;
+    return r;
+  }
+};
+
+bool parse_uint(Cursor& c, uint64_t* out) {
+  uint8_t t = c.u8();
+  if (t <= 0x7f) { *out = t; return true; }
+  if (t == 0xcc) { *out = c.be(1); return true; }
+  if (t == 0xcd) { *out = c.be(2); return true; }
+  if (t == 0xce) { *out = c.be(4); return true; }
+  if (t == 0xcf) { *out = c.be(8); return true; }
+  return false;
+}
+
+bool parse_str(Cursor& c, std::string* out) {
+  uint8_t t = c.u8();
+  size_t n;
+  if ((t & 0xe0) == 0xa0) n = t & 0x1f;
+  else if (t == 0xd9) n = c.be(1);
+  else if (t == 0xda) n = c.be(2);
+  else if (t == 0xdb) n = c.be(4);
+  else return false;
+  const uint8_t* r = c.raw(n);
+  if (!r) return false;
+  out->assign(reinterpret_cast<const char*>(r), n);
+  return true;
+}
+
+// ext leaf -> Leaf (head tuple [dtype_str, [shape...]] + raw data).
+// `len` is ATTACKER-CONTROLLED (artifacts cross trust boundaries — device
+// uploads, served model pulls): it must be bounded by the remaining
+// buffer before any sub-cursor is built, and allocation is deferred until
+// the payload length has been checked against the declared shape.
+bool parse_ext_leaf(Cursor& c, size_t len, int8_t type, Leaf* leaf) {
+  if (type != kNpExt) return false;
+  if (len > static_cast<size_t>(c.end - c.p)) return false;  // truncated
+  Cursor h{c.p, c.p + len};
+  const uint8_t* payload_end = c.p + len;
+  uint8_t t = h.u8();
+  size_t tuple_n;
+  if ((t & 0xf0) == 0x90) tuple_n = t & 0x0f;
+  else if (t == 0xdc) tuple_n = h.be(2);
+  else return false;
+  if (tuple_n != 2) return false;
+  std::string dtype;
+  if (!parse_str(h, &dtype)) return false;
+  uint8_t s = h.u8();
+  size_t ndim;
+  if ((s & 0xf0) == 0x90) ndim = s & 0x0f;
+  else if (s == 0xdc) ndim = h.be(2);
+  else return false;
+  size_t elems = 1;
+  leaf->shape.clear();
+  for (size_t i = 0; i < ndim; ++i) {
+    uint64_t d;
+    if (!parse_uint(h, &d)) return false;
+    if (d > (1ULL << 31)) return false;  // absurd dim = crafted input
+    leaf->shape.push_back(static_cast<int32_t>(d));
+    if (d != 0 && elems > (1ULL << 33) / d) return false;  // overflow cap
+    elems *= d;
+  }
+  if (h.fail) return false;
+  const uint8_t* data = h.p;
+  size_t nbytes = static_cast<size_t>(payload_end - data);
+  // validate the declared shape against the ACTUAL payload bytes BEFORE
+  // allocating — crafted dims must not drive a giant resize
+  size_t unit;
+  if (dtype == "<f4" || dtype == "<i4") unit = 4;
+  else if (dtype == "<f8") unit = 8;
+  else return false;  // artifact leaves are float tensors
+  if (nbytes != elems * unit) return false;
+  leaf->data.resize(elems);
+  if (dtype == "<f4") {
+    std::memcpy(leaf->data.data(), data, nbytes);
+  } else if (dtype == "<f8") {
+    const double* src = reinterpret_cast<const double*>(data);
+    for (size_t i = 0; i < elems; ++i)
+      leaf->data[i] = static_cast<float>(src[i]);
+  } else {  // <i4
+    const int32_t* src = reinterpret_cast<const int32_t*>(data);
+    for (size_t i = 0; i < elems; ++i)
+      leaf->data[i] = static_cast<float>(src[i]);
+  }
+  c.p = payload_end;
+  return true;
+}
+
+bool parse_value(Cursor& c, const std::string& prefix, Store* store);
+
+bool parse_map(Cursor& c, size_t n, const std::string& prefix,
+               Store* store) {
+  for (size_t i = 0; i < n; ++i) {
+    std::string key;
+    if (!parse_str(c, &key)) return false;
+    std::string path = prefix.empty() ? key : prefix + "/" + key;
+    if (!parse_value(c, path, store)) return false;
+  }
+  return true;
+}
+
+bool parse_value(Cursor& c, const std::string& prefix, Store* store) {
+  if (c.p >= c.end) return false;
+  uint8_t t = *c.p;
+  if ((t & 0xf0) == 0x80) { c.u8(); return parse_map(c, t & 0x0f, prefix, store); }
+  if (t == 0xde) { c.u8(); return parse_map(c, c.be(2), prefix, store); }
+  if (t == 0xdf) { c.u8(); return parse_map(c, c.be(4), prefix, store); }
+  size_t len;
+  int8_t etype;
+  if (t == 0xd4 || t == 0xd5 || t == 0xd6 || t == 0xd7 || t == 0xd8) {
+    c.u8();
+    len = 1u << (t - 0xd4);
+    etype = static_cast<int8_t>(c.u8());
+  } else if (t == 0xc7) { c.u8(); len = c.be(1); etype = static_cast<int8_t>(c.u8()); }
+  else if (t == 0xc8) { c.u8(); len = c.be(2); etype = static_cast<int8_t>(c.u8()); }
+  else if (t == 0xc9) { c.u8(); len = c.be(4); etype = static_cast<int8_t>(c.u8()); }
+  else return false;  // artifact trees hold only maps and array leaves
+  Leaf leaf;
+  if (!parse_ext_leaf(c, len, etype, &leaf)) return false;
+  store->leaves[prefix] = std::move(leaf);
+  return true;
+}
+
+// ---- writer ----------------------------------------------------------------
+
+void put_be(std::vector<uint8_t>* out, uint64_t v, int n) {
+  for (int i = n - 1; i >= 0; --i)
+    out->push_back(static_cast<uint8_t>((v >> (8 * i)) & 0xff));
+}
+
+void put_str(std::vector<uint8_t>* out, const std::string& s) {
+  if (s.size() < 32) out->push_back(0xa0 | static_cast<uint8_t>(s.size()));
+  else { out->push_back(0xd9); put_be(out, s.size(), 1); }
+  out->insert(out->end(), s.begin(), s.end());
+}
+
+void put_uint(std::vector<uint8_t>* out, uint64_t v) {
+  if (v <= 0x7f) out->push_back(static_cast<uint8_t>(v));
+  else if (v <= 0xff) { out->push_back(0xcc); put_be(out, v, 1); }
+  else if (v <= 0xffff) { out->push_back(0xcd); put_be(out, v, 2); }
+  else { out->push_back(0xce); put_be(out, v, 4); }
+}
+
+void put_leaf(std::vector<uint8_t>* out, const Leaf& leaf) {
+  std::vector<uint8_t> head;
+  head.push_back(0x92);  // fixarray 2
+  put_str(&head, "<f4");
+  head.push_back(0x90 | static_cast<uint8_t>(leaf.shape.size()));
+  size_t elems = 1;
+  for (int32_t d : leaf.shape) { put_uint(&head, d); elems *= d; }
+  size_t total = head.size() + elems * 4;
+  out->push_back(0xc9);  // ext32 (simplest single form)
+  put_be(out, total, 4);
+  out->push_back(static_cast<uint8_t>(kNpExt));
+  out->insert(out->end(), head.begin(), head.end());
+  const uint8_t* data = reinterpret_cast<const uint8_t*>(leaf.data.data());
+  out->insert(out->end(), data, data + elems * 4);
+}
+
+// nested emit: the sorted flat slash paths form a tree; emit maps
+// recursively over the [begin, end) range sharing `prefix`
+using LeafIter = std::map<std::string, Leaf>::const_iterator;
+
+void put_tree(std::vector<uint8_t>* out, LeafIter begin, LeafIter end,
+              size_t prefix_len) {
+  // collect direct children
+  std::vector<std::pair<std::string, std::pair<LeafIter, LeafIter>>> kids;
+  for (LeafIter it = begin; it != end;) {
+    const std::string& path = it->first;
+    size_t slash = path.find('/', prefix_len);
+    std::string child = (slash == std::string::npos)
+                            ? path.substr(prefix_len)
+                            : path.substr(prefix_len, slash - prefix_len);
+    LeafIter run = it;
+    while (run != end && run->first.compare(prefix_len, child.size(),
+                                            child) == 0 &&
+           (run->first.size() == prefix_len + child.size() ||
+            run->first[prefix_len + child.size()] == '/'))
+      ++run;
+    kids.emplace_back(child, std::make_pair(it, run));
+    it = run;
+  }
+  if (kids.size() < 16) out->push_back(0x80 | static_cast<uint8_t>(kids.size()));
+  else { out->push_back(0xde); put_be(out, kids.size(), 2); }
+  for (auto& k : kids) {
+    put_str(out, k.first);
+    LeafIter b = k.second.first, e = k.second.second;
+    bool is_leaf = (std::next(b) == e &&
+                    b->first.size() == prefix_len + k.first.size());
+    if (is_leaf) put_leaf(out, b->second);
+    else put_tree(out, b, e, prefix_len + k.first.size() + 1);
+  }
+}
+
+}  // namespace artifact
+
+extern "C" {
+
+// Opens a model artifact; returns an opaque handle or NULL on parse
+// failure. Pair with artifact_close.
+void* artifact_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < static_cast<long>(artifact::kMagicLen)) { std::fclose(f); return nullptr; }
+  std::vector<uint8_t> blob(static_cast<size_t>(size));
+  if (std::fread(blob.data(), 1, blob.size(), f) != blob.size()) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+  if (std::memcmp(blob.data(), artifact::kMagic, artifact::kMagicLen) != 0)
+    return nullptr;
+  auto store = std::make_unique<artifact::Store>();
+  artifact::Cursor c{blob.data() + artifact::kMagicLen,
+                     blob.data() + blob.size()};
+  if (!artifact::parse_value(c, "", store.get()) || c.fail) return nullptr;
+  return store.release();
+}
+
+int32_t artifact_count(void* h) {
+  return static_cast<int32_t>(
+      static_cast<artifact::Store*>(h)->leaves.size());
+}
+
+// i-th (sorted) slash path; returns its length or -1.
+int32_t artifact_key(void* h, int32_t i, char* out, int32_t cap) {
+  auto& leaves = static_cast<artifact::Store*>(h)->leaves;
+  if (i < 0 || i >= static_cast<int32_t>(leaves.size())) return -1;
+  auto it = leaves.begin();
+  std::advance(it, i);
+  int32_t n = static_cast<int32_t>(it->first.size());
+  if (cap > 0) {
+    int32_t c = n < cap - 1 ? n : cap - 1;
+    std::memcpy(out, it->first.data(), c);
+    out[c] = 0;
+  }
+  return n;
+}
+
+int64_t artifact_elems(void* h, const char* key) {
+  auto& leaves = static_cast<artifact::Store*>(h)->leaves;
+  auto it = leaves.find(key);
+  if (it == leaves.end()) return -1;
+  return static_cast<int64_t>(it->second.data.size());
+}
+
+int32_t artifact_shape(void* h, const char* key, int32_t* dims,
+                       int32_t cap) {
+  auto& leaves = static_cast<artifact::Store*>(h)->leaves;
+  auto it = leaves.find(key);
+  if (it == leaves.end()) return -1;
+  int32_t n = static_cast<int32_t>(it->second.shape.size());
+  for (int32_t i = 0; i < n && i < cap; ++i) dims[i] = it->second.shape[i];
+  return n;
+}
+
+int64_t artifact_read_f32(void* h, const char* key, float* out,
+                          int64_t cap) {
+  auto& leaves = static_cast<artifact::Store*>(h)->leaves;
+  auto it = leaves.find(key);
+  if (it == leaves.end()) return -1;
+  int64_t n = static_cast<int64_t>(it->second.data.size());
+  if (n > cap) return -2;
+  std::memcpy(out, it->second.data.data(), static_cast<size_t>(n) * 4);
+  return n;
+}
+
+void artifact_close(void* h) { delete static_cast<artifact::Store*>(h); }
+
+// Save leaves as a NESTED artifact (slash paths -> map tree), bytes
+// compatible with Python `serving.load_model`. shapes is the
+// concatenation of each leaf's dims (ndims[i] entries each).
+int32_t artifact_save(const char* path, const char** keys,
+                      const float** data, const int32_t* ndims,
+                      const int32_t* shapes, int32_t n_leaves) {
+  artifact::Store store;
+  const int32_t* sp = shapes;
+  for (int32_t i = 0; i < n_leaves; ++i) {
+    artifact::Leaf leaf;
+    size_t elems = 1;
+    for (int32_t d = 0; d < ndims[i]; ++d) {
+      leaf.shape.push_back(*sp);
+      elems *= static_cast<size_t>(*sp);
+      ++sp;
+    }
+    leaf.data.assign(data[i], data[i] + elems);
+    store.leaves[keys[i]] = std::move(leaf);
+  }
+  std::vector<uint8_t> out;
+  out.insert(out.end(), artifact::kMagic,
+             artifact::kMagic + artifact::kMagicLen);
+  artifact::put_tree(&out, store.leaves.begin(), store.leaves.end(), 0);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  bool ok = std::fwrite(out.data(), 1, out.size(), f) == out.size();
+  std::fclose(f);
+  return ok ? 0 : -2;
+}
+
+}  // extern "C"
+
+// ===================== device client manager ================================
+//
+// The FedMLClientManager analogue (reference
+// MobileNN/includes/FedMLClientManager.h + JniFedMLClientManager.cpp:
+// create/init/train/getEpochAndLoss/stopTraining/release): one opaque
+// session object a host app drives through the C ABI in
+// include/fedml_client.h. init() loads the global model ARTIFACT and the
+// device's CSV shard; train() runs the local epochs (linear or CNN per
+// the artifact's keys) with progress/loss callbacks; the trained params
+// save back as an artifact the server loads directly.
+
+extern "C" {
+
+typedef void (*fedml_progress_cb)(float pct);
+typedef void (*fedml_loss_cb)(int32_t epoch, float loss);
+
+struct FedMLClient {
+  artifact::Store params;
+  std::vector<float> x;
+  std::vector<int32_t> y;
+  int32_t n = 0, d = 0;
+  int32_t batch = 32, epochs = 1;
+  float lr = 0.1f;
+  uint64_t seed = 0;
+  volatile int32_t stop_flag = 0;
+  int32_t last_epoch = -1;
+  float last_loss = 0.0f;
+  fedml_progress_cb on_progress = nullptr;
+  fedml_loss_cb on_loss = nullptr;
+};
+
+void* fedml_client_create() { return new FedMLClient(); }
+
+void fedml_client_release(void* h) {
+  delete static_cast<FedMLClient*>(h);
+}
+
+// Load the global model artifact + the device's CSV data shard.
+// Returns 0 on success.
+int32_t fedml_client_init(void* h, const char* model_path,
+                          const char* data_path, int32_t batch_size,
+                          float learning_rate, int32_t epoch_num,
+                          uint64_t seed) {
+  auto* c = static_cast<FedMLClient*>(h);
+  void* art = artifact_open(model_path);
+  if (!art) return -1;
+  c->params = *static_cast<artifact::Store*>(art);
+  artifact_close(art);
+  int32_t rows = 0, cols = 0;
+  if (csv_probe(data_path, &rows, &cols) != 0 || cols < 2) return -2;
+  c->x.resize(static_cast<size_t>(rows) * (cols - 1));
+  c->y.resize(rows);
+  if (csv_read(data_path, c->x.data(), c->y.data(), rows, cols) != 0)
+    return -3;
+  c->n = rows;
+  c->d = cols - 1;
+  c->batch = batch_size;
+  c->lr = learning_rate;
+  c->epochs = epoch_num;
+  c->seed = seed;
+  c->stop_flag = 0;
+  return 0;
+}
+
+void fedml_client_set_callbacks(void* h, fedml_progress_cb progress,
+                                fedml_loss_cb loss) {
+  auto* c = static_cast<FedMLClient*>(h);
+  c->on_progress = progress;
+  c->on_loss = loss;
+}
+
+// Local training over the loaded shard; epoch-at-a-time so stopTraining
+// and the progress callback have real granularity. Returns final-epoch
+// mean loss (NaN on error).
+// Shared precondition of train/evaluate: the artifact's linear head must
+// exist, be 2-D, and match the loaded shard's feature width — a 64-wide
+// kernel against an 80-column CSV would index past the weight buffer.
+// Returns the class count k, or -1 when the params are unusable.
+static int32_t client_linear_classes(FedMLClient* c,
+                                     artifact::Leaf** W,
+                                     artifact::Leaf** B) {
+  auto wi = c->params.leaves.find("Dense_0/kernel");
+  auto bi = c->params.leaves.find("Dense_0/bias");
+  if (wi == c->params.leaves.end() || bi == c->params.leaves.end())
+    return -1;  // only the linear family is artifact-driven for now
+  if (wi->second.shape.size() != 2 || wi->second.shape[0] != c->d)
+    return -1;
+  int32_t k = wi->second.shape[1];
+  if (bi->second.shape.size() != 1 || bi->second.shape[0] != k) return -1;
+  *W = &wi->second;
+  *B = &bi->second;
+  return k;
+}
+
+float fedml_client_train(void* h) {
+  auto* c = static_cast<FedMLClient*>(h);
+  artifact::Leaf *W, *B;
+  int32_t k = client_linear_classes(c, &W, &B);
+  if (k < 0) return NAN;
+  float loss = NAN;
+  for (int32_t e = 0; e < c->epochs && !c->stop_flag; ++e) {
+    loss = train_linear_sgd(W->data.data(), B->data.data(),
+                            c->x.data(), c->y.data(), c->n, c->d, k, 1,
+                            c->batch, c->lr, c->seed + e);
+    c->last_epoch = e;
+    c->last_loss = loss;
+    if (c->on_loss) c->on_loss(e, loss);
+    if (c->on_progress)
+      c->on_progress(100.0f * (e + 1) / c->epochs);
+  }
+  return loss;
+}
+
+// "epoch,loss" of the most recent local epoch (reference getEpochAndLoss
+// returns the same pair as a string; a C ABI hands back the parts).
+int32_t fedml_client_get_epoch_and_loss(void* h, int32_t* epoch,
+                                        float* loss) {
+  auto* c = static_cast<FedMLClient*>(h);
+  *epoch = c->last_epoch;
+  *loss = c->last_loss;
+  return c->last_epoch >= 0 ? 0 : -1;
+}
+
+int32_t fedml_client_stop_training(void* h) {
+  static_cast<FedMLClient*>(h)->stop_flag = 1;
+  return 0;
+}
+
+// On-device evaluation of the CURRENT params on the loaded shard.
+float fedml_client_evaluate(void* h) {
+  auto* c = static_cast<FedMLClient*>(h);
+  artifact::Leaf *W, *B;
+  int32_t k = client_linear_classes(c, &W, &B);
+  if (k < 0) return -1.0f;
+  return eval_linear(W->data.data(), B->data.data(),
+                     c->x.data(), c->y.data(), c->n, c->d, k);
+}
+
+// Persist the trained params as an artifact the server loads directly.
+int32_t fedml_client_save_model(void* h, const char* path) {
+  auto* c = static_cast<FedMLClient*>(h);
+  std::vector<const char*> keys;
+  std::vector<const float*> data;
+  std::vector<int32_t> ndims, shapes;
+  for (auto& kv : c->params.leaves) {
+    keys.push_back(kv.first.c_str());
+    data.push_back(kv.second.data.data());
+    ndims.push_back(static_cast<int32_t>(kv.second.shape.size()));
+    for (int32_t dshape : kv.second.shape) shapes.push_back(dshape);
+  }
+  return artifact_save(path, keys.data(), data.data(), ndims.data(),
+                       shapes.data(), static_cast<int32_t>(keys.size()));
 }
 
 }  // extern "C"

@@ -76,35 +76,147 @@ class LookbackPolicy:
 
 # ---------------------------------------------------------- replica set ----
 
-class ReplicaSet:
-    """N live inference runners over one predictor-factory (the
-    container-fleet analogue; ``scale_to`` is the rolling update)."""
+class SubprocessReplica:
+    """One replica as a CHILD PROCESS serving HTTP — the process-isolation
+    analogue of the reference's per-replica docker container
+    (``device_model_deployment.py:61-333``): a crash (up to ``kill -9``)
+    kills only this process; the controller's health check replaces it.
+    Same surface as FedMLInferenceRunner: ``start()``/``stop()``/``port``.
+    """
 
-    def __init__(self, predictor_factory, min_replicas: int = 1,
-                 max_replicas: int = 8):
+    def __init__(self, spec_path: str, startup_wait_s: float = 30.0):
+        self.spec_path = spec_path
+        self.startup_wait_s = float(startup_wait_s)
+        self.port: Optional[int] = None
+        self.proc = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def start(self) -> int:
+        import subprocess
+        import sys
+        import tempfile
+        import os
+        fd, port_file = tempfile.mkstemp(suffix=".port")
+        os.close(fd)
+        os.unlink(port_file)
+        with open(self.spec_path) as f:
+            spec = json.load(f)
+        spec["port_file"] = port_file
+        child_spec = self.spec_path + f".{os.getpid()}.{id(self)}"
+        try:
+            with open(child_spec, "w") as f:
+                json.dump(spec, f)
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "fedml_tpu.serving.replica_main",
+                 child_spec],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            deadline = time.time() + self.startup_wait_s
+            while time.time() < deadline:
+                if os.path.exists(port_file):
+                    with open(port_file) as f:
+                        self.port = int(f.read().strip())
+                    return self.port
+                if self.proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            self.stop()
+            raise RuntimeError(
+                "subprocess replica never published its port")
+        finally:
+            # a crash-looping replica replaced by the health check every
+            # few seconds must not accumulate temp spec/port files
+            for p in (port_file, child_spec):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5.0)
+            except Exception:
+                self.proc.kill()
+                try:  # reap: an ignored SIGTERM must not leave a zombie
+                    self.proc.wait(timeout=5.0)
+                except Exception:
+                    pass
+
+
+def subprocess_replica_factory(args, params_path: str, output_dim: int,
+                               workdir: str, platform: str = "cpu"):
+    """Build a ``replica_factory`` for :class:`ReplicaSet`: each call
+    yields a fresh un-started :class:`SubprocessReplica` serving the given
+    model artifact."""
+    import os
+    spec = {"args": {k: v for k, v in vars(args).items()
+                     if isinstance(v, (str, int, float, bool, type(None)))},
+            "params_path": os.path.abspath(params_path),
+            "output_dim": int(output_dim), "platform": platform}
+    os.makedirs(workdir, exist_ok=True)
+    spec_path = os.path.join(workdir, "replica_spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    return lambda: SubprocessReplica(spec_path)
+
+
+class ReplicaSet:
+    """N live inference replicas over one factory (the container-fleet
+    analogue; ``scale_to`` is the rolling update). Replicas are in-process
+    runners via ``predictor_factory``, or isolated child processes via
+    ``replica_factory`` (see :class:`SubprocessReplica`)."""
+
+    def __init__(self, predictor_factory=None, min_replicas: int = 1,
+                 max_replicas: int = 8, replica_factory=None):
         from . import FedMLInferenceRunner
+        if (predictor_factory is None) == (replica_factory is None):
+            raise ValueError("pass exactly one of predictor_factory / "
+                             "replica_factory")
         self._runner_cls = FedMLInferenceRunner
         self.predictor_factory = predictor_factory
+        self.replica_factory = replica_factory
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.replicas: List = []
         self._lock = threading.Lock()
         self.scale_to(self.min_replicas)
 
+    def _new_replica(self):
+        if self.replica_factory is not None:
+            return self.replica_factory()
+        return self._runner_cls(self.predictor_factory())
+
     def scale_to(self, n: int) -> int:
+        """Grow/shrink to ``n``. Replica start/stop happens OUTSIDE the
+        set lock — a subprocess replica takes seconds to come up, and the
+        gateway needs the same lock for every request; scaling up under
+        load must not stall the traffic it is scaling for."""
         n = min(max(n, self.min_replicas), self.max_replicas)
-        with self._lock:
-            while len(self.replicas) < n:
-                runner = self._runner_cls(self.predictor_factory())
-                runner.start()
-                self.replicas.append(runner)
-                logger.info("replica up on :%d (%d total)", runner.port,
-                            len(self.replicas))
-            while len(self.replicas) > n:
-                runner = self.replicas.pop()
-                runner.stop()
-                logger.info("replica down (%d left)", len(self.replicas))
-        return n
+        while True:
+            victim = None
+            with self._lock:
+                cur = len(self.replicas)
+                if cur > n:
+                    victim = self.replicas.pop()
+            if victim is not None:
+                victim.stop()
+                logger.info("replica down (%d left)", len(self))
+                continue
+            if cur >= n:
+                return n
+            runner = self._new_replica()
+            runner.start()
+            with self._lock:
+                if len(self.replicas) < n:
+                    self.replicas.append(runner)
+                    logger.info("replica up on :%d (%d total)", runner.port,
+                                len(self.replicas))
+                    continue
+            runner.stop()  # target shrank underneath us
 
     def ports(self) -> List[int]:
         with self._lock:
@@ -124,7 +236,7 @@ class ReplicaSet:
     def _start_ready(self, wait_s: float = 10.0):
         """Start a fresh replica and wait until it answers /ready —
         traffic must never be pointed at a cold server."""
-        runner = self._runner_cls(self.predictor_factory())
+        runner = self._new_replica()
         runner.start()
         deadline = time.time() + wait_s
         while time.time() < deadline:
@@ -160,11 +272,23 @@ class ReplicaSet:
                 pass
         return replaced
 
-    def rolling_update(self, predictor_factory) -> None:
+    def rolling_update(self, predictor_factory=None,
+                       replica_factory=None) -> None:
         """Replace every replica with one built from the new factory,
         one at a time, new-up-and-ready before old-down — the gateway keeps
         serving throughout (reference rolling-upgrade flow)."""
-        self.predictor_factory = predictor_factory
+        if replica_factory is not None:
+            self.replica_factory = replica_factory
+        elif predictor_factory is not None:
+            if self.replica_factory is not None:
+                # subprocess mode: a bare positional factory is a replica
+                # factory
+                self.replica_factory = predictor_factory
+            else:
+                self.predictor_factory = predictor_factory
+        # both None: respawn from the CURRENT factory (subprocess mode
+        # re-reads the spec/artifact from disk on every start, so a bare
+        # rolling_update() rolls an updated on-disk model out)
         with self._lock:
             n = len(self.replicas)
         for i in range(n):
@@ -175,6 +299,9 @@ class ReplicaSet:
                     return
                 old = self.replicas[i]
                 self.replicas[i] = fresh
+            # drain: a request the gateway routed to `old` JUST before the
+            # swap is still in flight — stopping immediately resets it.
+            time.sleep(0.25)
             old.stop()
 
     def stop(self) -> None:
@@ -202,19 +329,34 @@ class Gateway:
         self._events: Deque[Tuple[float, float]] = deque()  # (ts, latency)
 
     def predict(self, request: dict, timeout: float = 30.0) -> dict:
-        ports = self.replica_set.ports()
-        if not ports:
-            raise RuntimeError("no live replicas")
-        with self._lock:
-            port = ports[self._i % len(ports)]
-            self._i += 1
+        body = json.dumps(request).encode()
         t0 = time.perf_counter()
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{port}/predict",
-            data=json.dumps(request).encode(),
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            out = json.load(r)
+        # one retry on a CONNECTION-PHASE failure only (replica swapped or
+        # crashed between routing and connect — the request never reached
+        # a predictor, so re-routing it is safe). HTTP errors and read
+        # timeouts DID reach a replica and must surface, not double the
+        # load on a saturated fleet.
+        for attempt in range(2):
+            ports = self.replica_set.ports()
+            if not ports:
+                raise RuntimeError("no live replicas")
+            with self._lock:
+                port = ports[self._i % len(ports)]
+                self._i += 1
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    out = json.load(r)
+                break
+            except urllib.error.HTTPError:
+                raise  # the replica answered; its answer stands
+            except (urllib.error.URLError, OSError) as e:
+                reason = getattr(e, "reason", e)
+                if (attempt == 1
+                        or not isinstance(reason, ConnectionError)):
+                    raise
         dt = time.perf_counter() - t0
         now = time.time()
         with self._lock:

@@ -1,0 +1,56 @@
+"""Subprocess replica entrypoint: ``python -m fedml_tpu.serving.replica_main
+<spec.json>`` builds a :class:`CheckpointPredictor` from a model artifact
+and serves it over HTTP until killed.
+
+This is the process-isolation analogue of the reference's container
+deployment (``model_scheduler/device_model_deployment.py:61-333``: one
+docker container per replica): a replica crash — up to ``kill -9`` — takes
+down this process only, never the gateway or its siblings; the replica
+controller's health check replaces the corpse. No container runtime exists
+in this environment, so the isolation boundary is the OS process.
+
+Spec schema (JSON):
+  ``args``        flat config dict (model/dataset fields the bundle needs)
+  ``params_path`` msgpack model artifact (``serving.save_model``)
+  ``output_dim``  classifier width
+  ``port_file``   where to write the bound port (the parent polls it)
+  ``platform``    jax platform for the replica (default "cpu" — serving
+                  replicas must not fight the trainer for the chip)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    spec_path = sys.argv[1]
+    with open(spec_path) as f:
+        spec = json.load(f)
+    os.environ.setdefault("JAX_PLATFORMS", spec.get("platform", "cpu"))
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ["JAX_PLATFORMS"].split(",")[0])
+
+    from types import SimpleNamespace
+    from . import CheckpointPredictor, FedMLInferenceRunner
+
+    args = SimpleNamespace(**spec["args"])
+    predictor = CheckpointPredictor.from_files(
+        args, spec["params_path"], int(spec["output_dim"]))
+    runner = FedMLInferenceRunner(predictor)
+    port = runner.start()
+    port_file = spec.get("port_file")
+    if port_file:
+        tmp = f"{port_file}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, port_file)
+    # serve until killed; the runner's server thread is non-daemon via join
+    runner._thread.join()
+
+
+if __name__ == "__main__":
+    main()

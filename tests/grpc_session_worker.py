@@ -1,0 +1,49 @@
+"""Worker for test_grpc_multiprocess_session: one role (server or client
+rank) of a cross-silo FL session over real gRPC sockets, driven through
+the public ``CrossSiloRunner`` dispatch — including the SecAgg federated
+optimizer, whose whole message FSM (channel keys -> round keys -> shares
+-> masked models -> unmask) rides the same transport.
+
+Usage: grpc_session_worker.py <role> <rank> <base_port> <optimizer> <out>
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    role, rank, base_port, optimizer, out_path = sys.argv[1:6]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.cross_silo.horizontal.runner import CrossSiloRunner
+
+    args = Arguments(
+        dataset="digits", model="lr", client_num_in_total=3,
+        client_num_per_round=3, comm_round=2, epochs=1, batch_size=32,
+        learning_rate=0.1, random_seed=11, training_type="cross_silo",
+        federated_optimizer=optimizer, backend="GRPC",
+        grpc_base_port=int(base_port), role=role, rank=int(rank),
+        round_timeout_s=30.0)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    runner = CrossSiloRunner(args, fed, bundle)
+    result = runner.run()
+
+    if role == "server":
+        out = {"error": None, "rounds": None, "final_test_acc": None}
+        if isinstance(result, dict):
+            out["error"] = result.get("error")
+            out["final_test_acc"] = result.get("final_test_acc")
+            hist = result.get("history") or []
+            out["rounds"] = len(hist)
+        with open(out_path, "w") as f:
+            json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,8 @@
 tests its autoscaler with fake QPS traces), replica scale-out, gateway
 round-robin."""
 
+import time
+
 import numpy as np
 
 from fedml_tpu.serving.autoscale import (Autoscaler, ConcurrencyPolicy,
@@ -141,3 +143,61 @@ class TestReplicaHealth:
             assert "v1" in versions  # traffic overlapped the rollout
         finally:
             rs.stop()
+
+
+class TestSubprocessReplicas:
+    """Process-isolated replicas (VERDICT r3 item 6): each replica is a
+    child OS process serving HTTP (the container analogue); SIGKILLing one
+    never touches the gateway, and the health check replaces the corpse."""
+
+    @staticmethod
+    def _factory(tmp_path):
+        import jax
+        import numpy as np
+        from types import SimpleNamespace
+        from fedml_tpu.model import create
+        from fedml_tpu.serving import save_model
+        from fedml_tpu.serving.autoscale import subprocess_replica_factory
+
+        args = SimpleNamespace(model="lr", dataset="digits")
+        bundle = create(args, 10)
+        params = bundle.init(jax.random.PRNGKey(0),
+                             np.zeros((2, 64), np.float32))
+        path = str(tmp_path / "model.fmtpu")
+        save_model(jax.device_get(params), path)
+        return subprocess_replica_factory(args, path, 10, str(tmp_path)), 64
+
+    def test_kill9_survival_and_gateway_continuity(self, tmp_path):
+        import os
+        import signal
+        import numpy as np
+
+        factory, n_feat = self._factory(tmp_path)
+        rs = ReplicaSet(replica_factory=factory, min_replicas=2,
+                        max_replicas=4)
+        try:
+            # replicas are distinct OS processes, not this one
+            pids = [r.pid for r in rs.replicas]
+            assert len(set(pids)) == 2 and os.getpid() not in pids
+            gw = Gateway(rs)
+            req = {"inputs": np.zeros((2, n_feat)).tolist()}
+            assert len(gw.predict(req)["classes"]) == 2
+
+            # SIGKILL one replica: the hardest crash a container would die of
+            os.kill(pids[0], signal.SIGKILL)
+            deadline = time.time() + 10
+            while time.time() < deadline and rs._probe(rs.replicas[0].port):
+                time.sleep(0.1)
+            replaced = rs.health_check()
+            assert replaced == 1
+            new_pids = [r.pid for r in rs.replicas]
+            assert pids[0] not in new_pids and len(rs) == 2
+            # gateway continuity: every post-kill request succeeds (round
+            # robin crosses both the survivor and the replacement)
+            for _ in range(4):
+                assert len(gw.predict(req)["classes"]) == 2
+        finally:
+            rs.stop()
+        # stop() reaps the children
+        for r in rs.replicas:
+            assert r.proc is None or r.proc.poll() is not None

@@ -44,6 +44,13 @@ def test_native_engine_device_session(tmp_path):
     assert result is not None
     assert len(result["history"]) == 3
     assert result["final_test_acc"] > 0.5, result["history"]
+    # the native device evaluated each round's GLOBAL model on-device and
+    # the server recorded the reported accuracy (MobileNN eval story)
+    accs = [r["device_eval_acc"] for r in result["history"]
+            if "device_eval_acc" in r]
+    assert len(accs) == 3, result["history"]
+    assert all(0.0 <= a <= 1.0 for a in accs)
+    assert accs[-1] > accs[0], accs  # global model improved across rounds
 
 
 class TestNativeCore:
@@ -352,3 +359,96 @@ class TestNativeLSAandReader:
         rx, ry = native.read_csv(str(path))
         np.testing.assert_allclose(rx, x, atol=1e-5)
         np.testing.assert_array_equal(ry, y)
+
+
+class TestNativeArtifactAndClientManager:
+    """Native serialized-model handling + the FedMLClientManager-analogue
+    session (VERDICT r3 item 10): the device consumes the server's global
+    model ARTIFACT and produces a server-loadable update with zero Python
+    codecs, and the C-ABI session (include/fedml_client.h) trains and
+    reports on-device accuracy."""
+
+    @staticmethod
+    def _digits_artifact(tmp_path):
+        import jax
+        from types import SimpleNamespace
+        from fedml_tpu.serving import save_model
+        from sklearn import datasets as skd
+
+        ds = skd.load_digits()
+        x = np.asarray(ds.data, np.float32) / 16.0
+        y = np.asarray(ds.target, np.int64)
+        bundle = model_mod.create(SimpleNamespace(model="lr"), 10)
+        params = bundle.init(jax.random.PRNGKey(0), x[:2])
+        path = str(tmp_path / "global.fmtpu")
+        save_model(jax.device_get(params), path)
+        return path, x, y, bundle, params
+
+    def test_artifact_roundtrip_native_vs_python(self, tmp_path):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from fedml_tpu.serving import load_model, save_model
+
+        path, *_ = self._digits_artifact(tmp_path)
+        # native reader sees the Python writer's bytes
+        leaves = native.load_artifact_native(path)
+        py = load_model(path)
+        assert set(leaves) == {"Dense_0/kernel", "Dense_0/bias"}
+        np.testing.assert_array_equal(leaves["Dense_0/kernel"],
+                                      np.asarray(py["Dense_0"]["kernel"]))
+        # native writer's bytes load with the Python reader, nested
+        out = str(tmp_path / "native.fmtpu")
+        native.save_artifact_native(leaves, out)
+        py2 = load_model(out)
+        np.testing.assert_array_equal(np.asarray(py2["Dense_0"]["bias"]),
+                                      leaves["Dense_0/bias"])
+
+    def test_client_manager_trains_and_reports_accuracy(self, tmp_path):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from fedml_tpu.serving import load_model
+
+        path, x, y, bundle, params = self._digits_artifact(tmp_path)
+        csv = str(tmp_path / "shard.csv")
+        with open(csv, "w") as f:
+            for xi, yi in zip(x[:800], y[:800]):
+                f.write(",".join(f"{v:.6f}" for v in xi) + f",{yi}\n")
+
+        losses, progress = [], []
+        with native.NativeClientManager() as cm:
+            cm.init(path, csv, batch_size=32, learning_rate=0.3, epochs=4,
+                    seed=7)
+            cm.set_callbacks(on_progress=progress.append,
+                             on_loss=lambda e, l: losses.append((e, l)))
+            acc0 = cm.evaluate()          # global model, on-device eval
+            final_loss = cm.train()
+            e, l = cm.get_epoch_and_loss()
+            acc1 = cm.evaluate()          # trained model, on-device eval
+            out = str(tmp_path / "update.fmtpu")
+            cm.save_model(out)
+
+        assert e == 3 and abs(l - final_loss) < 1e-6
+        assert len(losses) == 4 and progress[-1] == 100.0
+        assert losses[0][1] > losses[-1][1]      # loss went down
+        assert acc0 < 0.3 and acc1 > 0.8, (acc0, acc1)
+        # the trained artifact loads server-side with the Python codec and
+        # differs from the init params (a real update)
+        trained = load_model(out)
+        assert not np.allclose(np.asarray(trained["Dense_0"]["kernel"]),
+                               np.asarray(params["Dense_0"]["kernel"]))
+
+    def test_stop_training_interrupts(self, tmp_path):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        path, x, y, *_ = self._digits_artifact(tmp_path)
+        csv = str(tmp_path / "shard.csv")
+        with open(csv, "w") as f:
+            for xi, yi in zip(x[:200], y[:200]):
+                f.write(",".join(f"{v:.6f}" for v in xi) + f",{yi}\n")
+        with native.NativeClientManager() as cm:
+            cm.init(path, csv, epochs=50)
+            cm.set_callbacks(
+                on_loss=lambda e, l: cm.stop_training() if e == 1 else None)
+            cm.train()
+            e, _ = cm.get_epoch_and_loss()
+        assert e <= 2  # stopped long before epoch 50

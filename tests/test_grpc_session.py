@@ -1,0 +1,104 @@
+"""Full cross-silo FL sessions across OS processes over real gRPC sockets
+(VERDICT r3 item 4): server + 3 clients as separate interpreters, for both
+the plain FedAvg FSM and the SecAgg secure-aggregation runtime (reference
+``tests/cross-silo/run_cross_silo.sh:10-18``)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "grpc_session_worker.py")
+N_CLIENTS = 3
+
+
+def _free_port_block(n: int = 8) -> int:
+    """A base port whose +0..+n block is free (ranks listen on base+rank)."""
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        if base + n < 65535 and all(_port_free(base + i)
+                                    for i in range(1, n)):
+            return base
+
+
+def _port_free(port: int) -> bool:
+    with socket.socket() as s:
+        try:
+            s.bind(("127.0.0.1", port))
+            return True
+        except OSError:
+            return False
+
+
+def _wait_listening(port: int, timeout_s: float = 60.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"port {port} never came up")
+
+
+def _run_session(optimizer: str, tmp_path) -> dict:
+    base = _free_port_block()
+    out_path = str(tmp_path / f"result_{optimizer}.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(role, rank):
+        return subprocess.Popen(
+            [sys.executable, WORKER, role, str(rank), str(base),
+             optimizer, out_path], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    procs = [spawn("server", 0)]
+    try:
+        _wait_listening(base)  # server's gRPC listener before client sends
+        procs += [spawn("client", r) for r in range(1, N_CLIENTS + 1)]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail(f"gRPC {optimizer} session timed out")
+            outs.append(out.decode(errors="replace"))
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def test_grpc_multiprocess_fedavg_session(tmp_path):
+    res = _run_session("FedAvg", tmp_path)
+    assert res["error"] is None
+    assert res["rounds"] == 2
+    assert res["final_test_acc"] is not None and res["final_test_acc"] > 0.3
+
+
+def test_grpc_multiprocess_secagg_session(tmp_path):
+    """The SecAgg runtime's full per-round protocol (channel keys, fresh
+    round keys, sealed Shamir shares, masked models, unmask) across real
+    process boundaries and real sockets."""
+    res = _run_session("secagg", tmp_path)
+    assert res["error"] is None
+    assert res["rounds"] == 2
+    assert res["final_test_acc"] is not None and res["final_test_acc"] > 0.3
