@@ -86,16 +86,21 @@ def bench_flagship():
             _force(params_of())
         return (time.perf_counter() - t0) / iters
 
-    # --- mesh engine (ours): whole round = one jitted SPMD program
+    # --- mesh engine (ours): rounds run in fused blocks of 8 — ONE
+    # dispatch per block, exactly what engine.run() does in production
+    # (the per-round tunnel dispatch is ~120 ms, 4.4% of a round;
+    # BASELINE.md §3b)
     opt = create_optimizer(args, spec)
     tpu_sim = TPUSimulator(args, fed, bundle, opt, spec)
     r = [0]
+    BLOCK = 8
 
-    def tpu_round():
-        tpu_sim.run_round(r[0], hyper)
-        r[0] += 1
+    def tpu_block():
+        tpu_sim.run_rounds_fused(r[0], BLOCK, hyper)
+        r[0] += BLOCK
 
-    tpu_round_s = time_rounds(tpu_round, lambda: tpu_sim.params)
+    tpu_block_s = time_rounds(tpu_block, lambda: tpu_sim.params)
+    tpu_round_s = tpu_block_s / BLOCK
 
     # FLOPs of the real (non-padded) work per round, for MFU
     flops = tpu_sim.round_cost_flops(hyper)
@@ -122,8 +127,12 @@ def bench_flagship():
     def sp_round():
         sp_sim.run(comm_round=1)
 
+    # iters=4: the SP loop is 8 small dispatches/round through the tunnel
+    # and its latency varies session-to-session far more than the mesh
+    # engine's single dispatch; sp_round_s is disclosed in the JSON so
+    # vs_baseline is auditable against the raw legs
     sp_round_s = time_rounds(sp_round, lambda: sp_sim.params,
-                             warmup=1, iters=2)
+                             warmup=1, iters=4)
     tpu_samples = float(fed.total_train_samples)
     sp_samples = float(bfed.total_train_samples)
     rounds_per_hour = 3600.0 / tpu_round_s
@@ -134,11 +143,17 @@ def bench_flagship():
         "unit": f"rounds/hour (64 clients/round, 1 local epoch, bf16, "
                 f"{provenance} data)",
         "vs_baseline": round(vs_baseline, 3),
+        "sp_baseline_round_s": round(sp_round_s, 4),
+        "sp_baseline_samples": int(sp_samples),
         "step_time_s": round(tpu_round_s, 4),
         "tflops": round(achieved_tflops, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "n_devices": n_dev,
         "data_provenance": provenance,
+        # honesty note: the SP baseline deliberately runs a 1/8-size
+        # workload (per-sample normalized); disclose any train-set caps
+        "baseline_train_capped_to": getattr(bargs, "_train_capped_to",
+                                            None),
     }), flush=True)
 
 

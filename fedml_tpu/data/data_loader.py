@@ -76,9 +76,17 @@ def _synthetic_fallback(args, raw_name: str, name: str):
 
 def _cap_train(xtr, ytr, args, seed: int):
     """Deterministically subsample the training set when the caller bounds
-    total samples (quick runs, bench baselines)."""
+    total samples (quick runs, bench baselines). Never silent: the cap is
+    logged and recorded on the args namespace (``_train_capped_to``) so
+    benchmark output can disclose it."""
     cap = int(getattr(args, "max_total_samples", 0) or 0)
     if cap and len(xtr) > cap:
+        logger.warning("training set capped to %d of %d samples "
+                       "(max_total_samples)", cap, len(xtr))
+        try:
+            args._train_capped_to = cap
+        except Exception:
+            pass
         idx = np.random.RandomState(seed ^ 0x5EED).permutation(len(xtr))[:cap]
         return xtr[idx], ytr[idx]
     return xtr, ytr
@@ -132,6 +140,32 @@ def load(args) -> Tuple[FederatedDataset, int]:
                                max_clients=num_clients)
         if got is not None:
             return got
+
+    # image-directory datasets from a local cache (no egress):
+    # ImageNet-style folder trees and Landmarks CSV-mapped user partitions
+    if name in ("imagenet", "ilsvrc2012", "tiny_imagenet") \
+            and not raw_name.startswith("synthetic"):
+        from .images import load_image_folder
+        got = load_image_folder(os.path.join(cache_dir, name))
+        if got is not None:
+            (xtr, ytr), (xte, yte), n_classes = got
+            fed = from_central_arrays(xtr, ytr, xte, yte, num_clients, bs,
+                                      n_classes, partition_method=method,
+                                      partition_alpha=alpha, seed=seed)
+            fed.provenance = "real"
+            return fed, n_classes
+    if name in ("landmarks", "gld23k", "gld160k") \
+            and not raw_name.startswith("synthetic"):
+        from .containers import build_federated_dataset
+        from .images import load_landmarks
+        got = load_landmarks(os.path.join(cache_dir, name),
+                             max_clients=num_clients)
+        if got is not None:
+            cxs, cys, test_x, test_y, n_classes = got
+            fed = build_federated_dataset(cxs, cys, test_x, test_y, bs,
+                                          n_classes)
+            fed.provenance = "real"
+            return fed, n_classes
 
     # LEAF-format natural partitions take precedence when present on disk
     if name in ("femnist", "shakespeare", "fed_shakespeare", "celeba",

@@ -654,13 +654,20 @@ bool parse_ext_leaf(Cursor& c, size_t len, int8_t type, Leaf* leaf) {
   if (dtype == "<f4") {
     std::memcpy(leaf->data.data(), data, nbytes);
   } else if (dtype == "<f8") {
-    const double* src = reinterpret_cast<const double*>(data);
-    for (size_t i = 0; i < elems; ++i)
-      leaf->data[i] = static_cast<float>(src[i]);
+    // per-element memcpy: the payload sits at an arbitrary offset inside
+    // the msgpack blob, and a reinterpret_cast load of a misaligned
+    // double is UB (SIGBUS on strict-alignment device targets)
+    for (size_t i = 0; i < elems; ++i) {
+      double v;
+      std::memcpy(&v, data + i * 8, 8);
+      leaf->data[i] = static_cast<float>(v);
+    }
   } else {  // <i4
-    const int32_t* src = reinterpret_cast<const int32_t*>(data);
-    for (size_t i = 0; i < elems; ++i)
-      leaf->data[i] = static_cast<float>(src[i]);
+    for (size_t i = 0; i < elems; ++i) {
+      int32_t v;
+      std::memcpy(&v, data + i * 4, 4);
+      leaf->data[i] = static_cast<float>(v);
+    }
   }
   c.p = payload_end;
   return true;

@@ -29,7 +29,10 @@ def main() -> None:
     spec_path = sys.argv[1]
     with open(spec_path) as f:
         spec = json.load(f)
-    os.environ.setdefault("JAX_PLATFORMS", spec.get("platform", "cpu"))
+    # the SPEC decides the platform — an inherited JAX_PLATFORMS=tpu from
+    # the trainer process must not make every replica fight it for the
+    # chip (the whole point of platform='cpu' isolation)
+    os.environ["JAX_PLATFORMS"] = spec.get("platform", "cpu")
     import jax
     jax.config.update("jax_platforms",
                       os.environ["JAX_PLATFORMS"].split(",")[0])

@@ -168,30 +168,27 @@ class TPUSimulator:
         self.dp.load_state_dict(st["dp"])
 
     # ------------------------------------------------------------------
-    def _build_round_fn(self):
+    def _make_round_core(self):
+        """The per-shard FL-round program, on SQUEEZED local blocks (no
+        shard_map leading axis): shared by the single-round fn and the
+        fused multi-round fn (which scans it — any drift would silently
+        break their parity).
+
+        Schedule slots run SEQUENTIALLY per chip (lax.scan) with full
+        per-op batches. A client-lockstep vmap mode was built and measured
+        in rounds 3-4 (scripts/vmap_vs_scan.py): XLA lowers
+        per-client-weight batched convs to per-group execution with a
+        fixed ~10-25 us/group overhead, and the mode LOST to scan on every
+        shipped model — 16..64-channel ResNet-56 (r3) AND MXU-wide
+        ResNet-18 (r4: 0.70x at chunk 8, 0.68x at chunk 4) — so it was
+        deleted rather than kept as a footgun."""
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
-        # Schedule slots run SEQUENTIALLY per chip (lax.scan) with full
-        # per-op batches. A client-lockstep vmap mode was built and
-        # measured in rounds 3-4 (scripts/vmap_vs_scan.py): XLA lowers
-        # per-client-weight batched convs to per-group execution with a
-        # fixed ~10-25 us/group overhead, and the mode LOST to scan on
-        # every shipped model — 16..64-channel ResNet-56 (r3) AND
-        # MXU-wide ResNet-18 (r4: 0.70x at chunk 8, 0.68x at chunk 4) —
-        # so it was deleted rather than kept as a footgun.
 
-        def round_body(params, server_state, local_data, local_states,
-                       sched_idx, sched_active, round_key, hyper):
-            """Runs per shard. shard_map hands blocks with a leading axis of
-            size 1 for P(client)-sharded inputs — squeeze it, and restore it
-            on the sharded output."""
+        def core(params, server_state, local_data, local_states,
+                 sched_idx, sched_active, round_key, hyper):
             dev = jax.lax.axis_index(AXIS_CLIENT)
-            local_data = jax.tree_util.tree_map(lambda a: a[0], local_data)
-            local_states = jax.tree_util.tree_map(lambda a: a[0], local_states)
-            sched_idx = sched_idx[0]
-            sched_active = sched_active[0]
-
             zero_update = tree_zeros_like(params)
             zero_extras = opt.server_extras_zero(params)
             zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
@@ -233,7 +230,6 @@ class TPUSimulator:
                 new_params, new_server_state = opt.server_update(
                     params, server_state, agg_update, agg_extras,
                     hyper.round_idx)
-                states = jax.tree_util.tree_map(lambda a: a[None], states)
                 return new_params, new_server_state, states, metrics
 
             init = (local_states, zero_update, zero_extras,
@@ -263,11 +259,73 @@ class TPUSimulator:
                 slot, init, jnp.arange(sched_idx.shape[0]))
             return finish(states, acc_u, acc_ex, acc_w, acc_m)
 
+        return core
+
+    def _build_round_fn(self):
+        core = self._make_round_core()
+
+        def round_body(params, server_state, local_data, local_states,
+                       sched_idx, sched_active, round_key, hyper):
+            """Runs per shard. shard_map hands blocks with a leading axis of
+            size 1 for P(client)-sharded inputs — squeeze it, and restore it
+            on the sharded output."""
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            new_params, new_sstate, states, metrics = core(
+                params, server_state, sq(local_data), sq(local_states),
+                sched_idx[0], sched_active[0], round_key, hyper)
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            return new_params, new_sstate, states, metrics
+
         shard_fn = jax.shard_map(
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+            check_vma=False,
+        )
+        return jax.jit(shard_fn)
+
+    def _build_fused_fn(self):
+        """R rounds in ONE dispatch: an outer lax.scan over per-round
+        schedules/keys inside the same shard_map — eliminates the
+        per-round host dispatch (~120 ms through the tunneled chip, 4.4%
+        of the flagship round; see BASELINE.md §3b) and every host
+        round-trip between rounds. Non-robust mode only: the robust path
+        hands the raw update matrix to the host defense pipeline each
+        round by design."""
+        core = self._make_round_core()
+
+        def rounds_body(params, server_state, local_data, local_states,
+                        sched_idxs, sched_actives, round_keys, round_idxs,
+                        hyper):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            local_data = sq(local_data)
+            local_states = sq(local_states)
+            sched_idxs = sched_idxs[:, 0]      # [R, 1, S] block -> [R, S]
+            sched_actives = sched_actives[:, 0]
+
+            def one_round(carry, xs):
+                params, server_state, states = carry
+                idx_r, act_r, key_r, ridx_r = xs
+                hyper_r = hyper.replace(round_idx=ridx_r)
+                new_p, new_s, states, metrics = core(
+                    params, server_state, local_data, states,
+                    idx_r, act_r, key_r, hyper_r)
+                return (new_p, new_s, states), metrics
+
+            (params, server_state, states), metrics = jax.lax.scan(
+                one_round, (params, server_state, local_states),
+                (sched_idxs, sched_actives, round_keys, round_idxs))
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            return params, server_state, states, metrics  # metrics: [R]
+
+        shard_fn = jax.shard_map(
+            rounds_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(None, AXIS_CLIENT), P(None, AXIS_CLIENT), P(),
+                      P(), P()),
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
@@ -458,7 +516,20 @@ class TPUSimulator:
 
     def _assess_contribution(self, mat, w, sampled, round_idx):
         """Shapley/LOO over the flattened update matrix — the subset-value
-        function works in vector space and unflattens per evaluation."""
+        function works in vector space and unflattens per evaluation.
+
+        Size guard: Shapley evaluates O(2^K or MC-samples) candidate
+        models, each a host-materialized [D] vector; on an LLM-sized
+        update matrix that OOMs the host. Refuse loudly above 2 GiB
+        rather than dying mid-round."""
+        nbytes = int(mat.size) * mat.dtype.itemsize
+        if nbytes > (2 << 30):
+            logger.error(
+                "contribution assessment skipped: update matrix is %.1f "
+                "GiB (> 2 GiB host guard) — Shapley/LOO on a model this "
+                "size would OOM the host; use a smaller model or disable "
+                "contribution assessment", nbytes / 2**30)
+            return
         from ...core.collectives import tree_flatten_to_vector
         spec, fed, params = self.spec, self.fed, self.params
         pvec = tree_flatten_to_vector(params)
@@ -512,11 +583,7 @@ class TPUSimulator:
             return 0.0
 
     def run_round(self, round_idx: int, hyper: TrainHyper) -> Dict[str, float]:
-        sampled = client_sampling(round_idx, self.fed.num_clients,
-                                  int(self.args.client_num_per_round))
-        max_slots = min(self.cpd, int(self.args.client_num_per_round))
-        idx, active = build_schedule(sampled, self.n_devices, self.cpd,
-                                     max_slots=max_slots)
+        sampled, (idx, active) = self._schedule_for(round_idx)
         idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
         active = jax.device_put(jnp.asarray(active), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, round_idx)
@@ -541,6 +608,50 @@ class TPUSimulator:
         self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
         return metrics
 
+    def _schedule_for(self, round_idx: int):
+        sampled = client_sampling(round_idx, self.fed.num_clients,
+                                  int(self.args.client_num_per_round))
+        max_slots = min(self.cpd, int(self.args.client_num_per_round))
+        return sampled, build_schedule(sampled, self.n_devices, self.cpd,
+                                       max_slots=max_slots)
+
+    def run_rounds_fused(self, start_round: int, n_rounds: int,
+                         hyper: TrainHyper) -> List[Dict[str, float]]:
+        """Run ``n_rounds`` rounds as ONE device dispatch (schedules and
+        round keys precomputed host-side, stacked, scanned on-device).
+        Returns the per-round metrics list. Robust mode falls back to the
+        per-round path (its defense pipeline is host-side by design)."""
+        if self.robust_mode or n_rounds == 1:
+            return [self.run_round(start_round + i, hyper)
+                    for i in range(n_rounds)]
+        if not hasattr(self, "_fused_fn"):
+            self._fused_fn = self._build_fused_fn()
+        idxs, acts, keys, ridxs = [], [], [], []
+        part = 0.0
+        for r in range(start_round, start_round + n_rounds):
+            sampled, (idx, active) = self._schedule_for(r)
+            idxs.append(idx)
+            acts.append(active)
+            keys.append(jax.random.fold_in(self.rng, r))
+            ridxs.append(r)
+            part += len(sampled) / max(self.fed.num_clients, 1)
+        sched_sharding = NamedSharding(self.mesh, P(None, AXIS_CLIENT))
+        idxs = jax.device_put(jnp.stack([jnp.asarray(i) for i in idxs],
+                                        axis=0), sched_sharding)
+        acts = jax.device_put(jnp.stack([jnp.asarray(a) for a in acts],
+                                        axis=0), sched_sharding)
+        keys = jnp.stack(keys)
+        ridxs = jnp.asarray(ridxs, jnp.int32)
+        (self.params, self.server_state, self.client_states,
+         metrics) = self._fused_fn(
+            self.params, self.server_state, self.train_data,
+            self.client_states, idxs, acts, keys, ridxs,
+            hyper.replace(round_idx=jnp.int32(start_round)))
+        for _ in range(n_rounds):  # DP accounting stays per-round
+            self.dp.record_round(part / n_rounds)
+        host = jax.device_get(metrics)
+        return [{k: host[k][i] for k in host} for i in range(n_rounds)]
+
     def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
         args = self.args
         rounds = comm_round if comm_round is not None else int(args.comm_round)
@@ -554,25 +665,51 @@ class TPUSimulator:
             self._load_ckpt_state(st)
             start_round = step + 1
             logger.info("resumed from checkpoint at round %d", step)
-        for round_idx in range(start_round, rounds):
-            metrics = self.run_round(round_idx, hyper)
-            rec: Dict[str, Any] = {"round": round_idx}
-            cnt = max(float(metrics["count"]), 1.0)
-            rec["train_loss"] = float(metrics["loss_sum"]) / cnt
-            rec["train_acc"] = float(metrics["correct"]) / cnt
-            freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
-            if round_idx % freq == 0 or round_idx == rounds - 1:
-                stats = self._evaluate(self.params, self.fed.test["x"],
-                                       self.fed.test["y"], self.fed.test["mask"])
-                n = max(float(stats["count"]), 1.0)
-                rec["test_acc"] = float(stats["correct"]) / n
-                rec["test_loss"] = float(stats["loss_sum"]) / n
-                logger.info("round %d: test_acc=%.4f", round_idx, rec["test_acc"])
-            self.history.append(rec)
-            self.ckpt.maybe_save(round_idx, self._ckpt_state())
-            mlops.log_round_info(rounds, round_idx)
-            mlops.log({k: v for k, v in rec.items() if k != "round"},
-                      step=round_idx)
+        freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
+        # Rounds between eval/checkpoint boundaries run as ONE device
+        # dispatch (run_rounds_fused): the per-round dispatch constant is
+        # ~120 ms through the tunneled chip — 4.4% of a flagship round
+        # (BASELINE.md §3b). rounds_per_dispatch caps the fused block
+        # (compile time grows with the scan length; 8 amortizes dispatch
+        # to <1% while keeping compiles quick).
+        rpd = max(int(getattr(args, "rounds_per_dispatch", 8) or 1), 1)
+        round_idx = start_round
+        while round_idx < rounds:
+            # run up to (and including) the next eval/checkpoint boundary
+            next_eval = (round_idx if round_idx % freq == 0
+                         else (round_idx // freq + 1) * freq)
+            stop = min(next_eval, rounds - 1, round_idx + rpd - 1)
+            if self.ckpt.enabled:
+                # maybe_save fires when (r + 1) % every == 0 — the block
+                # must END on such a round or the checkpoint would be
+                # written from end-of-block params under an earlier label
+                # (wrong state on resume)
+                every = self.ckpt.every
+                nxt = ((round_idx + every) // every) * every - 1
+                stop = min(stop, nxt)
+            n_block = stop - round_idx + 1
+            block = self.run_rounds_fused(round_idx, n_block, hyper)
+            for i, metrics in enumerate(block):
+                r = round_idx + i
+                rec: Dict[str, Any] = {"round": r}
+                cnt = max(float(metrics["count"]), 1.0)
+                rec["train_loss"] = float(metrics["loss_sum"]) / cnt
+                rec["train_acc"] = float(metrics["correct"]) / cnt
+                if r % freq == 0 or r == rounds - 1:
+                    stats = self._evaluate(self.params, self.fed.test["x"],
+                                           self.fed.test["y"],
+                                           self.fed.test["mask"])
+                    n = max(float(stats["count"]), 1.0)
+                    rec["test_acc"] = float(stats["correct"]) / n
+                    rec["test_loss"] = float(stats["loss_sum"]) / n
+                    logger.info("round %d: test_acc=%.4f", r,
+                                rec["test_acc"])
+                self.history.append(rec)
+                self.ckpt.maybe_save(r, self._ckpt_state())
+                mlops.log_round_info(rounds, r)
+                mlops.log({k: v for k, v in rec.items() if k != "round"},
+                          step=r)
+            round_idx = stop + 1
         wall = time.time() - t0
         last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
                          None)

@@ -53,3 +53,27 @@ def test_stateful_optimizer_checkpoint(tmp_path):
                     jax.tree_util.tree_leaves(r_res["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_resume_parity_through_fused_blocks(tmp_path):
+    """With a large eval interval the TPU engine runs multi-round FUSED
+    dispatch blocks; checkpoint rounds must end a block so the saved state
+    matches its round label (a mid-block save would store end-of-block
+    params under an earlier round and corrupt the resumed trajectory)."""
+    kw = dict(frequency_of_the_test=100, checkpoint_every_rounds=3,
+              comm_round=8)
+    full_dir = tmp_path / "full"
+    part_dir = tmp_path / "part"
+    r_full = fedml_tpu.run_simulation(backend="tpu",
+                                      args=make_args(full_dir, **kw))
+    # interrupted after 4 rounds: the round-2 checkpoint is the restore
+    # point, taken at a fused-block boundary
+    fedml_tpu.run_simulation(backend="tpu",
+                             args=make_args(part_dir, **{**kw,
+                                                         "comm_round": 4}))
+    r_resumed = fedml_tpu.run_simulation(backend="tpu",
+                                         args=make_args(part_dir, **kw))
+    for a, b in zip(jax.tree_util.tree_leaves(r_full["params"]),
+                    jax.tree_util.tree_leaves(r_resumed["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
