@@ -38,6 +38,13 @@ def _load_image(path: str, image_size: int) -> np.ndarray:
         return np.asarray(im, np.float32) / 255.0
 
 
+# in-memory budget for eagerly-decoded image datasets: above this, refuse
+# loudly instead of silently OOMing the host mid-decode. Full ILSVRC2012
+# at 64px float32 would need ~63 GB; that scale needs a streaming/HDF5
+# pipeline, not this eager loader.
+MAX_EAGER_BYTES = 8 << 30
+
+
 def _folder_split(root: str, image_size: int,
                   class_to_id: Optional[Dict[str, int]] = None):
     """One ImageFolder split: class subdirs -> (x, y, class_to_id)."""
@@ -45,19 +52,27 @@ def _folder_split(root: str, image_size: int,
                      if os.path.isdir(os.path.join(root, d)))
     if class_to_id is None:
         class_to_id = {c: i for i, c in enumerate(classes)}
-    xs, ys = [], []
+    paths, ys = [], []
     for c in classes:
         cid = class_to_id.get(c)
         if cid is None:
             continue
         cdir = os.path.join(root, c)
         for fname in sorted(os.listdir(cdir)):
-            if not fname.lower().endswith(_IMG_EXTS):
-                continue
-            xs.append(_load_image(os.path.join(cdir, fname), image_size))
-            ys.append(cid)
-    if not xs:
+            if fname.lower().endswith(_IMG_EXTS):
+                paths.append(os.path.join(cdir, fname))
+                ys.append(cid)
+    if not paths:
         raise FileNotFoundError(f"no images under {root}")
+    need = len(paths) * image_size * image_size * 3 * 4
+    if need > MAX_EAGER_BYTES:
+        raise MemoryError(
+            f"{root}: {len(paths)} images at {image_size}px need "
+            f"~{need / 2**30:.0f} GiB decoded — beyond the eager loader's "
+            f"{MAX_EAGER_BYTES >> 30} GiB budget. Use a class/sample "
+            "subset of the tree, a smaller image_size, or a streaming "
+            "pipeline for full-scale ImageNet.")
+    xs = [_load_image(p, image_size) for p in paths]
     return np.stack(xs), np.asarray(ys, np.int64), class_to_id
 
 
@@ -152,18 +167,33 @@ def load_landmarks(data_dir: str, image_size: int = 64,
         if os.path.exists(p):
             test_csv = p
             break
+    xs, ys = [], []
     if test_csv is not None:
-        xs, ys = [], []
         with open(test_csv) as f:
             for row in csv.DictReader(f):
                 p = _find_image(images_dir, row["image_id"])
                 if p is not None and row["class"] in class_id:
                     xs.append(_load_image(p, image_size))
                     ys.append(class_id[row["class"]])
+        if not xs:
+            logger.warning(
+                "landmarks: %s matched no usable rows (missing images or "
+                "classes outside the train mapping) — falling back to "
+                "held-out per-client test samples", test_csv)
+    if xs:
         test_x, test_y = np.stack(xs), np.asarray(ys, np.int64)
-    else:  # no test mapping: hold out one sample per client
-        test_x = np.stack([cx[-1] for cx in client_xs])
-        test_y = np.asarray([cy[-1] for cy in client_ys], np.int64)
+    else:
+        # no test mapping: hold out ONE sample per multi-image client.
+        # Single-image clients contribute nothing — duplicating their only
+        # sample into both splits would evaluate on training data.
+        test_x = np.stack([cx[-1] for cx in client_xs if len(cx) > 1])
+        test_y = np.asarray([cy[-1] for cx, cy in
+                             zip(client_xs, client_ys) if len(cx) > 1],
+                            np.int64)
+        if len(test_x) == 0:
+            raise ValueError(
+                f"{data_dir}: cannot build a test split — no test csv and "
+                "every user has a single image")
         client_xs = [cx[:-1] if len(cx) > 1 else cx for cx in client_xs]
         client_ys = [cy[:-1] if len(cy) > 1 else cy for cy in client_ys]
     logger.info("landmarks %s: %d users, %d classes", data_dir,

@@ -88,4 +88,6 @@ def load_leaf_dataset(
         cxs = [x[:int(len(x) * 0.9)] for x in cxs]
         cys = [y[:int(len(y) * 0.9)] for y in cys]
     return build_federated_dataset(cxs, cys, tx, ty, batch_size, num_classes,
+                                   dtype=(np.int32 if task == "sequence"
+                                          else np.float32),
                                    task=task)

@@ -117,6 +117,43 @@ class TestRealDataPolicy:
         with pytest.raises(FileNotFoundError):
             data_mod.load(args)
 
+    def test_offline_archive_import(self, tmp_path, monkeypatch):
+        """A raw cifar-10-python.tar.gz dropped in $FEDML_TPU_OFFLINE_DIR
+        is parsed with NO network and flips the dataset to real — the
+        airgapped path that makes the flagship bench real-data when the
+        operator provides the archive (VERDICT r3 item 2c)."""
+        import io
+        import pickle
+        import tarfile
+
+        rng = np.random.RandomState(0)
+        offline = tmp_path / "offline"
+        offline.mkdir()
+
+        def batch(n):
+            return {b"data": rng.randint(0, 256, (n, 3072), np.uint8),
+                    b"labels": rng.randint(0, 10, n).tolist()}
+
+        tar_path = offline / "cifar-10-python.tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, n in (("data_batch_1", 64), ("data_batch_2", 64),
+                            ("test_batch", 32)):
+                blob = pickle.dumps(batch(n))
+                info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+
+        monkeypatch.setenv("FEDML_TPU_OFFLINE_DIR", str(offline))
+        cache = tmp_path / "cache"
+        args = Arguments(dataset="cifar10", model="simple_cnn",
+                         client_num_in_total=4, client_num_per_round=4,
+                         batch_size=8, data_cache_dir=str(cache))
+        fed, out = data_mod.load(args)
+        assert out == 10 and fed.provenance == "real"
+        assert (cache / "cifar10.npz").exists()
+        x = np.asarray(fed.train.x)
+        assert x.shape[-3:] == (32, 32, 3)
+
     def test_synthetic_optin_is_labeled(self, tmp_path):
         args = Arguments(dataset="cifar10", data_cache_dir=str(tmp_path),
                          allow_synthetic=True, model="simple_cnn")
@@ -210,6 +247,38 @@ class TestTFFFormats:
         args = self._args("stackoverflow_lr", "lr", 4)
         r = fedml_tpu.run_simulation(backend="sp", args=args)
         assert "final_test_acc" in r
+
+
+class TestLeafReddit:
+    def test_reddit_leaf_cache_loads_real(self, tmp_path):
+        """A LEAF-format reddit cache (users/user_data json — the layout
+        the reference's LEAF-derived loaders read) loads through the
+        standard dispatch as a REAL sequence dataset with the natural
+        per-user partition (reference data/reddit/data_loader.py:1-141;
+        that loader's albert tokenizer needs a model download, so the
+        LEAF text route is the zero-egress path here)."""
+        root = tmp_path / "reddit" / "train"
+        root.mkdir(parents=True)
+        blob = {"users": [], "num_samples": [], "user_data": {}}
+        for u in range(3):
+            name = f"redditor_{u}"
+            posts = [f"post {i} from user {u} about jax" for i in range(6)]
+            nxt = [p[1:] + "x" for p in posts]  # next-char style labels
+            blob["users"].append(name)
+            blob["num_samples"].append(len(posts))
+            blob["user_data"][name] = {"x": posts, "y": nxt}
+        with open(root / "data.json", "w") as f:
+            json.dump(blob, f)
+        args = Arguments(dataset="reddit", model="rnn",
+                         client_num_in_total=3, client_num_per_round=3,
+                         comm_round=1, epochs=1, batch_size=4,
+                         learning_rate=0.1, random_seed=0,
+                         data_cache_dir=str(tmp_path))
+        fed, out = data_mod.load(args)
+        assert fed.num_clients == 3
+        assert getattr(fed, "provenance", "real") == "real"
+        x = np.asarray(fed.train.x)
+        assert x.dtype == np.int32 and x.ndim == 4  # [c, nb, bs, L] tokens
 
 
 class TestImageDirectoryLoaders:
