@@ -1,0 +1,72 @@
+"""Worker for the multi-process LLM FSDP/TP test: one HOST of a
+two-process slice. The global mesh is {fsdp: 4, tensor: 2} over 8 devices
+spanning both processes — the exact sharded train step a multi-host TPU
+pod runs for FedLLM fine-tuning. Rank 0 writes the post-step loss and a
+param checksum for the pytest process to compare against the
+single-process run."""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    out_path = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fedml_tpu.cross_silo.hierarchical.process_group import (
+        init_silo_process_group)
+    assert init_silo_process_group()
+    assert len(jax.devices()) == 8
+
+    loss, checksum = _llm_fsdp_step()
+
+    if jax.process_index() == 0:
+        with open(out_path, "w") as f:
+            json.dump({"loss": loss, "checksum": checksum,
+                       "n_processes": jax.process_count()}, f)
+    jax.distributed.shutdown()
+
+
+def _llm_fsdp_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from fedml_tpu.core.mesh import build_mesh
+    from fedml_tpu.llm import CausalLMTrainer, LLMConfig, init_llm
+    from fedml_tpu.llm.sharding import (llm_param_specs,
+                                        make_sharded_train_step,
+                                        shard_llm_params)
+
+    mesh = build_mesh({"data": 1, "fsdp": 4, "tensor": 2},
+                      devices=jax.devices())
+    cfg = LLMConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_layers=2, num_heads=4, max_seq_len=16,
+                    tie_embeddings=False)
+    model, params = init_llm(cfg, jax.random.PRNGKey(0))
+    spec = CausalLMTrainer(
+        lambda p, x, rng=None, train=False: model.apply({"params": p}, x))
+    x = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 4, 64)
+    batch = {"x": x, "y": x, "mask": jnp.ones(8)}
+    opt = optax.sgd(0.1)
+    specs = llm_param_specs(params, mesh)
+    with mesh:
+        sharded = shard_llm_params(params, mesh)
+        step = make_sharded_train_step(
+            lambda p, b, r: spec.loss(p, b, r), opt, mesh, specs)
+        new_params, _, loss = step(sharded, opt.init(sharded), batch,
+                                   jax.random.PRNGKey(0))
+    # checksum over the (replicable) gathered params: sum of abs sums
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(new_params):
+        total += float(jnp.abs(leaf.astype(jnp.float32)).sum())
+    return float(loss), total
+
+
+if __name__ == "__main__":
+    main()

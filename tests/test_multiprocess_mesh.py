@@ -67,6 +67,48 @@ def _golden():
     return ws, flat
 
 
+def test_two_process_llm_fsdp_step_matches_single_process(tmp_path):
+    """The FedLLM sharded train step (fsdp=4 x tensor=2 mesh) executes
+    across TWO OS processes — the multi-host pod program — and matches the
+    single-process 8-device result exactly."""
+    LLM_WORKER = os.path.join(REPO, "tests", "multiproc_llm_worker.py")
+    port = _free_port()
+    out_path = str(tmp_path / "llm.json")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port),
+            "WORLD_SIZE": "2", "RANK": str(rank),
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, LLM_WORKER, out_path], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process LLM step timed out")
+        outs.append(out.decode(errors="replace"))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    with open(out_path) as f:
+        got = json.load(f)
+    assert got["n_processes"] == 2
+
+    # single-process golden on this process's own 8 CPU devices
+    from tests.multiproc_llm_worker import _llm_fsdp_step
+    loss, checksum = _llm_fsdp_step()
+    assert abs(got["loss"] - loss) < 1e-5
+    assert abs(got["checksum"] - checksum) / max(checksum, 1.0) < 1e-5
+
+
 def test_two_process_mesh_round_matches_single_process(tmp_path):
     port = _free_port()
     out_path = str(tmp_path / "result.json")
