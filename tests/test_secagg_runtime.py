@@ -211,6 +211,43 @@ def test_secagg_dropout_after_shares_reconstructs_masks():
                                    rtol=1e-3, atol=1e-3)
 
 
+def test_secagg_straggler_rejoins_next_round():
+    """A client that misses round 0's key-advertisement deadline is left
+    out of that round's cohort — and REJOINS round 1 with fresh keys (the
+    per-round protocol makes round membership elastic, not a session
+    death sentence)."""
+    from fedml_tpu.cross_silo.secagg import (SecAggClientManager,
+                                             run_secagg_inproc)
+
+    SLOW_RANK = 3  # client idx 2
+    rejoined_rounds = []
+
+    class SlowFirstRound(SecAggClientManager):
+        def on_train(self, msg):
+            if int(msg.get("round", 0)) == 0:
+                return  # missed the round-0 deadline entirely
+            rejoined_rounds.append(int(msg.get("round")))
+            super().on_train(msg)
+
+    args = make_args(comm_round=2, round_timeout_s=10.0)
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+
+    def factory(rank, a, trainer):
+        cls = SlowFirstRound if rank == SLOW_RANK else SecAggClientManager
+        return cls(a, trainer, rank=rank, size=5, backend="INPROC")
+
+    result = run_secagg_inproc(args, fed, bundle, client_factory=factory)
+    assert result is not None and "error" not in result, result
+    assert len(result["history"]) == 2
+    # the straggler actually participated in round 1 (got the TRAIN
+    # message and ran the full key/share/mask path), not merely "the
+    # survivors finished without it"
+    assert rejoined_rounds == [1], rejoined_rounds
+    # both rounds aggregated and the model learned
+    assert result["final_test_acc"] > 0.4, result["history"]
+
+
 def test_server_relays_only_ciphertext():
     """What the server sees of the routed shares must be AEAD ciphertext it
     cannot open: no plaintext share bytes, and decryption without the
