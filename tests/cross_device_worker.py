@@ -1,0 +1,53 @@
+"""Worker for test_cross_device_multiprocess: one role (server or device)
+of a cross-device (Beehive) FL session over real gRPC sockets, driven
+through the public ``CrossDeviceRunner``. Devices can run the NATIVE C++
+engine — a separate OS process running native local training against a
+Python server is exactly the reference's MobileNN deployment shape.
+
+Usage: cross_device_worker.py <role> <rank> <base_port> <cache_dir>
+                              <engine> <out>
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    role, rank, base_port, cache_dir, engine, out_path = sys.argv[1:7]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.cross_device.runner import CrossDeviceRunner
+
+    args = Arguments(
+        dataset="digits", model="lr", client_num_in_total=2,
+        client_num_per_round=2, comm_round=2, epochs=1, batch_size=32,
+        learning_rate=0.2, random_seed=3, training_type="cross_device",
+        backend="GRPC", grpc_base_port=int(base_port), role=role,
+        rank=int(rank), model_file_cache_dir=cache_dir,
+        round_timeout_s=30.0,
+        device_engine=(engine if engine != "-" else "jax"))
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    runner = CrossDeviceRunner(args, fed, bundle)
+    result = runner.run()
+
+    if role == "server":
+        hist = (result or {}).get("history") or []
+        engines = {str(did): d.get("engine") for did, d in
+                   getattr(runner.manager, "devices_online", {}).items()}
+        with open(out_path, "w") as f:
+            json.dump({"rounds": len(hist),
+                       "final_test_acc": (result or {}).get(
+                           "final_test_acc"),
+                       "engines": engines,
+                       "device_eval_accs": [r.get("device_eval_acc")
+                                            for r in hist]}, f)
+
+
+if __name__ == "__main__":
+    main()
