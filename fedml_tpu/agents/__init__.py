@@ -56,19 +56,34 @@ TOPIC_STATUS = "fl_client/mlops/status"
 TOPIC_ONLINE = "fl_client/agent/online"
 
 
+# Signed commands are only honored within this window of their signing
+# time, and a MAC is single-use within it — together these close the
+# replay a passive broker observer could otherwise mount (capturing a
+# signed stop_train and firing it later at a re-used request id).
+JOB_MAC_TTL_S = 300.0
+
+# check_job reason for an exact re-delivery of an already-honored frame —
+# callers treat this one specially: the message center's at-least-once
+# sender can legitimately produce byte-identical resends (same MAC), which
+# must be re-announced, never reported as a failure of the live request
+REASON_REPLAY = "replayed command (MAC already seen)"
+
+
 def agent_secret() -> Optional[bytes]:
     """Shared bind token for job dispatch (``FEDML_TPU_AGENT_SECRET``).
     Independent of the broker secret: even a peer that can reach the
-    broker cannot start jobs without it. None = open (local-first
-    default). Reference counterpart: device binding through the account
-    manager (``scheduler_core/account_manager.py:1-469``)."""
+    broker cannot start jobs without it. None = no token configured —
+    daemons REFUSE to start that way unless told ``insecure_open``.
+    Reference counterpart: device binding through the account manager
+    (``scheduler_core/account_manager.py:1-469``)."""
     s = os.environ.get("FEDML_TPU_AGENT_SECRET", "")
     return s.encode() if s else None
 
 
 def _job_mac(secret: bytes, payload: dict) -> str:
     """HMAC over the canonical job command (everything except the mac
-    itself), binding request id, target and yaml content."""
+    itself), binding request id, target, yaml content, and the signing
+    timestamp + nonce added by :func:`sign_job`."""
     import hashlib
     import hmac as _hmac
     body = json.dumps({k: v for k, v in sorted(payload.items())
@@ -81,18 +96,56 @@ def sign_job(payload: dict, secret: Optional[bytes] = None) -> dict:
     secret = secret if secret is not None else agent_secret()
     if secret is not None:
         payload = dict(payload)
+        payload["ts"] = time.time()
+        payload["nonce"] = uuid.uuid4().hex
         payload["auth"] = _job_mac(secret, payload)
     return payload
 
 
-def verify_job(payload: dict, secret: Optional[bytes] = None) -> bool:
+def check_job(payload: dict, secret: Optional[bytes] = None,
+              seen_macs: Optional[Dict[str, float]] = None) -> Optional[str]:
+    """None iff the command carries a valid, fresh, never-before-seen
+    MAC; otherwise a human-readable refusal reason. A bad token and a
+    stale timestamp are DIFFERENT operational failures (rotate secrets
+    vs fix NTP) and are reported distinctly.
+
+    ``secret=None`` (and no env token) accepts everything — callers own
+    that decision; the daemons only reach it through an explicit
+    ``insecure_open``. ``seen_macs`` is the caller's replay ledger
+    (mac -> first-seen time). Only a freshness window of entries ever
+    needs keeping (older frames fail the ts check on their own), so
+    pruning drops entries older than the TTL and, under a flood, evicts
+    oldest-first down to the cap instead of scanning forever.
+    """
     import hmac as _hmac
     secret = secret if secret is not None else agent_secret()
     if secret is None:
-        return True  # open deployment
+        return None  # explicit insecure-open deployment
     mac = payload.get("auth")
-    return bool(mac) and _hmac.compare_digest(
-        str(mac), _job_mac(secret, payload))
+    if not mac or not _hmac.compare_digest(str(mac),
+                                           _job_mac(secret, payload)):
+        return "bad or missing bind token"
+    ts = payload.get("ts")
+    now = time.time()
+    if not isinstance(ts, (int, float)) or abs(now - ts) > JOB_MAC_TTL_S:
+        return ("stale or clock-skewed command timestamp (>%.0fs; fix "
+                "NTP or re-dispatch)" % JOB_MAC_TTL_S)
+    if seen_macs is not None:
+        if mac in seen_macs:
+            return REASON_REPLAY
+        seen_macs[str(mac)] = now
+        if len(seen_macs) > 4096:
+            for m, t in list(seen_macs.items()):
+                if now - t > JOB_MAC_TTL_S:
+                    del seen_macs[m]
+            while len(seen_macs) > 4096:  # flood of still-fresh MACs
+                seen_macs.pop(min(seen_macs, key=seen_macs.get))
+    return None
+
+
+def verify_job(payload: dict, secret: Optional[bytes] = None,
+               seen_macs: Optional[Dict[str, float]] = None) -> bool:
+    return check_job(payload, secret, seen_macs) is None
 
 
 def _topic_start(device_id: int) -> str:
@@ -285,10 +338,27 @@ class SlaveAgent:
     broker fires its last-will) on abnormal disconnect."""
 
     def __init__(self, device_id: int, broker_host: str, broker_port: int,
-                 poll_s: float = 0.5):
+                 poll_s: float = 0.5, secret: Optional[bytes] = None,
+                 insecure_open: bool = False):
         self.device_id = int(device_id)
         self.poll_s = poll_s
+        # secure by default: a daemon that executes arbitrary shell jobs
+        # must not come up accepting ANY start_train published to its
+        # topic — open deployment is an explicit flag, never a default
+        self._secret = secret if secret is not None else agent_secret()
+        if self._secret is None and not insecure_open:
+            raise RuntimeError(
+                "SlaveAgent: refusing to start without a bind token. Set "
+                "FEDML_TPU_AGENT_SECRET (or pass secret=) so job dispatch "
+                "is authenticated, or pass insecure_open=True to "
+                "explicitly accept unauthenticated commands.")
         from ..api import _runs_root
+        # the replay ledger persists across daemon restarts: an in-memory
+        # ledger alone would re-accept a captured frame replayed inside
+        # the freshness window right after a crash/relaunch
+        self._ledger_path = os.path.join(
+            _runs_root(), f"agent_{device_id}", "seen-macs.log")
+        self._seen_macs: Dict[str, float] = self._load_ledger()
         self.center = MessageCenter(
             broker_host, broker_port,
             record_dir=os.path.join(_runs_root(), f"agent_{device_id}"),
@@ -299,6 +369,52 @@ class SlaveAgent:
         self.runs: Dict[str, str] = {}
         self._seen_requests = set()
         self._watchers: Dict[str, threading.Thread] = {}
+
+    # --- replay ledger persistence -----------------------------------------
+    def _load_ledger(self) -> Dict[str, float]:
+        seen: Dict[str, float] = {}
+        now = time.time()
+        try:
+            with open(self._ledger_path) as f:
+                for line in f:
+                    try:
+                        mac, ts = line.split()
+                        if now - float(ts) <= 2 * JOB_MAC_TTL_S:
+                            seen[mac] = float(ts)
+                    except ValueError:
+                        continue
+        except OSError:
+            return seen
+        # compact: the file is append-only while running, so rewrite it at
+        # load with only the surviving (freshness-window) entries — a
+        # long-lived daemon must not accrete an unbounded ledger
+        try:
+            tmp = self._ledger_path + ".tmp"
+            with open(tmp, "w") as f:
+                for mac, ts in seen.items():
+                    f.write(f"{mac} {ts}\n")
+            os.replace(tmp, self._ledger_path)
+        except OSError:
+            pass
+        return seen
+
+    def _remember_mac(self, payload: dict) -> None:
+        mac = payload.get("auth")
+        if not mac:
+            return
+        try:
+            os.makedirs(os.path.dirname(self._ledger_path), exist_ok=True)
+            with open(self._ledger_path, "a") as f:
+                f.write(f"{mac} {self._seen_macs.get(str(mac), time.time())}\n")
+        except OSError:
+            pass
+
+    def _check(self, payload: dict) -> Optional[str]:
+        reason = check_job(payload, self._secret,
+                           seen_macs=self._seen_macs)
+        if reason is None:
+            self._remember_mac(payload)
+        return reason
 
     def start(self) -> None:
         c = self.center
@@ -319,14 +435,28 @@ class SlaveAgent:
     def _on_start(self, payload: dict) -> None:
         from .. import api
         request_id = str(payload.get("request_id") or uuid.uuid4().hex)
-        if not verify_job(payload):
+        reason = self._check(payload)
+        if reason is not None:
+            if reason == REASON_REPLAY:
+                # byte-identical redelivery (at-least-once sender retry, or
+                # an actual replay): re-announce a request we already honor,
+                # drop anything else — publishing FAILED here would let a
+                # replayed frame poison the live job's status on the master
+                if request_id in self._seen_requests:
+                    self._status(request_id, JOB_RUNNING,
+                                 run_id=self.runs.get(request_id))
+                else:
+                    logger.error("agent %s: dropping replayed start_train "
+                                 "%s for unknown request", self.device_id,
+                                 request_id)
+                return
             # refuse unauthenticated job dispatch outright — and say so on
             # the status topic so the (possibly legitimate, misconfigured)
             # sender is not left waiting at PROVISIONING
-            logger.error("agent %s: REFUSING start_train %s — bad or "
-                         "missing bind token", self.device_id, request_id)
+            logger.error("agent %s: REFUSING start_train %s — %s",
+                         self.device_id, request_id, reason)
             self._status(request_id, JOB_FAILED,
-                         error="start_train refused: bad bind token")
+                         error=f"start_train refused: {reason}")
             return
         # idempotency: the master re-publishes start_train until it sees a
         # status (the broker has no retained messages, so a command sent
@@ -387,9 +517,10 @@ class SlaveAgent:
     def _on_stop(self, payload: dict) -> None:
         from .. import api
         request_id = str(payload.get("request_id", ""))
-        if not verify_job(payload):
-            logger.error("agent %s: REFUSING stop_train %s — bad or "
-                         "missing bind token", self.device_id, request_id)
+        reason = self._check(payload)
+        if reason is not None:
+            logger.error("agent %s: REFUSING stop_train %s — %s",
+                         self.device_id, request_id, reason)
             return
         run_id = self.runs.get(request_id)
         if run_id is None:

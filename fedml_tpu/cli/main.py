@@ -70,19 +70,69 @@ def launch(yaml_file, blocking, remote, device_id):
                            "status back (reference slave agent)")
 @click.option("--broker", required=True, metavar="HOST:PORT")
 @click.option("--device-id", type=int, required=True)
-def agent(broker, device_id):
+@click.option("--insecure-open", is_flag=True, default=False,
+              help="accept UNAUTHENTICATED job dispatch (no bind token); "
+                   "without this flag the daemon refuses to start unless "
+                   "FEDML_TPU_AGENT_SECRET is set")
+def agent(broker, device_id, insecure_open):
     import signal
     import threading
     from ..agents import SlaveAgent
     host, port = _parse_hostport(broker, "--broker")
-    daemon = SlaveAgent(device_id, host, port)
+    try:
+        daemon = SlaveAgent(device_id, host, port,
+                            insecure_open=insecure_open)
+    except RuntimeError as e:
+        click.echo(str(e), err=True)
+        sys.exit(2)
     daemon.start()
-    click.echo(f"agent {device_id} bound to {broker}")
+    # banner reflects the EFFECTIVE mode: with a secret in the env the
+    # daemon authenticates even if --insecure-open was passed
+    click.echo(f"agent {device_id} bound to {broker}"
+               + (" [INSECURE-OPEN]" if daemon._secret is None else ""))
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     daemon.stop()
+
+
+@cli.command("broker", help="Run a standalone pub/sub broker (the MQTT "
+                            "analogue agents and launch --remote bind to)")
+@click.option("--port", type=int, default=0, help="0 = pick a free port")
+@click.option("--insecure-open", is_flag=True, default=False,
+              help="skip connection authentication; without this flag a "
+                   "secret is taken from FEDML_TPU_BROKER_SECRET or "
+                   "GENERATED and printed once at startup")
+def broker_cmd(port, insecure_open):
+    import secrets as _secrets
+    import signal
+    import threading
+    from ..core.distributed.communication.pubsub import (PubSubBroker,
+                                                         broker_secret)
+    import os as _os
+    if insecure_open:
+        # PubSubBroker(secret=None) falls back to the env secret, which
+        # would silently re-arm auth under an "[INSECURE-OPEN]" banner —
+        # drop it from this process so the flag means what it says
+        _os.environ.pop("FEDML_TPU_BROKER_SECRET", None)
+        secret = None
+    else:
+        secret = broker_secret()
+        if secret is None:
+            token = _secrets.token_hex(16)
+            secret = token.encode()
+            click.echo("no FEDML_TPU_BROKER_SECRET configured — generated "
+                       f"one for this broker:\n  {token}\nexport it as "
+                       "FEDML_TPU_BROKER_SECRET on every peer.")
+    b = PubSubBroker(port=port, secret=secret)
+    click.echo(f"broker listening on :{b.port}"
+               + (" [INSECURE-OPEN]" if b.secret is None else ""))
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    b.stop()
 
 
 @cli.group("run", help="Inspect and control runs")

@@ -47,9 +47,13 @@ class LogShipper:
         self.retries = int(retries)
         self.timeout_s = float(timeout_s)
         self._seq = 0
-        self._offset = 0
+        self._offset = 0  # BYTE offset (file is read in binary mode: a
+        # text-mode tell() is an opaque cookie that need not equal byte
+        # counts on non-UTF-8 logs, which would desync the st_size
+        # truncation check)
         self._inode: Optional[int] = None
-        self._buf = ""   # partial trailing line across reads
+        self._buf = b""  # partial trailing line across reads (bytes, so
+        # a multi-byte char split across reads survives intact)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.shipped_lines = 0
@@ -67,18 +71,20 @@ class LogShipper:
             # rotated or truncated: start over on the new file
             logger.info("log shipper: %s rotated, re-tailing", self.path)
             self._offset = 0
-            self._buf = ""
+            self._buf = b""
         self._inode = st.st_ino
         if st.st_size <= self._offset:
             return []
-        with open(self.path, "r", errors="replace") as f:
+        with open(self.path, "rb") as f:
             f.seek(self._offset)
-            chunk = f.read()
+            raw = f.read()
             self._offset = f.tell()
-        text = self._buf + chunk
-        lines = text.split("\n")
-        self._buf = lines.pop()  # incomplete tail (or "")
-        return [ln for ln in lines if ln.strip()]
+        data = self._buf + raw
+        chunks = data.split(b"\n")
+        self._buf = chunks.pop()  # incomplete tail (or b"")
+        return [ln for ln in
+                (c.decode("utf-8", errors="replace") for c in chunks)
+                if ln.strip()]
 
     # -- upload -------------------------------------------------------------
 
@@ -141,9 +147,10 @@ class LogShipper:
         crashed job's log usually ends mid-line and that last partial
         traceback line is the most diagnostic one."""
         self.pump_once()
-        if self._buf.strip():
-            if self._post([self._buf]):
-                self._buf = ""
+        tail = self._buf.decode("utf-8", errors="replace")
+        if tail.strip():
+            if self._post([tail]):
+                self._buf = b""
 
     def stop(self, flush: bool = True, timeout_s: float = 10.0) -> None:
         self._stop.set()

@@ -219,16 +219,21 @@ def import_archive(name: str, path: str,
     return out
 
 
-def _find_local_archive(name: str, cache_dir: str) -> Optional[str]:
-    """Look for a user-provided raw archive in the offline drop dirs:
-    ``$FEDML_TPU_OFFLINE_DIR`` (if set) and the cache dir itself."""
-    dirs = [d for d in (os.environ.get("FEDML_TPU_OFFLINE_DIR"), cache_dir)
-            if d]
-    for d in dirs:
-        for fname in _ARCHIVE_NAMES.get(name, ()):
-            p = os.path.join(os.path.expanduser(d), fname)
-            if os.path.exists(p):
-                return p
+def _find_local_archive(name: str) -> Optional[str]:
+    """Look for a user-provided raw archive in ``$FEDML_TPU_OFFLINE_DIR``
+    — and ONLY there. CIFAR archives are python pickles, so importing one
+    executes whatever it deserializes; auto-importing from the generic
+    (often shared) cache dir would turn any writable cache into a code
+    path. Setting the env var is the explicit "I trust these archives"
+    statement; without it, use :func:`import_archive` on a path you
+    chose."""
+    d = os.environ.get("FEDML_TPU_OFFLINE_DIR")
+    if not d:
+        return None
+    for fname in _ARCHIVE_NAMES.get(name, ()):
+        p = os.path.join(os.path.expanduser(d), fname)
+        if os.path.exists(p):
+            return p
     return None
 
 
@@ -236,13 +241,14 @@ def acquire(name: str, cache_dir: str) -> Optional[str]:
     """Materialize dataset ``name`` as ``<cache_dir>/<name>.npz``; returns the
     path, or None if the dataset has no recipe or acquisition failed (the
     caller decides how loudly to fall back). A raw archive dropped in
-    ``$FEDML_TPU_OFFLINE_DIR`` (or the cache dir) is imported without any
-    network — see :func:`import_archive`."""
+    ``$FEDML_TPU_OFFLINE_DIR`` (explicitly set — archives there are
+    trusted input) is imported without any network — see
+    :func:`import_archive`."""
     cache_dir = os.path.expanduser(cache_dir or ".")
     path = os.path.join(cache_dir, f"{name}.npz")
     if os.path.exists(path):
         return path
-    local = _find_local_archive(name, cache_dir)
+    local = _find_local_archive(name)
     if local is not None:
         try:
             return import_archive(name, local, cache_dir)

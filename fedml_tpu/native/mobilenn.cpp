@@ -673,25 +673,33 @@ bool parse_ext_leaf(Cursor& c, size_t len, int8_t type, Leaf* leaf) {
   return true;
 }
 
-bool parse_value(Cursor& c, const std::string& prefix, Store* store);
+// Nesting bound: artifacts are attacker-controlled, and each fixmap level
+// costs ~2 bytes of input, so unbounded recursion here is a crafted-blob
+// stack overflow. Real parameter trees are a handful of levels deep.
+constexpr int kMaxTreeDepth = 64;
+
+bool parse_value(Cursor& c, const std::string& prefix, Store* store,
+                 int depth);
 
 bool parse_map(Cursor& c, size_t n, const std::string& prefix,
-               Store* store) {
+               Store* store, int depth) {
   for (size_t i = 0; i < n; ++i) {
     std::string key;
     if (!parse_str(c, &key)) return false;
     std::string path = prefix.empty() ? key : prefix + "/" + key;
-    if (!parse_value(c, path, store)) return false;
+    if (!parse_value(c, path, store, depth)) return false;
   }
   return true;
 }
 
-bool parse_value(Cursor& c, const std::string& prefix, Store* store) {
+bool parse_value(Cursor& c, const std::string& prefix, Store* store,
+                 int depth) {
+  if (depth > kMaxTreeDepth) return false;
   if (c.p >= c.end) return false;
   uint8_t t = *c.p;
-  if ((t & 0xf0) == 0x80) { c.u8(); return parse_map(c, t & 0x0f, prefix, store); }
-  if (t == 0xde) { c.u8(); return parse_map(c, c.be(2), prefix, store); }
-  if (t == 0xdf) { c.u8(); return parse_map(c, c.be(4), prefix, store); }
+  if ((t & 0xf0) == 0x80) { c.u8(); return parse_map(c, t & 0x0f, prefix, store, depth + 1); }
+  if (t == 0xde) { c.u8(); return parse_map(c, c.be(2), prefix, store, depth + 1); }
+  if (t == 0xdf) { c.u8(); return parse_map(c, c.be(4), prefix, store, depth + 1); }
   size_t len;
   int8_t etype;
   if (t == 0xd4 || t == 0xd5 || t == 0xd6 || t == 0xd7 || t == 0xd8) {
@@ -717,7 +725,9 @@ void put_be(std::vector<uint8_t>* out, uint64_t v, int n) {
 
 void put_str(std::vector<uint8_t>* out, const std::string& s) {
   if (s.size() < 32) out->push_back(0xa0 | static_cast<uint8_t>(s.size()));
-  else { out->push_back(0xd9); put_be(out, s.size(), 1); }
+  else if (s.size() <= 0xff) { out->push_back(0xd9); put_be(out, s.size(), 1); }
+  else if (s.size() <= 0xffff) { out->push_back(0xda); put_be(out, s.size(), 2); }
+  else { out->push_back(0xdb); put_be(out, s.size(), 4); }
   out->insert(out->end(), s.begin(), s.end());
 }
 
@@ -725,7 +735,8 @@ void put_uint(std::vector<uint8_t>* out, uint64_t v) {
   if (v <= 0x7f) out->push_back(static_cast<uint8_t>(v));
   else if (v <= 0xff) { out->push_back(0xcc); put_be(out, v, 1); }
   else if (v <= 0xffff) { out->push_back(0xcd); put_be(out, v, 2); }
-  else { out->push_back(0xce); put_be(out, v, 4); }
+  else if (v <= 0xffffffffULL) { out->push_back(0xce); put_be(out, v, 4); }
+  else { out->push_back(0xcf); put_be(out, v, 8); }
 }
 
 void put_leaf(std::vector<uint8_t>* out, const Leaf& leaf) {
@@ -768,7 +779,8 @@ void put_tree(std::vector<uint8_t>* out, LeafIter begin, LeafIter end,
     it = run;
   }
   if (kids.size() < 16) out->push_back(0x80 | static_cast<uint8_t>(kids.size()));
-  else { out->push_back(0xde); put_be(out, kids.size(), 2); }
+  else if (kids.size() <= 0xffff) { out->push_back(0xde); put_be(out, kids.size(), 2); }
+  else { out->push_back(0xdf); put_be(out, kids.size(), 4); }
   for (auto& k : kids) {
     put_str(out, k.first);
     LeafIter b = k.second.first, e = k.second.second;
@@ -803,7 +815,7 @@ void* artifact_open(const char* path) {
   auto store = std::make_unique<artifact::Store>();
   artifact::Cursor c{blob.data() + artifact::kMagicLen,
                      blob.data() + blob.size()};
-  if (!artifact::parse_value(c, "", store.get()) || c.fail) return nullptr;
+  if (!artifact::parse_value(c, "", store.get(), 0) || c.fail) return nullptr;
   return store.release();
 }
 

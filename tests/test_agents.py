@@ -17,6 +17,8 @@ from fedml_tpu.core.distributed.communication.pubsub import PubSubBroker
 @pytest.fixture()
 def registry(tmp_path, monkeypatch):
     monkeypatch.setenv("FEDML_TPU_RUNS_DIR", str(tmp_path / "runs"))
+    # daemons are secure-by-default: a bind token is part of any deployment
+    monkeypatch.setenv("FEDML_TPU_AGENT_SECRET", "test-bind-token")
     return tmp_path
 
 
@@ -207,7 +209,7 @@ class TestAuth:
                 time.sleep(0.1)
             evil = [s for s in statuses if s.get("request_id") == "evil"]
             assert evil and evil[-1]["status"] == "FAILED"
-            assert "bad bind token" in evil[-1].get("error", "")
+            assert "bind token" in evil[-1].get("error", "")
             # no run was provisioned
             assert slave.runs == {}
             # a signed stop for an unknown run is still honored (verify_job
@@ -217,3 +219,90 @@ class TestAuth:
             slave.stop()
         finally:
             broker.stop()
+
+    def test_tokenless_daemon_start_refused(self, registry, monkeypatch):
+        """VERDICT r4 item 5: open deployment must be an explicit flag.
+        Without FEDML_TPU_AGENT_SECRET the daemon refuses to construct,
+        and the CLI exits 2 with the reason; insecure_open=True is the
+        explicit opt-out."""
+        monkeypatch.delenv("FEDML_TPU_AGENT_SECRET", raising=False)
+        with pytest.raises(RuntimeError, match="bind token"):
+            SlaveAgent(device_id=1, broker_host="127.0.0.1",
+                       broker_port=1)  # never connects: ctor refuses first
+        # explicit opt-out constructs fine (no broker contact yet)
+        SlaveAgent(device_id=1, broker_host="127.0.0.1", broker_port=1,
+                   insecure_open=True)
+        # process-level: the CLI refuses too
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env.pop("FEDML_TPU_AGENT_SECRET", None)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.cli", "agent",
+             "--broker", "127.0.0.1:1", "--device-id", "1"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 2
+        assert "bind token" in out.stderr
+
+    def test_replayed_signed_command_rejected(self, monkeypatch):
+        """ADVICE r4: a captured signed frame must not be honored twice
+        (nonce/MAC ledger) nor after the freshness window (timestamp)."""
+        import time as _time
+        from fedml_tpu.agents import (JOB_MAC_TTL_S, sign_job, verify_job)
+        monkeypatch.setenv("FEDML_TPU_AGENT_SECRET", "tok")
+        signed = sign_job({"request_id": "r1", "job_yaml": "x"})
+        ledger = {}
+        assert verify_job(signed, seen_macs=ledger) is True
+        # exact replay of the captured frame: rejected by the ledger
+        assert verify_job(signed, seen_macs=ledger) is False
+        # stale frame (signed outside the freshness window): rejected even
+        # with an empty ledger
+        old = dict(signed)
+        monkeypatch.setattr(_time, "time",
+                            lambda: old["ts"] + JOB_MAC_TTL_S + 1)
+        assert verify_job(old, seen_macs={}) is False
+        # tampered-after-signing ts fails the MAC itself
+        forged = dict(signed)
+        forged["ts"] = signed["ts"] + 1
+        assert verify_job(forged, seen_macs={}) is False
+
+    def test_replay_ledger_survives_daemon_restart(self, registry,
+                                                   monkeypatch):
+        """A frame accepted before a crash must still be rejected by the
+        relaunched daemon (the ledger is persisted, not process memory)."""
+        from fedml_tpu.agents import sign_job
+        signed = sign_job({"request_id": "r1", "job_yaml": "x"})
+        a1 = SlaveAgent(device_id=2, broker_host="127.0.0.1", broker_port=1)
+        assert a1._check(signed) is None           # first delivery: accepted
+        assert "already seen" in a1._check(signed)  # same-process replay
+        a2 = SlaveAgent(device_id=2, broker_host="127.0.0.1", broker_port=1)
+        assert "already seen" in a2._check(signed)  # post-restart replay
+
+    def test_redelivered_start_reannounces_instead_of_failing(
+            self, registry):
+        """A byte-identical redelivery of an honored start_train (sender
+        retry or replay) must re-announce the live job, not publish FAILED
+        and poison its status on the master."""
+        from fedml_tpu.agents import sign_job
+        a = SlaveAgent(device_id=4, broker_host="127.0.0.1", broker_port=1)
+        signed = sign_job({"request_id": "live", "job_yaml_content": "x"})
+        # simulate the already-honored state without launching anything
+        assert a._check(signed) is None
+        a._seen_requests.add("live")
+        a.runs["live"] = "run-1"
+        a._on_start(dict(signed))  # exact redelivery
+        statuses = [q["payload"] for q in a.center._queue
+                    if q["payload"].get("request_id") == "live"]
+        assert statuses and statuses[-1]["status"] == JOB_RUNNING
+        assert all(s["status"] != "FAILED" for s in statuses)
+        # a replayed frame for an UNKNOWN request is dropped silently
+        # (no status poisoning), not FAILED
+        n_before = len(a.center._queue)
+        other = sign_job({"request_id": "gone", "job_yaml_content": "x"})
+        assert a._check(other) is None  # consume its MAC into the ledger
+        a._on_start(dict(other))        # now arrives as a replay
+        poisoned = [q["payload"] for q in a.center._queue[n_before:]
+                    if q["payload"].get("request_id") == "gone"]
+        assert poisoned == []
