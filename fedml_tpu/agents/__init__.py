@@ -368,6 +368,10 @@ class SlaveAgent:
         # request run-id -> registry run-id (for stop routing)
         self.runs: Dict[str, str] = {}
         self._seen_requests = set()
+        # last status published per request — a redelivered start_train
+        # re-announces THIS (a finished job must not be resurrected to
+        # RUNNING by a duplicate frame)
+        self._last_status: Dict[str, Dict[str, Any]] = {}
         self._watchers: Dict[str, threading.Thread] = {}
 
     # --- replay ledger persistence -----------------------------------------
@@ -428,6 +432,7 @@ class SlaveAgent:
         self.center.stop()
 
     def _status(self, request_id: str, status: str, **extra) -> None:
+        self._last_status[request_id] = {"status": status, **extra}
         self.center.publish(TOPIC_STATUS, {
             "device_id": self.device_id, "request_id": request_id,
             "status": status, "ts": time.time(), **extra})
@@ -439,24 +444,29 @@ class SlaveAgent:
         if reason is not None:
             if reason == REASON_REPLAY:
                 # byte-identical redelivery (at-least-once sender retry, or
-                # an actual replay): re-announce a request we already honor,
-                # drop anything else — publishing FAILED here would let a
-                # replayed frame poison the live job's status on the master
-                if request_id in self._seen_requests:
-                    self._status(request_id, JOB_RUNNING,
-                                 run_id=self.runs.get(request_id))
+                # an actual replay): re-announce the request's ACTUAL last
+                # status — hardcoding RUNNING would resurrect a finished
+                # job, publishing FAILED would poison a live one
+                last = self._last_status.get(request_id)
+                if request_id in self._seen_requests and last:
+                    self._status(request_id, last["status"],
+                                 **{k: v for k, v in last.items()
+                                    if k != "status"})
                 else:
                     logger.error("agent %s: dropping replayed start_train "
-                                 "%s for unknown request", self.device_id,
-                                 request_id)
+                                 "%s", self.device_id, request_id)
                 return
-            # refuse unauthenticated job dispatch outright — and say so on
-            # the status topic so the (possibly legitimate, misconfigured)
-            # sender is not left waiting at PROVISIONING
+            # refuse unauthenticated job dispatch — but NEVER by publishing
+            # a status for a request id we already honor: an unauthenticated
+            # peer echoing a live request id must not be able to flip that
+            # job to FAILED on the master (status poisoning)
             logger.error("agent %s: REFUSING start_train %s — %s",
                          self.device_id, request_id, reason)
-            self._status(request_id, JOB_FAILED,
-                         error=f"start_train refused: {reason}")
+            if request_id not in self._seen_requests:
+                # unknown id: tell the (possibly legitimate, misconfigured)
+                # sender instead of leaving them waiting at PROVISIONING
+                self._status(request_id, JOB_FAILED,
+                             error=f"start_train refused: {reason}")
             return
         # idempotency: the master re-publishes start_train until it sees a
         # status (the broker has no retained messages, so a command sent

@@ -80,7 +80,11 @@ class LogShipper:
             raw = f.read()
             self._offset = f.tell()
         data = self._buf + raw
-        chunks = data.split(b"\n")
+        # universal newlines by hand (binary mode): CR-only progress bars
+        # (tqdm-style) and CRLF logs must still split into lines — buffering
+        # until LF would hoard a \r-only stream forever
+        import re as _re
+        chunks = _re.split(b"\r\n|\r|\n", data)
         self._buf = chunks.pop()  # incomplete tail (or b"")
         return [ln for ln in
                 (c.decode("utf-8", errors="replace") for c in chunks)

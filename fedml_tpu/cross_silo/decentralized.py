@@ -1,0 +1,264 @@
+"""Decentralized (gossip) FL as a REAL distributed runtime — no server;
+every node trains locally and exchanges parameters with its topology
+neighbors as Messages over the comm stack (INPROC threads, TCP, or gRPC
+across OS processes).
+
+Parity target: reference ``simulation/mpi/decentralized_framework/``
+(``decentralized_worker.py`` send-to-neighbors / wait-for-neighbors over
+MPI) driving ``core/distributed/topology/symmetric_topology_manager.py:7``.
+Here each node derives the SAME row-stochastic mixing matrix from the
+shared (deterministic) topology manager, ships its locally-trained
+parameters to every neighbor, and applies ``p_i <- sum_j W[i,j] p_j``
+once all in-neighbor parameters for the round have arrived — out-of-order
+rounds are buffered, so a fast neighbor can run ahead by a round without
+stalling anyone.
+
+The SP simulator (``simulation/sp/decentralized.py``) fuses the same
+round into one jitted program (vmapped local SGD + one einsum mix) and on
+a mesh the mix is ``ppermute`` per edge; this module is the identical
+protocol in its message-passing form — the parity test asserts the same
+trajectory. Node-local math here is jitted JAX: the local training step
+and the weighted mix are each one compiled program per node.
+
+Rank 0 doubles as the session's reporter: after the last round every node
+sends it their final model; it publishes the average-model accuracy and
+consensus distance as the run result (the reference's eval worker role).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.algframe.client_trainer import make_trainer_spec
+from ..core.algframe.local_training import evaluate
+from ..core.algframe.types import TrainHyper
+from ..core.distributed.communication.message import (Message, tree_to_wire,
+                                                      wire_to_tree)
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..core.distributed.topology import SymmetricTopologyManager
+
+logger = logging.getLogger(__name__)
+
+
+class GossipMsg:
+    N2N_PARAMS = 301   # trained params -> each neighbor, tagged with round
+    N2Z_FINAL = 302    # final params -> rank 0 for the session result
+    Z2N_FINISH = 303   # rank 0 -> all: session done
+
+    K_PARAMS = "params"
+    K_ROUND = "round_idx"
+
+
+class GossipNodeManager(FedMLCommManager):
+    """One gossip node (rank == node index == data silo index)."""
+
+    def __init__(self, args, fed, bundle, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.fed = fed
+        self.n = size
+        self.rounds = int(getattr(args, "comm_round", 1))
+        spec = make_trainer_spec(fed, bundle)
+        self.spec = spec
+        import copy
+        from ..optimizers.registry import create_optimizer
+        inner = copy.copy(args)
+        inner.federated_optimizer = "FedAvg"  # local step is plain SGD
+        self.opt = create_optimizer(inner, spec)
+        tm = SymmetricTopologyManager(
+            self.n, neighbor_num=int(getattr(args, "topology_neighbors", 2)
+                                     or 2))
+        tm.generate_topology()
+        self.W = np.asarray(tm.mixing_matrix())
+        # peers i mixes FROM (row) == peers that need i's params (symmetric)
+        self.neighbors = sorted(
+            j for j in range(self.n)
+            if self.W[self.rank, j] > 0 and j != self.rank)
+        self._neighbor_w = [float(self.W[self.rank, j])
+                            for j in self.neighbors]
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        init_rng, self.rng = jax.random.split(rng)
+        p0 = bundle.init(init_rng, fed.train.x[0, 0])
+        self.params = p0
+        self._template = p0
+        cid = min(self.rank, fed.num_clients - 1)
+        self.cdata = jax.tree_util.tree_map(lambda a: a[cid], fed.train)
+        self.hyper = TrainHyper(
+            learning_rate=jnp.float32(args.learning_rate),
+            epochs=int(getattr(args, "epochs", 1)))
+        self._train = jax.jit(self._train_impl)
+        self._mix = jax.jit(self._mix_impl)
+        self._evaluate = jax.jit(
+            lambda p, x, y, m: evaluate(spec, p, x, y, m))
+        self.round_idx = 0
+        # round -> {sender: params}; buffers early arrivals from fast peers
+        self._inbox: Dict[int, Dict[int, Any]] = {}
+        self._trained: Optional[Any] = None
+        self._finals: Dict[int, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+        self.result: Optional[dict] = None
+
+    # --- jitted math --------------------------------------------------------
+    def _train_impl(self, params, round_key, hyper):
+        key = jax.random.fold_in(round_key, self.rank)
+        out = self.opt.local_train(params, {}, {}, self.cdata, key, hyper)
+        return jax.tree_util.tree_map(jnp.add, params, out.update)
+
+    def _mix_impl(self, own, neighbor_params):
+        """p_i <- W[i,i]*own + sum_j W[i,j]*p_j, accumulated in f32 over
+        neighbors in ascending-j order (matching the SP sim's einsum
+        contraction up to float reassociation)."""
+        w_self = float(self.W[self.rank, self.rank])
+        acc = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32) * w_self, own)
+        for pj, w in zip(neighbor_params, self._neighbor_w):
+            acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32) * w, acc, pj)
+        return jax.tree_util.tree_map(
+            lambda a, t: a.astype(t.dtype), acc, own)
+
+    # --- FSM ----------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(GossipMsg.N2N_PARAMS,
+                                              self._on_params)
+        self.register_message_receive_handler(GossipMsg.N2Z_FINAL,
+                                              self._on_final)
+        self.register_message_receive_handler(GossipMsg.Z2N_FINISH,
+                                              self._on_finish)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self._kick_round()
+        self.com_manager.handle_receive_message()
+
+    def _kick_round(self) -> None:
+        """Train locally and ship the trained params to every neighbor."""
+        round_key = jax.random.fold_in(self.rng, self.round_idx)
+        self._trained = self._train(
+            self.params, round_key,
+            self.hyper.replace(round_idx=jnp.int32(self.round_idx)))
+        wire = tree_to_wire(self._trained)
+        for j in self.neighbors:
+            m = Message(GossipMsg.N2N_PARAMS, self.rank, j)
+            m.add_params(GossipMsg.K_PARAMS, wire)
+            m.add_params(GossipMsg.K_ROUND, self.round_idx)
+            self._send_with_retry(m)
+        self._try_mix()
+
+    def _send_with_retry(self, msg: Message, timeout_s: float = 60.0) -> None:
+        """Peer processes come up at their own pace and there is no server
+        to sequence the handshake — round-0 sends retry until the
+        neighbor's listener is reachable."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        delay = 0.2
+        while True:
+            try:
+                self.send_message(msg)
+                return
+            except Exception as e:
+                if _time.monotonic() >= deadline:
+                    raise
+                logger.debug("gossip node %d: send to %s not yet "
+                             "deliverable (%s); retrying", self.rank,
+                             msg.get_receiver_id(), e)
+                _time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+
+    def _on_params(self, msg: Message) -> None:
+        r = int(msg.get(GossipMsg.K_ROUND))
+        sender = msg.get_sender_id()
+        self._inbox.setdefault(r, {})[sender] = wire_to_tree(
+            msg.get(GossipMsg.K_PARAMS), self._template)
+        self._try_mix()
+
+    def _try_mix(self) -> None:
+        box = self._inbox.get(self.round_idx, {})
+        if self._trained is None or len(box) < len(self.neighbors):
+            return
+        ordered = [box[j] for j in sorted(box)]
+        self.params = self._mix(self._trained, ordered)
+        del self._inbox[self.round_idx]
+        self._trained = None
+        if self.rank == 0 and self.round_idx < self.rounds - 1:
+            # the last round's record is written by the final report (it
+            # carries the avg-model accuracy)
+            self.history.append({"round": self.round_idx})
+        self.round_idx += 1
+        if self.round_idx >= self.rounds:
+            self._finalize()
+            return
+        self._kick_round()
+
+    def _finalize(self) -> None:
+        if self.rank != 0:
+            m = Message(GossipMsg.N2Z_FINAL, self.rank, 0)
+            m.add_params(GossipMsg.K_PARAMS, tree_to_wire(self.params))
+            self.send_message(m)
+            return  # wait for FINISH
+        self._finals[0] = self.params
+        self._maybe_report()
+
+    def _on_final(self, msg: Message) -> None:
+        self._finals[msg.get_sender_id()] = wire_to_tree(
+            msg.get(GossipMsg.K_PARAMS), self._template)
+        self._maybe_report()
+
+    def _maybe_report(self) -> None:
+        if self.rank != 0 or len(self._finals) < self.n:
+            return
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves),
+            *[self._finals[i] for i in range(self.n)])
+        avg = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0), stacked)
+        stats = self._evaluate(avg, self.fed.test["x"], self.fed.test["y"],
+                               self.fed.test["mask"])
+        cnt = max(float(stats["count"]), 1.0)
+        acc = float(stats["correct"]) / cnt
+        mean = avg
+        sq = jax.tree_util.tree_map(
+            lambda a, m: jnp.sum((a - m[None]) ** 2,
+                                 axis=tuple(range(1, a.ndim))),
+            stacked, mean)
+        consensus = float(jnp.mean(jnp.sqrt(
+            sum(jax.tree_util.tree_leaves(sq)))))
+        logger.info("gossip session: avg-model acc=%.4f consensus=%.4f",
+                    acc, consensus)
+        self.history.append({"round": self.rounds - 1, "test_acc": acc,
+                             "consensus_dist": consensus})
+        self.result = {"params": avg, "history": self.history,
+                       "final_test_acc": acc,
+                       "consensus_dist": consensus, "rounds": self.rounds}
+        for j in range(1, self.n):
+            self.send_message(Message(GossipMsg.Z2N_FINISH, self.rank, j))
+        self.finish()
+
+    def _on_finish(self, msg: Message) -> None:
+        logger.info("gossip node %d: finish", self.rank)
+        self.finish()
+
+
+def run_gossip_inproc(args, fed, bundle) -> Dict[str, Any]:
+    """All N gossip nodes as threads over the in-proc broker — the exact
+    distributed FSM without sockets (parity test / `backend: INPROC`)."""
+    from ..core.distributed.communication.inproc import InProcBroker
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    n = int(getattr(args, "client_num_in_total", fed.num_clients))
+    nodes = [GossipNodeManager(args, fed, bundle, rank=r, size=n,
+                               backend="INPROC")
+             for r in range(n)]
+    threads = [threading.Thread(target=nd.run, daemon=True)
+               for nd in nodes[1:]]
+    for t in threads:
+        t.start()
+    nodes[0].run()
+    for t in threads:
+        t.join(timeout=60.0)
+    return nodes[0].result

@@ -91,6 +91,38 @@ class CrossSiloRunner:
         if fo in ("sa", "secagg", "lsa", "lightsecagg"):
             self.manager = self._build_secure(args, dataset, model,
                                               client_trainer, fo, role, rank)
+        elif fo in ("split_nn", "splitnn"):
+            # split learning as a real distributed session: parties
+            # exchange activations/grads over the transport
+            from ..split_learning import (SplitNNClientManager,
+                                          SplitNNServerManager)
+            n = int(getattr(args, "client_num_per_round", 1))
+            if role == "server":
+                self.manager = SplitNNServerManager(
+                    args, dataset.num_classes, size=n + 1,
+                    backend=_wan_backend(args))
+            else:
+                self.manager = SplitNNClientManager(
+                    args, dataset, rank=max(rank, 1), size=n + 1,
+                    backend=_wan_backend(args))
+        elif fo in ("decentralized_fl", "gossip"):
+            # serverless: every process is a gossip node; rank == node idx
+            from ..decentralized import GossipNodeManager
+            n = int(getattr(args, "client_num_in_total", 2))
+            self.manager = GossipNodeManager(
+                args, dataset, model,
+                rank=0 if role == "server" else max(rank, 1), size=n,
+                backend=_wan_backend(args))
+        elif fo in ("classical_vertical", "vertical_fl", "vfl"):
+            from ..vertical import VFLPartyManager, VFLServerManager
+            n = int(getattr(args, "party_num", 2) or 2)
+            if role == "server":
+                self.manager = VFLServerManager(
+                    args, dataset, size=n + 1, backend=_wan_backend(args))
+            else:
+                self.manager = VFLPartyManager(
+                    args, dataset, rank=max(rank, 1), size=n + 1,
+                    backend=_wan_backend(args))
         elif role == "server":
             self.manager = build_server(args, dataset, model, client_trainer)
         else:

@@ -1,0 +1,307 @@
+"""Split learning (SplitNN) as a REAL distributed session over the comm
+stack — the model is cut at a layer; parties exchange ONLY activations
+(forward) and activation-gradients (backward) across the process/WAN
+boundary.
+
+Parity target: reference ``simulation/mpi/split_nn/SplitNNAPI.py:10`` with
+``SplitNNClientManager``/``SplitNNServerManager`` exchanging
+activations/grads over MPI and training clients round-robin. Here the
+protocol rides the repo's :class:`FedMLCommManager` (INPROC threads, TCP,
+or gRPC across OS processes — same FSM), and all party-local math is
+jitted JAX: the client's cut-layer forward and its vjp backward are each
+one compiled program, the server's head step (loss + head grads +
+activation grads) is one compiled program, so the TPU work per message is
+a single dispatch on either side.
+
+The SP simulator (``simulation/sp/split_nn.py``) fuses the same math into
+one end-to-end program for speed; this module is the same protocol in its
+distributed form — results are numerically identical (chain rule is chain
+rule whether or not a socket sits at the cut), which the parity test
+asserts.
+
+Privacy boundary: raw features never leave the client; labels travel with
+activations (the label-sharing SplitNN variant, as in the reference).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..simulation.sp.split_nn import _Bottom, _Top
+
+logger = logging.getLogger(__name__)
+
+
+class SplitMsg:
+    # client -> server
+    C2S_ONLINE = 101
+    C2S_ACTS = 102        # one batch of cut-layer activations (+ labels)
+    C2S_DONE = 103        # client finished its local epochs
+    C2S_EVAL_ACTS = 104   # test-set activations for server-side eval
+    # server -> client
+    S2C_ACTIVATE = 111    # your turn: run local epochs
+    S2C_GRADS = 112       # d(loss)/d(activations) for the batch just sent
+    S2C_EVALUATE = 113    # stream your test activations
+    S2C_FINISH = 114
+
+    K_ACTS = "acts"
+    K_GRADS = "grads"
+    K_LABELS = "labels"
+    K_MASK = "mask"
+    K_ROUND = "round_idx"
+
+
+def _tree_np(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class SplitNNServerManager(FedMLCommManager):
+    """Rank 0 — owns the model head (top). Initializes it lazily from the
+    SHAPE of the first activation (dense-stack init depends on shapes and
+    rng only, so this matches the SP simulator's probe init exactly)."""
+
+    def __init__(self, args, output_dim: int, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        self.top = _Top(int(output_dim))
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        _, self._kt, _ = jax.random.split(rng, 3)
+        self.top_params = None
+        self.lr = float(args.learning_rate)
+        self.rounds = int(getattr(args, "comm_round", 1))
+        self.freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
+        self.round_idx = 0
+        self.client_num = size - 1
+        self._online: List[int] = []
+        self._active_pos = 0  # index into the sorted client order
+        self.history: List[Dict[str, Any]] = []
+        self.result: Optional[dict] = None
+        self._step = jax.jit(self._step_impl)
+        self._eval = jax.jit(self._eval_impl)
+
+    # --- jitted math --------------------------------------------------------
+    def _loss(self, tp, h, y, mask):
+        logits = self.top.apply(tp, h)
+        labels = y.astype(jnp.int32)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits,
+                                                                 labels)
+        mask = mask.astype(per_ex.dtype)
+        loss = jnp.sum(per_ex * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels) * mask)
+        return loss, (correct, jnp.sum(mask))
+
+    def _step_impl(self, tp, h, y, mask):
+        (_, aux), (gt, dh) = jax.value_and_grad(
+            self._loss, argnums=(0, 1), has_aux=True)(tp, h, y, mask)
+        new_tp = jax.tree_util.tree_map(lambda w, g: w - self.lr * g, tp, gt)
+        return new_tp, dh, aux
+
+    def _eval_impl(self, tp, h, y, mask):
+        _, (correct, count) = self._loss(tp, h, y, mask)
+        return correct, count
+
+    # --- FSM ----------------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(SplitMsg.C2S_ONLINE,
+                                              self._on_online)
+        self.register_message_receive_handler(SplitMsg.C2S_ACTS,
+                                              self._on_acts)
+        self.register_message_receive_handler(SplitMsg.C2S_DONE,
+                                              self._on_done)
+        self.register_message_receive_handler(SplitMsg.C2S_EVAL_ACTS,
+                                              self._on_eval_acts)
+
+    def _on_online(self, msg: Message) -> None:
+        rank = msg.get_sender_id()
+        if rank not in self._online:
+            self._online.append(rank)
+        logger.info("splitnn server: %d/%d parties online",
+                    len(self._online), self.client_num)
+        if len(self._online) >= self.client_num:
+            self._online.sort()  # round-robin in cid order, like the SP sim
+            self._activate(self._online[0])
+
+    def _activate(self, rank: int) -> None:
+        m = Message(SplitMsg.S2C_ACTIVATE, self.rank, rank)
+        m.add_params(SplitMsg.K_ROUND, self.round_idx)
+        self.send_message(m)
+
+    def _on_acts(self, msg: Message) -> None:
+        h = jnp.asarray(msg.get(SplitMsg.K_ACTS))
+        y = jnp.asarray(msg.get(SplitMsg.K_LABELS))
+        mask = jnp.asarray(msg.get(SplitMsg.K_MASK))
+        if self.top_params is None:
+            self.top_params = self.top.init(self._kt, jnp.zeros_like(h))
+        self.top_params, dh, _ = self._step(self.top_params, h, y, mask)
+        out = Message(SplitMsg.S2C_GRADS, self.rank, msg.get_sender_id())
+        out.add_params(SplitMsg.K_GRADS, np.asarray(dh))
+        self.send_message(out)
+
+    def _on_done(self, msg: Message) -> None:
+        self._active_pos += 1
+        if self._active_pos < len(self._online):
+            self._activate(self._online[self._active_pos])
+            return
+        # round complete
+        self._active_pos = 0
+        if (self.round_idx % self.freq == 0
+                or self.round_idx == self.rounds - 1):
+            # evaluate with the FIRST party's bottom (SP sim evaluates
+            # client 0's pair; any one pair is a valid split model)
+            self.send_message(Message(SplitMsg.S2C_EVALUATE, self.rank,
+                                      self._online[0]))
+            return
+        self.history.append({"round": self.round_idx})
+        self._advance()
+
+    def _on_eval_acts(self, msg: Message) -> None:
+        h = jnp.asarray(msg.get(SplitMsg.K_ACTS))
+        y = jnp.asarray(msg.get(SplitMsg.K_LABELS))
+        mask = jnp.asarray(msg.get(SplitMsg.K_MASK))
+        correct, count = self._eval(self.top_params, h, y, mask)
+        acc = float(correct) / max(float(count), 1.0)
+        logger.info("splitnn server round %d: acc=%.4f", self.round_idx, acc)
+        self.history.append({"round": self.round_idx, "test_acc": acc})
+        self._advance()
+
+    def _advance(self) -> None:
+        self.round_idx += 1
+        if self.round_idx >= self.rounds:
+            for rank in self._online:
+                self.send_message(Message(SplitMsg.S2C_FINISH, self.rank,
+                                          rank))
+            last = next((r for r in reversed(self.history)
+                         if "test_acc" in r), {})
+            self.result = {"params": {"top": self.top_params},
+                           "history": self.history,
+                           "final_test_acc": last.get("test_acc"),
+                           "rounds": self.rounds}
+            self.finish()
+            return
+        self._activate(self._online[0])
+
+
+class SplitNNClientManager(FedMLCommManager):
+    """Rank k>=1 — owns the bottom (feature extractor) for data silo
+    ``k-1``. A state machine, not a blocking loop: handlers run on the
+    receive thread, so each GRADS reply triggers the next batch send."""
+
+    def __init__(self, args, fed, comm=None, rank: int = 1, size: int = 0,
+                 backend: str = "INPROC"):
+        super().__init__(args, comm, rank, size, backend)
+        hidden = int(getattr(args, "splitnn_hidden", 128) or 128)
+        self.bottom = _Bottom(hidden)
+        rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        kb, _, _ = jax.random.split(rng, 3)
+        sample = fed.train.x[0, 0]
+        self.params = self.bottom.init(kb, sample)
+        self.lr = float(args.learning_rate)
+        cid = min(self.rank - 1, fed.num_clients - 1)
+        self.cdata = jax.tree_util.tree_map(lambda a: a[cid], fed.train)
+        self.test = fed.test
+        self.epochs = int(getattr(args, "epochs", 1))
+        self._fwd = jax.jit(self.bottom.apply)
+        self._bwd = jax.jit(self._bwd_impl)
+        # batches with at least one live sample, in order (padding batches
+        # are no-op updates in the SP sim — skipping them is exact parity)
+        self._real = [int(i) for i in
+                      np.flatnonzero(np.asarray(
+                          self.cdata.mask).sum(axis=-1) > 0)]
+        self._epoch = 0
+        self._pos = 0
+
+    def _bwd_impl(self, p, x, dh):
+        _, vjp = jax.vjp(lambda pp: self.bottom.apply(pp, x), p)
+        (gp,) = vjp(dh)
+        return jax.tree_util.tree_map(lambda w, g: w - self.lr * g, p, gp)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(SplitMsg.S2C_ACTIVATE,
+                                              self._on_activate)
+        self.register_message_receive_handler(SplitMsg.S2C_GRADS,
+                                              self._on_grads)
+        self.register_message_receive_handler(SplitMsg.S2C_EVALUATE,
+                                              self._on_evaluate)
+        self.register_message_receive_handler(SplitMsg.S2C_FINISH,
+                                              self._on_finish)
+
+    def run(self) -> None:
+        m = Message(SplitMsg.C2S_ONLINE, self.rank, 0)
+        self.send_message(m)
+        super().run()
+
+    def _on_activate(self, msg: Message) -> None:
+        self._epoch = 0
+        self._pos = 0
+        self._send_next()
+
+    def _send_next(self) -> None:
+        if self._pos >= len(self._real):
+            self._epoch += 1
+            self._pos = 0
+        if self._epoch >= self.epochs or not self._real:
+            self.send_message(Message(SplitMsg.C2S_DONE, self.rank, 0))
+            return
+        b = self._real[self._pos]
+        h = self._fwd(self.params, self.cdata.x[b])
+        out = Message(SplitMsg.C2S_ACTS, self.rank, 0)
+        out.add_params(SplitMsg.K_ACTS, np.asarray(h))
+        out.add_params(SplitMsg.K_LABELS, np.asarray(self.cdata.y[b]))
+        out.add_params(SplitMsg.K_MASK, np.asarray(self.cdata.mask[b]))
+        self.send_message(out)
+
+    def _on_grads(self, msg: Message) -> None:
+        dh = jnp.asarray(msg.get(SplitMsg.K_GRADS))
+        b = self._real[self._pos]
+        self.params = self._bwd(self.params, self.cdata.x[b], dh)
+        self._pos += 1
+        self._send_next()
+
+    def _on_evaluate(self, msg: Message) -> None:
+        tx = jnp.asarray(self.test["x"])
+        flat = tx.reshape((-1,) + tx.shape[2:])
+        h = self._fwd(self.params, flat)
+        out = Message(SplitMsg.C2S_EVAL_ACTS, self.rank, 0)
+        out.add_params(SplitMsg.K_ACTS, np.asarray(h))
+        out.add_params(SplitMsg.K_LABELS,
+                       np.asarray(self.test["y"]).reshape(-1))
+        out.add_params(SplitMsg.K_MASK,
+                       np.asarray(self.test["mask"]).reshape(-1))
+        self.send_message(out)
+
+    def _on_finish(self, msg: Message) -> None:
+        logger.info("splitnn client rank %d: finish", self.rank)
+        self.finish()
+
+
+def run_splitnn_inproc(args, fed) -> Dict[str, Any]:
+    """Server + N party clients as threads over the in-proc broker —
+    the exact distributed FSM without sockets (used by the parity test
+    and the `backend: INPROC` config path)."""
+    import threading
+
+    from ..core.distributed.communication.inproc import InProcBroker
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    n = int(getattr(args, "client_num_per_round",
+                    getattr(args, "client_num_in_total", 2)))
+    server = SplitNNServerManager(args, fed.num_classes, size=n + 1,
+                                  backend="INPROC")
+    clients = [SplitNNClientManager(args, fed, rank=r, size=n + 1,
+                                    backend="INPROC")
+               for r in range(1, n + 1)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60.0)
+    return server.result

@@ -22,13 +22,15 @@ def main() -> None:
     from fedml_tpu.arguments import Arguments
     from fedml_tpu.cross_silo.horizontal.runner import CrossSiloRunner
 
+    # decentralized gossip has no server: all 4 processes are nodes
+    n_total = 4 if optimizer in ("decentralized_fl", "gossip") else 3
     args = Arguments(
-        dataset="digits", model="lr", client_num_in_total=3,
-        client_num_per_round=3, comm_round=2, epochs=1, batch_size=32,
-        learning_rate=0.1, random_seed=11, training_type="cross_silo",
-        federated_optimizer=optimizer, backend="GRPC",
-        grpc_base_port=int(base_port), role=role, rank=int(rank),
-        round_timeout_s=30.0)
+        dataset="digits", model="lr", client_num_in_total=n_total,
+        client_num_per_round=3, party_num=3, comm_round=2, epochs=1,
+        batch_size=32, learning_rate=0.1, random_seed=11,
+        training_type="cross_silo", federated_optimizer=optimizer,
+        backend="GRPC", grpc_base_port=int(base_port), role=role,
+        rank=int(rank), round_timeout_s=30.0)
     fed, output_dim = data_mod.load(args)
     bundle = model_mod.create(args, output_dim)
     runner = CrossSiloRunner(args, fed, bundle)

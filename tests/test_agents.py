@@ -285,18 +285,26 @@ class TestAuth:
         """A byte-identical redelivery of an honored start_train (sender
         retry or replay) must re-announce the live job, not publish FAILED
         and poison its status on the master."""
-        from fedml_tpu.agents import sign_job
+        from fedml_tpu.agents import JOB_FINISHED, sign_job
         a = SlaveAgent(device_id=4, broker_host="127.0.0.1", broker_port=1)
         signed = sign_job({"request_id": "live", "job_yaml_content": "x"})
         # simulate the already-honored state without launching anything
         assert a._check(signed) is None
         a._seen_requests.add("live")
         a.runs["live"] = "run-1"
+        a._status("live", JOB_RUNNING, run_id="run-1")
         a._on_start(dict(signed))  # exact redelivery
         statuses = [q["payload"] for q in a.center._queue
                     if q["payload"].get("request_id") == "live"]
         assert statuses and statuses[-1]["status"] == JOB_RUNNING
         assert all(s["status"] != "FAILED" for s in statuses)
+        # a redelivery AFTER the job finished re-announces FINISHED — it
+        # must not resurrect the job to RUNNING on the master
+        a._status("live", JOB_FINISHED, run_id="run-1")
+        a._on_start(dict(signed))
+        statuses = [q["payload"] for q in a.center._queue
+                    if q["payload"].get("request_id") == "live"]
+        assert statuses[-1]["status"] == JOB_FINISHED
         # a replayed frame for an UNKNOWN request is dropped silently
         # (no status poisoning), not FAILED
         n_before = len(a.center._queue)
@@ -306,3 +314,21 @@ class TestAuth:
         poisoned = [q["payload"] for q in a.center._queue[n_before:]
                     if q["payload"].get("request_id") == "gone"]
         assert poisoned == []
+
+    def test_unauthenticated_frame_cannot_poison_live_job(self, registry):
+        """An unauthenticated peer echoing a LIVE request id must not be
+        able to flip that job to FAILED on the master; unknown ids still
+        get the refusal status so misconfigured senders aren't left
+        hanging."""
+        a = SlaveAgent(device_id=6, broker_host="127.0.0.1", broker_port=1)
+        a._seen_requests.add("live")
+        a._status("live", JOB_RUNNING, run_id="run-9")
+        n_before = len(a.center._queue)
+        a._on_start({"request_id": "live"})  # forged, no MAC
+        assert all(q["payload"]["status"] != "FAILED"
+                   for q in a.center._queue[n_before:]
+                   if q["payload"].get("request_id") == "live")
+        a._on_start({"request_id": "fresh"})  # forged, unknown id
+        fresh = [q["payload"] for q in a.center._queue
+                 if q["payload"].get("request_id") == "fresh"]
+        assert fresh and fresh[-1]["status"] == "FAILED"

@@ -1,0 +1,63 @@
+"""Decentralized (gossip) FL over a real transport (VERDICT r4 item 4):
+nodes exchange parameters with topology neighbors as Messages, with
+parity against the fused SP simulator on the same config."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu import data as data_mod
+from fedml_tpu import model as model_mod
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.cross_silo.decentralized import run_gossip_inproc
+from fedml_tpu.runner import FedMLRunner
+
+pytestmark = pytest.mark.slow
+
+
+def _args(**kw):
+    base = dict(dataset="digits", model="lr", client_num_in_total=4,
+                client_num_per_round=4, comm_round=4, epochs=1,
+                batch_size=32, learning_rate=0.1,
+                frequency_of_the_test=1, random_seed=5,
+                federated_optimizer="decentralized_fl",
+                topology_neighbors=2)
+    base.update(kw)
+    return Arguments(**base)
+
+
+def test_gossip_session_matches_sp_simulator():
+    """Same topology matrix, same local steps, same mixing — the message
+    protocol and the fused einsum round are the same trajectory."""
+    args = _args(training_type="cross_silo")
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    dist = run_gossip_inproc(args, fed, bundle)
+    sp_args = _args(training_type="simulation")
+    sp = FedMLRunner(sp_args, dataset=fed, model=bundle).run()
+    assert dist is not None
+    assert dist["rounds"] == sp["rounds"] == 4
+    assert abs(dist["final_test_acc"] - sp["final_test_acc"]) < 0.02
+    assert abs(dist["consensus_dist"]
+               - sp["history"][-1]["consensus_dist"]) < 1e-2
+    assert dist["final_test_acc"] > 0.5
+    # gossip actually mixed: nodes are closer than untrained divergence
+    assert dist["consensus_dist"] < 1.0
+
+
+def test_gossip_node_neighbor_sets_are_consistent():
+    """Every directed edge a node expects to receive on is an edge some
+    neighbor sends on (symmetric topology => identical in/out sets)."""
+    from fedml_tpu.cross_silo.decentralized import GossipNodeManager
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    args = _args(training_type="cross_silo")
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    args.inproc_broker = InProcBroker()
+    nodes = [GossipNodeManager(args, fed, bundle, rank=r, size=4,
+                               backend="INPROC") for r in range(4)]
+    for nd in nodes:
+        for j in nd.neighbors:
+            assert nd.rank in nodes[j].neighbors
+    # row-stochastic weights
+    for nd in nodes:
+        np.testing.assert_allclose(nd.W.sum(axis=1), 1.0, atol=1e-9)

@@ -134,3 +134,27 @@ def test_wired_into_mlops_init(tmp_path, log_server, monkeypatch):
     log_daemon.stop_all_shippers()
     mine = [b for b in col.received if b["run_id"] == "ship1"]
     assert mine and any("acc" in ln for b in mine for ln in b["log_lines"])
+
+
+def test_cr_and_crlf_and_binary_lines(tmp_path, log_server):
+    """Binary tailing must keep universal newlines: \r-only progress bars
+    (tqdm-style) and CRLF logs still split into lines, and non-UTF-8
+    bytes neither crash nor desync the byte-offset bookkeeping."""
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "wb") as f:
+        f.write(b"epoch 1/3\repoch 2/3\repoch 3/3\r\n")
+        f.write(b"crlf line\r\n")
+        f.write(b"raw \xff\xfe bytes\n")
+    s = LogShipper(path, url)
+    assert s.pump_once() == 5
+    lines = [ln for b in col.received for ln in b["log_lines"]]
+    assert lines[:3] == ["epoch 1/3", "epoch 2/3", "epoch 3/3"]
+    assert lines[3] == "crlf line"          # no trailing \r shipped
+    assert "raw" in lines[4] and "bytes" in lines[4]
+    # byte offset equals the true file size even with non-UTF-8 content
+    assert s._offset == os.path.getsize(path)
+    # a \r-terminated tail is a complete line, not hoarded in the buffer
+    with open(path, "ab") as f:
+        f.write(b"progress 10%\r")
+    assert s.pump_once() == 1
