@@ -407,12 +407,14 @@ def bench_llm_mfu(steps=16):
     }), flush=True)
 
 
-def bench_long_context(seq_len=4096, steps=8):
+def bench_long_context(seq_len=4096, steps=8, metric_suffix=""):
     """Long-context training throughput through the Pallas flash fwd+bwd
-    kernels at s=4096 (a dense backward would materialize 64 MiB of
+    kernels (a dense backward at s=4096 would materialize 64 MiB of
     scores per head per layer; flash trains in O(s·block) memory — the
-    property test_flash_bwd_never_materializes_scores asserts on-chip).
-    Off-TPU falls back to dense and says so in the unit string."""
+    property test_flash_bwd_never_materializes_scores asserts on-chip;
+    ring attention extends the same contract across chips,
+    test_ring_bwd_residuals_stay_linear_in_s). Off-TPU falls back to
+    dense and says so in the unit string."""
     import jax
 
     impl = "flash" if jax.default_backend() == "tpu" else "dense"
@@ -421,7 +423,7 @@ def bench_long_context(seq_len=4096, steps=8):
     peak = _peak_tflops(jax.devices()[0])
     mfu = (flops / dt / 1e12 / peak) if peak else None
     print(json.dumps({
-        "metric": "llm_long_context_train_tokens_per_s",
+        "metric": "llm_long_context_train_tokens_per_s" + metric_suffix,
         "value": round(seq_len / dt, 0),
         "unit": f"tokens/s (bf16, seq {seq_len}, bs 1, {impl} fwd+bwd, "
                 "single chip)",
@@ -440,7 +442,10 @@ def run():
              bench_shakespeare_fedopt),
             ("fedllm_lora_federated_round_s", bench_federated_lora),
             ("llm_train_step_mfu", bench_llm_mfu),
-            ("llm_long_context_train_tokens_per_s", bench_long_context)):
+            ("llm_long_context_train_tokens_per_s", bench_long_context),
+            ("llm_long_context_train_tokens_per_s_seq8192",
+             lambda: bench_long_context(seq_len=8192, steps=4,
+                                        metric_suffix="_seq8192"))):
         try:  # a broken line must never mask the others
             fn()
         except Exception as e:

@@ -471,10 +471,15 @@ class SlaveAgent:
         # idempotency: the master re-publishes start_train until it sees a
         # status (the broker has no retained messages, so a command sent
         # before this agent subscribed is simply gone) — a duplicate must
-        # re-announce, never re-execute
+        # re-announce the request's ACTUAL last status (a freshly-signed
+        # redispatch arriving after the job finished must not resurrect
+        # it to RUNNING), never re-execute
         if request_id in self._seen_requests:
-            self._status(request_id, JOB_RUNNING,
-                         run_id=self.runs.get(request_id))
+            last = self._last_status.get(request_id)
+            if last:
+                self._status(request_id, last["status"],
+                             **{k: v for k, v in last.items()
+                                if k != "status"})
             return
         self._seen_requests.add(request_id)
         self._status(request_id, JOB_PROVISIONING)

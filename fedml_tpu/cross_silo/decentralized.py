@@ -28,7 +28,6 @@ consensus distance as the run result (the reference's eval worker role).
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -245,20 +244,11 @@ class GossipNodeManager(FedMLCommManager):
 
 
 def run_gossip_inproc(args, fed, bundle) -> Dict[str, Any]:
-    """All N gossip nodes as threads over the in-proc broker — the exact
-    distributed FSM without sockets (parity test / `backend: INPROC`)."""
-    from ..core.distributed.communication.inproc import InProcBroker
-    broker = InProcBroker()
-    args.inproc_broker = broker
+    """All N gossip nodes over the in-proc broker (parity test /
+    `backend: INPROC`); node 0 reports the session result."""
+    from . import run_inproc_session
     n = int(getattr(args, "client_num_in_total", fed.num_clients))
-    nodes = [GossipNodeManager(args, fed, bundle, rank=r, size=n,
-                               backend="INPROC")
-             for r in range(n)]
-    threads = [threading.Thread(target=nd.run, daemon=True)
-               for nd in nodes[1:]]
-    for t in threads:
-        t.start()
-    nodes[0].run()
-    for t in threads:
-        t.join(timeout=60.0)
-    return nodes[0].result
+    return run_inproc_session(args, lambda: [
+        GossipNodeManager(args, fed, bundle, rank=r, size=n,
+                          backend="INPROC")
+        for r in range(n)])

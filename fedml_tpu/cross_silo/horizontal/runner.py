@@ -11,11 +11,9 @@ deployment.
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Any, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 
 from ...core.algframe.client_trainer import make_trainer_spec
 from ...core.algframe.local_training import evaluate
@@ -169,17 +167,10 @@ class CrossSiloRunner:
 
 def run_cross_silo_inproc(args, fed, bundle, spec=None) -> Dict[str, Any]:
     """Server + N silo clients as threads over the in-proc broker."""
-    from ...core.distributed.communication.inproc import InProcBroker
-    broker = InProcBroker()
-    args.inproc_broker = broker
+    from .. import run_inproc_session
     n = int(getattr(args, "client_num_per_round", 2))
-    server = build_server(args, fed, bundle, spec, backend="INPROC")
-    clients = [build_client(args, fed, bundle, rank=r, spec=spec,
-                            backend="INPROC") for r in range(1, n + 1)]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    for t in threads:
-        t.start()
-    server.run()  # blocks until FINISH
-    for t in threads:
-        t.join(timeout=30.0)
-    return server.result
+    return run_inproc_session(args, lambda: [
+        build_server(args, fed, bundle, spec, backend="INPROC"),
+        *[build_client(args, fed, bundle, rank=r, spec=spec,
+                       backend="INPROC") for r in range(1, n + 1)]],
+        join_timeout_s=30.0)

@@ -59,10 +59,6 @@ class SplitMsg:
     K_ROUND = "round_idx"
 
 
-def _tree_np(tree):
-    return jax.tree_util.tree_map(np.asarray, tree)
-
-
 class SplitNNServerManager(FedMLCommManager):
     """Rank 0 — owns the model head (top). Initializes it lazily from the
     SHAPE of the first activation (dense-stack init depends on shapes and
@@ -283,25 +279,14 @@ class SplitNNClientManager(FedMLCommManager):
 
 
 def run_splitnn_inproc(args, fed) -> Dict[str, Any]:
-    """Server + N party clients as threads over the in-proc broker —
-    the exact distributed FSM without sockets (used by the parity test
-    and the `backend: INPROC` config path)."""
-    import threading
-
-    from ..core.distributed.communication.inproc import InProcBroker
-    broker = InProcBroker()
-    args.inproc_broker = broker
+    """Server + N party clients over the in-proc broker (parity test /
+    `backend: INPROC` config path)."""
+    from . import run_inproc_session
     n = int(getattr(args, "client_num_per_round",
                     getattr(args, "client_num_in_total", 2)))
-    server = SplitNNServerManager(args, fed.num_classes, size=n + 1,
-                                  backend="INPROC")
-    clients = [SplitNNClientManager(args, fed, rank=r, size=n + 1,
-                                    backend="INPROC")
-               for r in range(1, n + 1)]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    for t in threads:
-        t.start()
-    server.run()
-    for t in threads:
-        t.join(timeout=60.0)
-    return server.result
+    return run_inproc_session(args, lambda: [
+        SplitNNServerManager(args, fed.num_classes, size=n + 1,
+                             backend="INPROC"),
+        *[SplitNNClientManager(args, fed, rank=r, size=n + 1,
+                               backend="INPROC")
+          for r in range(1, n + 1)]])

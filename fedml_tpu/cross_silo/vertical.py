@@ -23,6 +23,7 @@ server; only logit contributions (forward) and the shared logit gradient
 
 from __future__ import annotations
 
+import functools
 import logging
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -53,6 +54,7 @@ class VFLMsg:
     K_LOGITS = "logits"
     K_GRAD = "dlogits"
     K_ROUND = "round_idx"
+    K_SEQ = "seq"  # server-side total order; parties replay it exactly
 
 
 def _pool_train(fed) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -90,10 +92,9 @@ class VFLServerManager(FedMLCommManager):
                  backend: str = "INPROC"):
         super().__init__(args, comm, rank, size, backend)
         self.party_num = size - 1
-        _, y, m = _pool_train(fed)
+        x, y, m = _pool_train(fed)
         self.y = jnp.asarray(y)
         self.mask = jnp.asarray(m)
-        x, _, _ = _pool_train(fed)
         feat = x.shape[1]
         _, ty, tm = _pool_test(fed, feat)
         self.test_y = jnp.asarray(ty)
@@ -111,6 +112,8 @@ class VFLServerManager(FedMLCommManager):
         self._online: List[int] = []
         self._contribs: Dict[int, jnp.ndarray] = {}
         self._eval_contribs: Dict[int, jnp.ndarray] = {}
+        self._out_seq = 0  # total order over every S2P send (broadcasts
+        # are identical per party, so one counter covers all of them)
         self.history: List[Dict[str, Any]] = []
         self.result: Optional[dict] = None
         self._grad_step = jax.jit(self._grad_step_impl)
@@ -155,16 +158,28 @@ class VFLServerManager(FedMLCommManager):
         self.step_idx = 0
         self._send_batch()
 
+    def _broadcast(self, msg_type, **params) -> None:
+        """One logical broadcast event = one seq number: parties process
+        S2P messages strictly in seq order, so a transport that reorders
+        back-to-back sends (TCP opens a connection per message) cannot
+        make a party apply a gradient against the wrong batch."""
+        seq = self._out_seq
+        self._out_seq += 1
+        for rank in self._online:
+            m = Message(msg_type, self.rank, rank)
+            for key, val in params.items():
+                m.add_params(key, val)
+            m.add_params(VFLMsg.K_SEQ, seq)
+            self.send_message(m)
+
     def _send_batch(self) -> None:
         idx = self._perm[self.step_idx * self.bs:
                          (self.step_idx + 1) * self.bs]
         self._contribs = {}
         self._cur_idx = idx
-        for rank in self._online:
-            m = Message(VFLMsg.S2P_BATCH, self.rank, rank)
-            m.add_params(VFLMsg.K_IDX, np.asarray(idx))
-            m.add_params(VFLMsg.K_ROUND, self.round_idx)
-            self.send_message(m)
+        self._broadcast(VFLMsg.S2P_BATCH, **{
+            VFLMsg.K_IDX: np.asarray(idx),
+            VFLMsg.K_ROUND: self.round_idx})
 
     def _on_contrib(self, msg: Message) -> None:
         self._contribs[msg.get_sender_id()] = jnp.asarray(
@@ -174,11 +189,8 @@ class VFLServerManager(FedMLCommManager):
         total = sum(self._contribs.values())
         idx = jnp.asarray(self._cur_idx)
         loss, dlogits = self._grad_step(total, self.y[idx], self.mask[idx])
-        wire = np.asarray(dlogits)
-        for rank in self._online:
-            m = Message(VFLMsg.S2P_GRAD, self.rank, rank)
-            m.add_params(VFLMsg.K_GRAD, wire)
-            self.send_message(m)
+        self._broadcast(VFLMsg.S2P_GRAD,
+                        **{VFLMsg.K_GRAD: np.asarray(dlogits)})
         self.step_idx += 1
         if self.step_idx < self.steps:
             self._send_batch()
@@ -187,9 +199,7 @@ class VFLServerManager(FedMLCommManager):
         if (self.round_idx % self.freq == 0
                 or self.round_idx == self.rounds - 1):
             self._eval_contribs = {}
-            for rank in self._online:
-                self.send_message(Message(VFLMsg.S2P_EVALUATE, self.rank,
-                                          rank))
+            self._broadcast(VFLMsg.S2P_EVALUATE)
             return
         self.history.append({"round": self.round_idx})
         self._advance()
@@ -209,9 +219,7 @@ class VFLServerManager(FedMLCommManager):
     def _advance(self) -> None:
         self.round_idx += 1
         if self.round_idx >= self.rounds:
-            for rank in self._online:
-                self.send_message(Message(VFLMsg.S2P_FINISH, self.rank,
-                                          rank))
+            self._broadcast(VFLMsg.S2P_FINISH)
             last = next((r for r in reversed(self.history)
                          if "test_acc" in r), {})
             self.result = {"history": self.history,
@@ -245,6 +253,10 @@ class VFLPartyManager(FedMLCommManager):
         self._fwd = jax.jit(self.net.apply)
         self._upd = jax.jit(self._upd_impl)
         self._cur_idx: Optional[jnp.ndarray] = None
+        # in-order delivery: every S2P handler is funneled through the
+        # server's seq numbers; out-of-order arrivals wait here
+        self._pending: Dict[int, tuple] = {}
+        self._next_seq = 0
 
     def _upd_impl(self, p, x, dlogits):
         _, vjp = jax.vjp(lambda pp: self.net.apply(pp, x), p)
@@ -252,14 +264,26 @@ class VFLPartyManager(FedMLCommManager):
         return jax.tree_util.tree_map(lambda w, g: w - self.lr * g, p, gp)
 
     def register_message_receive_handlers(self) -> None:
-        self.register_message_receive_handler(VFLMsg.S2P_BATCH,
-                                              self._on_batch)
-        self.register_message_receive_handler(VFLMsg.S2P_GRAD,
-                                              self._on_grad)
-        self.register_message_receive_handler(VFLMsg.S2P_EVALUATE,
-                                              self._on_evaluate)
-        self.register_message_receive_handler(VFLMsg.S2P_FINISH,
-                                              self._on_finish)
+        for t, h in ((VFLMsg.S2P_BATCH, self._on_batch),
+                     (VFLMsg.S2P_GRAD, self._on_grad),
+                     (VFLMsg.S2P_EVALUATE, self._on_evaluate),
+                     (VFLMsg.S2P_FINISH, self._on_finish)):
+            self.register_message_receive_handler(
+                t, functools.partial(self._in_order, h))
+
+    def _in_order(self, handler, msg: Message) -> None:
+        """Process S2P messages strictly in the server's send order: the
+        gradient for batch t must be applied before batch t+1's forward,
+        and a transport may reorder back-to-back sends."""
+        seq = msg.get(VFLMsg.K_SEQ)
+        if seq is None:  # direct (non-broadcast) message: run immediately
+            handler(msg)
+            return
+        self._pending[int(seq)] = (handler, msg)
+        while self._next_seq in self._pending:
+            h, m = self._pending.pop(self._next_seq)
+            self._next_seq += 1
+            h(m)
 
     def run(self) -> None:
         self.send_message(Message(VFLMsg.P2S_ONLINE, self.rank, 0))
@@ -289,21 +313,10 @@ class VFLPartyManager(FedMLCommManager):
 
 
 def run_vfl_inproc(args, fed) -> Dict[str, Any]:
-    """Server + N feature parties as threads over the in-proc broker."""
-    import threading
-
-    from ..core.distributed.communication.inproc import InProcBroker
-    broker = InProcBroker()
-    args.inproc_broker = broker
+    """Server + N feature parties over the in-proc broker."""
+    from . import run_inproc_session
     n = int(getattr(args, "party_num", 2) or 2)
-    server = VFLServerManager(args, fed, size=n + 1, backend="INPROC")
-    parties = [VFLPartyManager(args, fed, rank=r, size=n + 1,
-                               backend="INPROC")
-               for r in range(1, n + 1)]
-    threads = [threading.Thread(target=p.run, daemon=True) for p in parties]
-    for t in threads:
-        t.start()
-    server.run()
-    for t in threads:
-        t.join(timeout=60.0)
-    return server.result
+    return run_inproc_session(args, lambda: [
+        VFLServerManager(args, fed, size=n + 1, backend="INPROC"),
+        *[VFLPartyManager(args, fed, rank=r, size=n + 1, backend="INPROC")
+          for r in range(1, n + 1)]])

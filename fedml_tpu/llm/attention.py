@@ -230,6 +230,19 @@ def _interp():
     return jax.default_backend() != "tpu"
 
 
+def _compiler_params():
+    """Raise the Mosaic scoped-VMEM cap above the 16 MiB default: the
+    kernels keep the full-length K/V refs resident, and at seq 8192 with
+    d=128 that sits a few hundred KiB over the default cap. v5e/v4 chips
+    have 128 MiB of VMEM; 64 MiB keeps headroom for double-buffering and
+    admits sequences to ~64k on one chip (ring attention shards beyond
+    that). None in interpret mode (TPU-only knob)."""
+    if _interp():
+        return None
+    import jax.experimental.pallas.tpu as pltpu
+    return pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+
+
 def _flash_fwd(q, k, v, mask, block_q: int, block_k: int):
     import jax.experimental.pallas as pl
 
@@ -258,6 +271,7 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int):
             jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
         ],
         interpret=_interp(),
+        compiler_params=_compiler_params(),
     )(qf, kf, vf, mask)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3), lse
 
@@ -292,6 +306,7 @@ def _flash_bwd(q, k, v, mask, o, lse, g, block_q: int, block_k: int):
         out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=_interp(),
+        compiler_params=_compiler_params(),
     )(qf, kf, vf, mask, gf, lse, dd)
 
     dk, dv = pl.pallas_call(
@@ -316,6 +331,7 @@ def _flash_bwd(q, k, v, mask, o, lse, g, block_q: int, block_k: int):
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         ],
         interpret=_interp(),
+        compiler_params=_compiler_params(),
     )(kf, vf, qf, mask, gf, lse, dd)
 
     unflat = lambda a: a.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -435,7 +451,17 @@ def ring_causal_attention(q, k, v, axis_name: str = "sp",
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    # TRAINING-MEMORY CONTRACT: the fold is rematerialized. Plain autodiff
+    # through the scan would save each step's [b, h, s_loc, s_loc]
+    # probability block as a residual — s_loc²·axis_size memory, erasing
+    # ring attention's point at exactly the context lengths it exists for.
+    # With remat the backward recomputes the block from the step's carry
+    # (K/V shards, O(s_loc·d)), so saved state stays O(axis_size·s_loc·d)
+    # and the s_loc² working block lives only transiently per step — the
+    # same guarantee the flash kernels give single-chip
+    # (test_ring_bwd_residuals_stay_linear_in_s).
     (o, m, l, _, _, _), _ = jax.lax.scan(
-        fold, (o0, m0, l0, k, v, kmask0), jnp.arange(axis_size))
+        jax.checkpoint(fold), (o0, m0, l0, k, v, kmask0),
+        jnp.arange(axis_size))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -207,6 +207,54 @@ def test_ring_gradients_match_dense():
                                    atol=2e-4)
 
 
+def test_ring_bwd_residuals_stay_linear_in_s():
+    """Training-memory contract for ring attention (VERDICT r4 item 3),
+    mirroring test_flash_bwd_never_materializes_scores: the fold is
+    rematerialized, so the backward must NOT stack the per-step
+    [s_loc, s_loc] probability block across the axis_size ring steps —
+    compiled temp memory stays well under the full [s, s] score matrix
+    (the un-remat'd form measures ~3x over this bound at s=4096 and the
+    gap grows with s)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from fedml_tpu.core.mesh import build_mesh
+
+    s, d, sp = 4096, 64, 4
+    mesh = build_mesh({"sp": sp}, devices=jax.devices()[:sp])
+    q = jnp.zeros((1, s, 1, d), jnp.float32)
+
+    def loss(q, k, v):
+        out = shard_map(
+            lambda a, b, c: ring_causal_attention(a, b, c, "sp", sp),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False)(q, k, v)
+        return out.sum()
+
+    compiled = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+        q, q, q).compile()
+    mem = compiled.memory_analysis()
+    if mem is None:
+        pytest.skip("backend reports no memory analysis")
+    scores_bytes = s * s * 4
+    assert mem.temp_size_in_bytes < scores_bytes // 2, (
+        f"ring bwd temp {mem.temp_size_in_bytes} vs full scores "
+        f"{scores_bytes} — remat contract broken")
+    # more shards -> smaller per-device block -> less temp memory: the
+    # property that lets context scale with chip count
+    mesh8 = build_mesh({"sp": 8}, devices=jax.devices()[:8])
+
+    def loss8(q, k, v):
+        out = shard_map(
+            lambda a, b, c: ring_causal_attention(a, b, c, "sp", 8),
+            mesh=mesh8, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False)(q, k, v)
+        return out.sum()
+
+    mem8 = jax.jit(jax.grad(loss8, argnums=(0, 1, 2))).lower(
+        q, q, q).compile().memory_analysis()
+    assert mem8.temp_size_in_bytes < mem.temp_size_in_bytes
+
+
 def test_ring_forward_full_model():
     """Sequence-parallel forward of the whole decoder matches the dense
     single-device forward (global RoPE positions + causal mask)."""
