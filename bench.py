@@ -117,7 +117,8 @@ def bench_flagship():
         dataset="cifar10", model="resnet56", precision="bfloat16",
         client_num_in_total=base_clients, client_num_per_round=base_clients,
         comm_round=1, epochs=1, batch_size=32, learning_rate=0.1,
-        frequency_of_the_test=10_000, random_seed=0, allow_synthetic=True,
+        frequency_of_the_test=-1,  # timing: no eval inside the timed call
+        random_seed=0, allow_synthetic=True,
         synthetic_size=6_250, max_total_samples=6_250,
     )
     bfed, _ = load(bargs)
@@ -205,6 +206,153 @@ def bench_time_to_acc(target_acc=0.90, max_rounds=80):
         "rounds_to_target": hit_round,
         "total_rounds": max_rounds,
         "total_s": round(total_s, 2),
+        "data_provenance": provenance,
+    }), flush=True)
+
+
+def bench_engine_mfu_resnet18():
+    """Engine MFU on an MXU-friendly federated CV workload (VERDICT r4
+    item 2): FedAvg ResNet-18 (64..512-wide channels), 64 clients/round,
+    bf16, fused 8-round dispatch — the proof that the ENGINE feeds the
+    MXU once operand shapes allow it, completing the flagship roofline
+    story (the ResNet-56 line's 6.9% is the workload's 16..64-wide
+    channels, BASELINE.md §3b). Reference counterpart: the NCCL
+    simulator's raison d'être
+    (``/root/reference/python/fedml/simulation/nccl/README.md:5``).
+    vs_baseline = per-sample-normalized speedup over the golden SP loop
+    on the same model."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.sp.simulator import SPSimulator
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    n_clients = 64
+    args = Arguments(
+        dataset="cifar10", model="resnet18", precision="bfloat16",
+        client_num_in_total=n_clients, client_num_per_round=n_clients,
+        comm_round=1, epochs=1, batch_size=32, learning_rate=0.1,
+        frequency_of_the_test=10_000, random_seed=0,
+        allow_synthetic=True, synthetic_size=50_000)
+    fed, output_dim = load(args)
+    provenance = getattr(fed, "provenance", "real")
+    bundle = create(args, output_dim)
+    spec = ClassificationTrainer(bundle.apply)
+    hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                       epochs=1)
+    opt = create_optimizer(args, spec)
+    sim = TPUSimulator(args, fed, bundle, opt, spec)
+    r = [0]
+    BLOCK = 8
+
+    def block():
+        sim.run_rounds_fused(r[0], BLOCK, hyper)
+        r[0] += BLOCK
+
+    block()
+    _force(sim.params)
+    # min-of-3: the tunneled chip occasionally hiccups for seconds at a
+    # time (remote compile service contention); the minimum is the
+    # engine's actual steady-state, and the trials are disclosed
+    trials = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        block()
+        _force(sim.params)
+        trials.append((time.perf_counter() - t0) / BLOCK)
+    round_s = min(trials)
+    flops = sim.round_cost_flops(hyper)
+    achieved_tflops = flops / round_s / 1e12
+    peak = _peak_tflops(jax.devices()[0])
+    mfu = (achieved_tflops / (peak * sim.n_devices)) if peak else None
+
+    # SP golden baseline at 1/8 workload, per-sample normalized (same
+    # honesty protocol as the flagship line)
+    bargs = Arguments(
+        dataset="cifar10", model="resnet18", precision="bfloat16",
+        client_num_in_total=8, client_num_per_round=8, comm_round=1,
+        epochs=1, batch_size=32, learning_rate=0.1,
+        frequency_of_the_test=-1,  # timing: no eval inside the timed call
+        random_seed=0, allow_synthetic=True, synthetic_size=6_250,
+        max_total_samples=6_250)
+    bfed, _ = load(bargs)
+    sp_sim = SPSimulator(bargs, bfed, bundle,
+                         create_optimizer(bargs, spec), spec)
+    sp_sim.run(comm_round=1)
+    _force(sp_sim.params)
+    t0 = time.perf_counter()
+    for _ in range(2):
+        sp_sim.run(comm_round=1)
+        _force(sp_sim.params)
+    sp_round_s = (time.perf_counter() - t0) / 2
+    vs_baseline = ((sp_round_s / float(bfed.total_train_samples))
+                   / (round_s / float(fed.total_train_samples)))
+    print(json.dumps({
+        "metric": "fedavg_resnet18_engine_mfu",
+        "value": round(mfu, 4) if mfu is not None else None,
+        "unit": f"MFU (FedAvg ResNet-18, 64 clients/round, bf16, fused "
+                f"8-round dispatch, {provenance} data)",
+        "vs_baseline": round(vs_baseline, 3),
+        "rounds_per_hour": round(3600.0 / round_s, 1),
+        "step_time_s": round(round_s, 4),
+        "tflops": round(achieved_tflops, 2),
+        "round_s_trials": [round(t, 4) for t in trials],
+        "sp_baseline_round_s": round(sp_round_s, 4),
+        "n_devices": sim.n_devices,
+        "data_provenance": provenance,
+        "mfu_vs_resnet56_line": "see fedavg_resnet56 line: same engine, "
+                                "workload-bound channels",
+    }), flush=True)
+
+
+def bench_hierarchical_femnist(global_rounds=2):
+    """BASELINE config 5: cross-device hierarchical FL, FEMNIST shapes
+    (28x28x1, 62 classes), MobileNetV3-Small — groups run
+    ``group_comm_round`` edge FedAvg rounds per global round, then the
+    edge models average (reference ``sp_hierarchicalfl_mnist_lr_example``
+    + ``data/FederatedEMNIST`` + ``model/cv/mobilenet.py``). Real FEMNIST
+    is a LEAF download (no egress here), so the stand-in is loudly
+    synthetic with the real shapes; throughput is shape-determined."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.runner import FedMLRunner
+
+    args = Arguments(
+        dataset="femnist", model="mobilenet", precision="bfloat16",
+        client_num_in_total=24, client_num_per_round=24,
+        comm_round=1, epochs=1, batch_size=16, learning_rate=0.05,
+        group_num=4, group_comm_round=2,
+        federated_optimizer="hierarchicalfl",
+        frequency_of_the_test=-1,  # timing: no eval inside the timed call
+        random_seed=0, allow_synthetic=True)
+    fed, output_dim = load(args)
+    provenance = getattr(fed, "provenance", "real")
+    bundle = create(args, output_dim)
+    runner = FedMLRunner(args, dataset=fed, model=bundle)
+    sim = runner.runner
+    sim.run(comm_round=1)  # warmup: compile (persistent-cached) + 1 round
+    _force(sim.params)
+    t0 = time.perf_counter()
+    for _ in range(global_rounds):
+        sim.run(comm_round=1)
+    _force(sim.params)
+    round_s = (time.perf_counter() - t0) / global_rounds
+    print(json.dumps({
+        "metric": "hierarchical_femnist_mobilenet_rounds_per_hour",
+        "value": round(3600.0 / round_s, 1),
+        "unit": f"global rounds/hour (24 clients, 4 groups x 2 edge "
+                f"rounds, MobileNetV3-Small, bf16, {provenance} data)",
+        "vs_baseline": None,
+        "step_time_s": round(round_s, 4),
         "data_provenance": provenance,
     }), flush=True)
 
@@ -437,6 +585,9 @@ def bench_long_context(seq_len=4096, steps=8, metric_suffix=""):
 def run():
     bench_flagship()
     for name, fn in (
+            ("fedavg_resnet18_engine_mfu", bench_engine_mfu_resnet18),
+            ("hierarchical_femnist_mobilenet_rounds_per_hour",
+             bench_hierarchical_femnist),
             ("fedavg_digits_time_to_90pct_s", bench_time_to_acc),
             ("fedopt_shakespeare_rnn_rounds_per_hour",
              bench_shakespeare_fedopt),

@@ -35,6 +35,38 @@ from .core import mlops
 
 __version__ = "0.1.0"
 
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache, on by default (opt out with
+    FEDML_TPU_NO_COMPILE_CACHE=1). On the tunneled TPU platform a deep
+    model's first jit goes through a remote compile service and can take
+    minutes (MobileNetV3 local-train: ~7 min); with the cache it is paid
+    once per (program, topology) ever, across processes."""
+    if os.environ.get("FEDML_TPU_NO_COMPILE_CACHE"):
+        return
+    try:
+        import jax
+        # the cache MUST be platform-scoped: under the tunnel, programs
+        # (including auxiliary CPU executables) are AOT-compiled on the
+        # remote terminal machine, and a local CPU process loading such
+        # an entry runs code built for a different CPU's features
+        # (observed: stalled collectives -> rendezvous abort). Keying the
+        # directory by the process's JAX_PLATFORMS keeps tunnel-compiled
+        # and host-compiled artifacts apart.
+        plat = (os.environ.get("JAX_PLATFORMS", "") or "default").replace(
+            ",", "_")
+        cache_dir = os.path.join(os.environ.get(
+            "FEDML_TPU_COMPILE_CACHE_DIR",
+            os.path.expanduser("~/.cache/fedml_tpu/jaxcache")), plat)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:  # never let cache setup break import
+        pass
+
+
+_enable_compile_cache()
+
 _logger_configured = False
 
 

@@ -4,7 +4,6 @@ device per ``args.role``, plus the in-proc session helper used by tests
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -69,19 +68,11 @@ def run_cross_device_inproc(args, fed, bundle,
                             ) -> Dict[str, Any]:
     """Server + N simulated devices as threads over the in-proc broker —
     the cross-device 'multi-node without a cluster' test mode."""
-    from ..core.distributed.communication.inproc import InProcBroker
-    broker = InProcBroker()
-    args.inproc_broker = broker
+    from ..cross_silo import run_inproc_session
     n = int(getattr(args, "client_num_per_round", 2))
-    server = build_device_server(args, fed, bundle, backend="INPROC")
-    engines = engines or [None] * n
-    devices = [build_device_client(args, fed, bundle, device_id=i + 1,
-                                   backend="INPROC", engine=engines[i])
-               for i in range(n)]
-    threads = [threading.Thread(target=d.run, daemon=True) for d in devices]
-    for t in threads:
-        t.start()
-    server.run()
-    for t in threads:
-        t.join(timeout=30.0)
-    return server.result
+    engs = engines or [None] * n
+    return run_inproc_session(args, lambda: [
+        build_device_server(args, fed, bundle, backend="INPROC"),
+        *[build_device_client(args, fed, bundle, device_id=i + 1,
+                              backend="INPROC", engine=engs[i])
+          for i in range(n)]], join_timeout_s=30.0)

@@ -246,10 +246,13 @@ def load(args) -> Tuple[FederatedDataset, int]:
             # dataset's per-client workload (bench representativeness)
             n_train = max(num_clients * 2 * bs, 4000,
                           int(getattr(args, "synthetic_size", 0) or 0))
+            # synthetic_test_size: tiny-run harnesses (the examples gate)
+            # shrink the eval set too — a 1000-sample resnet eval on the
+            # virtual CPU mesh costs minutes
+            n_test = int(getattr(args, "synthetic_test_size", 0) or 1000)
             x, y = synthetic.make_classification(
-                n_train + 1000, n_feat, n_classes,
+                n_train + n_test, n_feat, n_classes,
                 seed=gen_seed, noise=2.5, flat=flat, image_shape=shape)
-            n_test = 1000
             xtr, ytr, xte, yte = x[:-n_test], y[:-n_test], x[-n_test:], y[-n_test:]
             provenance = "synthetic"
         xtr, ytr = _cap_train(xtr, ytr, args, seed)

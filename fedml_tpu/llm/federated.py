@@ -86,8 +86,10 @@ class LLMBundle:
         return self._apply(params, x, rng=rng, train=train)
 
 
-def build_llm(args) -> Tuple[Any, LLMBundle, CausalLMTrainer, ByteTokenizer]:
-    """→ (fed_dataset, bundle, trainer_spec, tokenizer)."""
+def build_llm_bundle(args) -> Tuple[LLMBundle, ByteTokenizer]:
+    """Model-only build (no dataset): what serving replicas need — a
+    replica restart must not pay corpus construction just to rebuild the
+    bundle an artifact's params plug into."""
     cfg = llm_config_from_args(args)
     rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
     module, base_params = init_llm(cfg, rng)
@@ -95,8 +97,15 @@ def build_llm(args) -> Tuple[Any, LLMBundle, CausalLMTrainer, ByteTokenizer]:
     alpha = float(getattr(args, "lora_alpha", 16.0))
     bundle = LLMBundle(module, cfg,
                        base_params if rank > 0 else None, rank, alpha)
+    return bundle, ByteTokenizer()
+
+
+def build_llm(args) -> Tuple[Any, LLMBundle, CausalLMTrainer, ByteTokenizer]:
+    """→ (fed_dataset, bundle, trainer_spec, tokenizer)."""
+    bundle, _ = build_llm_bundle(args)
     n_silos = int(getattr(args, "client_num_in_total", 2))
-    fed, tokenizer = build_llm_federated(args, n_silos, cfg.max_seq_len)
+    fed, tokenizer = build_llm_federated(args, n_silos,
+                                         bundle.cfg.max_seq_len)
     spec = CausalLMTrainer(bundle.apply)
     return fed, bundle, spec, tokenizer
 

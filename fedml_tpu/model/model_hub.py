@@ -26,7 +26,13 @@ class ModelBundle:
     compute_dtype: Any = jnp.float32
 
     def init(self, rng: jax.Array, sample_input: jnp.ndarray) -> PyTree:
-        variables = self.module.init(rng, sample_input, train=False)
+        # jit the init: eager tracing pays one device round-trip per op,
+        # which on the tunneled TPU platform turns a deep model's init
+        # (MobileNetV3: hundreds of ops) into MINUTES; compiled it is one
+        # dispatch. eval_shape-free — shapes come from the sample input.
+        variables = jax.jit(
+            lambda r, x: self.module.init(r, x, train=False)
+        )(rng, sample_input)
         return variables["params"]
 
     def apply(self, params: PyTree, x: jnp.ndarray, rng: Optional[jax.Array] = None,

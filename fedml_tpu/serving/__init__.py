@@ -115,8 +115,14 @@ class FedMLInferenceRunner:
     thread and returns the bound port; ``run()`` blocks."""
 
     def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 extra_routes: Optional[dict] = None):
         self.predictor = predictor
+        # POST routes: path -> callable(json_request) -> json_response.
+        # /predict is always mounted; templates mount more (e.g. the LLM
+        # template's /v1/chat/completions)
+        self.routes = {"/predict": predictor.predict}
+        self.routes.update(extra_routes or {})
         runner = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -139,13 +145,14 @@ class FedMLInferenceRunner:
                     self._reply(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/predict":
+                handler = runner.routes.get(self.path)
+                if handler is None:
                     self._reply(404, {"error": "not found"})
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(n) or b"{}")
-                    self._reply(200, runner.predictor.predict(request))
+                    self._reply(200, handler(request))
                 except Exception as e:
                     logger.exception("predict failed")
                     self._reply(500, {"error": str(e)})

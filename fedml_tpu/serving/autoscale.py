@@ -148,15 +148,18 @@ class SubprocessReplica:
 
 
 def subprocess_replica_factory(args, params_path: str, output_dim: int,
-                               workdir: str, platform: str = "cpu"):
+                               workdir: str, platform: str = "cpu",
+                               kind: str = "classifier"):
     """Build a ``replica_factory`` for :class:`ReplicaSet`: each call
     yields a fresh un-started :class:`SubprocessReplica` serving the given
-    model artifact."""
+    model artifact. ``kind='causal_lm'`` makes every replica an LLM
+    template server (chat route mounted) instead of a classifier."""
     import os
     spec = {"args": {k: v for k, v in vars(args).items()
                      if isinstance(v, (str, int, float, bool, type(None)))},
             "params_path": os.path.abspath(params_path),
-            "output_dim": int(output_dim), "platform": platform}
+            "output_dim": int(output_dim), "platform": platform,
+            "kind": kind}
     os.makedirs(workdir, exist_ok=True)
     spec_path = os.path.join(workdir, "replica_spec.json")
     with open(spec_path, "w") as f:
@@ -171,12 +174,15 @@ class ReplicaSet:
     ``replica_factory`` (see :class:`SubprocessReplica`)."""
 
     def __init__(self, predictor_factory=None, min_replicas: int = 1,
-                 max_replicas: int = 8, replica_factory=None):
+                 max_replicas: int = 8, replica_factory=None,
+                 runner_cls=None):
         from . import FedMLInferenceRunner
         if (predictor_factory is None) == (replica_factory is None):
             raise ValueError("pass exactly one of predictor_factory / "
                              "replica_factory")
-        self._runner_cls = FedMLInferenceRunner
+        # runner_cls lets templates mount extra routes on every replica
+        # (e.g. the LLM template's ChatCompletionRunner)
+        self._runner_cls = runner_cls or FedMLInferenceRunner
         self.predictor_factory = predictor_factory
         self.replica_factory = replica_factory
         self.min_replicas = int(min_replicas)
@@ -328,7 +334,10 @@ class Gateway:
         self._lock = threading.Lock()
         self._events: Deque[Tuple[float, float]] = deque()  # (ts, latency)
 
-    def predict(self, request: dict, timeout: float = 30.0) -> dict:
+    def predict(self, request: dict, timeout: float = 30.0,
+                path: str = "/predict") -> dict:
+        """Route one request to a replica; ``path`` selects the replica
+        route (e.g. ``/v1/chat/completions`` on LLM replicas)."""
         body = json.dumps(request).encode()
         t0 = time.perf_counter()
         # one retry on a CONNECTION-PHASE failure only (replica swapped or
@@ -344,7 +353,7 @@ class Gateway:
                 port = ports[self._i % len(ports)]
                 self._i += 1
             req = urllib.request.Request(
-                f"http://127.0.0.1:{port}/predict", data=body,
+                f"http://127.0.0.1:{port}{path}", data=body,
                 headers={"Content-Type": "application/json"})
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as r:

@@ -36,14 +36,27 @@ def main() -> None:
     import jax
     jax.config.update("jax_platforms",
                       os.environ["JAX_PLATFORMS"].split(",")[0])
+    # re-key the platform-scoped compile cache: the package import (and
+    # its cache setup) happened under the PARENT's JAX_PLATFORMS — host
+    # executables must not land in the tunnel-compiled cache dir
+    from fedml_tpu import _enable_compile_cache
+    _enable_compile_cache()
 
     from types import SimpleNamespace
     from . import CheckpointPredictor, FedMLInferenceRunner
 
     args = SimpleNamespace(**spec["args"])
-    predictor = CheckpointPredictor.from_files(
-        args, spec["params_path"], int(spec["output_dim"]))
-    runner = FedMLInferenceRunner(predictor)
+    if spec.get("kind") == "causal_lm":
+        # LLM template replica: chat route mounted, artifact + bundle
+        # rebuilt from the spec's flat config
+        from .llm_template import CausalLMPredictor, ChatCompletionRunner
+        predictor = CausalLMPredictor.from_artifact(
+            args, spec["params_path"])
+        runner = ChatCompletionRunner(predictor)
+    else:
+        predictor = CheckpointPredictor.from_files(
+            args, spec["params_path"], int(spec["output_dim"]))
+        runner = FedMLInferenceRunner(predictor)
     port = runner.start()
     port_file = spec.get("port_file")
     if port_file:

@@ -28,6 +28,28 @@ from ..sampling import client_sampling
 logger = logging.getLogger(__name__)
 
 
+# Module-level jitted helpers (NOT methods with a static self: jit's cache
+# would strongly retain every simulator instance — dataset and all — for
+# process lifetime, and share no compilations between instances).
+@jax.jit
+def _apply_updates(params, updates, weights):
+    """Stack + weighted-average + apply as ONE compiled program: done
+    eagerly this is 3 device ops per leaf, and on the tunneled TPU
+    platform each first-seen eager op costs a remote compile — a deep
+    model (MobileNet: ~150 leaves) turned the first round into minutes."""
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *updates)
+    agg = tree_weighted_average(stacked, jnp.stack(weights))
+    return (jax.tree_util.tree_map(jnp.add, params, agg),
+            jnp.sum(jnp.stack(weights)))
+
+
+@jax.jit
+def _average_groups(group_params, group_weights):
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *group_params)
+    return tree_weighted_average(stacked, group_weights)
+
+
 class HierarchicalSimulator:
     """``group_num`` edge aggregators, ``group_comm_round`` edge rounds per
     global round."""
@@ -63,10 +85,8 @@ class HierarchicalSimulator:
                                     key, hyper)
             updates.append(out.update)
             weights.append(out.weight)
-        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *updates)
-        agg = tree_weighted_average(stacked, jnp.stack(weights))
-        return (jax.tree_util.tree_map(jnp.add, params, agg),
-                float(jnp.sum(jnp.stack(weights))))
+        new_params, total_w = _apply_updates(params, updates, weights)
+        return new_params, float(total_w)
 
     def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
         args = self.args
@@ -94,13 +114,14 @@ class HierarchicalSimulator:
                         hyper.replace(round_idx=jnp.int32(round_idx)))
                 group_params.append(gp)
                 group_weights.append(gw)
-            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
-                                             *group_params)
-            self.params = tree_weighted_average(
-                stacked, jnp.asarray(group_weights, jnp.float32))
+            self.params = _average_groups(
+                group_params, jnp.asarray(group_weights, jnp.float32))
             rec: Dict[str, Any] = {"round": round_idx}
             freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
-            if round_idx % freq == 0 or round_idx == rounds - 1:
+            # freq < 0: never evaluate in-loop (bench timing mode —
+            # a per-round full-test eval would pollute round_s)
+            if freq > 0 and (round_idx % freq == 0
+                             or round_idx == rounds - 1):
                 stats = self._evaluate(self.params, self.fed.test["x"],
                                        self.fed.test["y"], self.fed.test["mask"])
                 n = max(float(stats["count"]), 1.0)
@@ -108,7 +129,9 @@ class HierarchicalSimulator:
                 logger.info("hierarchical round %d: acc=%.4f", round_idx,
                             rec["test_acc"])
             self.history.append(rec)
-        last_eval = next(r for r in reversed(self.history) if "test_acc" in r)
+        last_eval = next((r for r in reversed(self.history)
+                          if "test_acc" in r), {})
         return {"params": self.params, "history": self.history,
                 "wall_time_s": time.time() - t0,
-                "final_test_acc": last_eval["test_acc"], "rounds": rounds}
+                "final_test_acc": last_eval.get("test_acc"),
+                "rounds": rounds}

@@ -184,7 +184,10 @@ class SPSimulator:
             rec["train_loss"] = float(tm["loss_sum"]) / cnt
             rec["train_acc"] = float(tm["correct"]) / cnt
             freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
-            if round_idx % freq == 0 or round_idx == rounds - 1:
+            # freq < 0: never evaluate in-loop (bench timing mode —
+            # a per-round full-test eval would pollute round_s)
+            if freq > 0 and (round_idx % freq == 0
+                             or round_idx == rounds - 1):
                 stats = self._evaluate(self.params, self.fed.test["x"],
                                        self.fed.test["y"], self.fed.test["mask"])
                 n = max(float(stats["count"]), 1.0)
@@ -201,12 +204,18 @@ class SPSimulator:
         last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
                          None)
         if last_eval is None:
-            # resumed past the final round: evaluate the restored params
-            stats = self._evaluate(self.params, self.fed.test["x"],
-                                   self.fed.test["y"], self.fed.test["mask"])
-            n = max(float(stats["count"]), 1.0)
-            last_eval = {"test_acc": float(stats["correct"]) / n,
-                         "test_loss": float(stats["loss_sum"]) / n}
+            if int(getattr(self.args, "frequency_of_the_test", 5) or 5) <= 0:
+                # bench timing mode (freq < 0): no eval, in-loop or here —
+                # an implicit final eval would pollute the timed call
+                last_eval = {"test_acc": None}
+            else:
+                # resumed past the final round: evaluate the restored params
+                stats = self._evaluate(self.params, self.fed.test["x"],
+                                       self.fed.test["y"],
+                                       self.fed.test["mask"])
+                n = max(float(stats["count"]), 1.0)
+                last_eval = {"test_acc": float(stats["correct"]) / n,
+                             "test_loss": float(stats["loss_sum"]) / n}
         result = {"params": self.params, "history": self.history,
                   "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
                   "final_test_loss": last_eval.get("test_loss"),

@@ -13,8 +13,16 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "collective_call_terminate" not in flags:
+    # XLA:CPU kills the process when a collective waits >40 s for a slow
+    # peer. On the virtual 8-device mesh a conv-heavy example (resnet18)
+    # legitimately keeps busy devices computing for minutes while padded
+    # devices idle at the all-reduce — raise the limits; slowness on a
+    # TEST mesh is not an error condition.
+    flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+              " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 

@@ -1,14 +1,24 @@
-"""Every shipped example config must parse and dispatch to a real runner
-(the heavy ones aren't trained here — config validity + runner wiring is
-the contract; the digits example IS run end-to-end)."""
+"""Every shipped example EXECUTES at least one training round (VERDICT
+r4 item 9 — parse-only checks let a yaml whose workload breaks at round 1
+pass the gate). Heavy knobs are shrunk (1 round, few clients, synthetic
+stand-ins allowed) but each example runs through its real runner path:
+simulation examples through ``run_simulation``/``run_federated_llm``,
+cross-silo and serving through the Message FSM over the in-proc broker,
+cross-device through the device session (native engine included).
+Reference counterpart: ``tests/test_federate/test_federate.sh``."""
 
+import copy
 import glob
+import json
 import os
+import urllib.request
 
 import pytest
 
 import fedml_tpu
 from fedml_tpu.arguments import load_arguments
+
+pytestmark = pytest.mark.slow
 
 EXAMPLES = sorted(glob.glob(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -19,19 +29,151 @@ def test_examples_exist():
     assert len(EXAMPLES) >= 10
 
 
+def _shrink(args, tmp_path):
+    """Tiny-run overrides: the contract is 'the config's workload trains',
+    not 'it converges'."""
+    args.comm_round = 1
+    args.epochs = 1
+    args.client_num_in_total = min(int(args.client_num_in_total), 4)
+    args.client_num_per_round = min(int(args.client_num_per_round),
+                                    int(args.client_num_in_total))
+    args.frequency_of_the_test = 1
+    args.allow_synthetic = True
+    # tiny: on the 8-device virtual CPU mesh, a heavy per-device workload
+    # (resnet18) with padded idle devices can trip XLA:CPU's 40 s
+    # collective-rendezvous termination timeout
+    args.synthetic_size = 64
+    args.max_total_samples = 64  # the synthetic fallback floors at 4000
+    args.synthetic_test_size = 64
+    args.batch_size = min(int(args.batch_size), 8)
+    args.data_cache_dir = str(tmp_path)
+    return args
+
+
+def _run_simulation_example(args):
+    if str(args.model) == "causal_lm":
+        from fedml_tpu.llm.federated import run_federated_llm
+        args.llm_hidden_size = 32
+        args.llm_num_layers = 1
+        args.llm_num_heads = 2
+        args.llm_intermediate_size = 64
+        args.llm_max_seq_len = 64
+        return run_federated_llm(args)
+    backend = str(getattr(args, "backend", "tpu")).lower()
+    backend = backend if backend in ("sp", "tpu") else "tpu"
+    return fedml_tpu.run_simulation(backend=backend, args=args)
+
+
+def _run_cross_silo_example(args):
+    """Server + silo clients as threads over the in-proc broker, through
+    the SAME CrossSiloRunner dispatch a per-process deployment uses (so
+    SecAgg/LSA examples exercise their full message FSMs)."""
+    from fedml_tpu.cross_silo import run_inproc_session
+    from fedml_tpu.cross_silo.horizontal.runner import CrossSiloRunner
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import model as model_mod
+    args.backend = "INPROC"
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    n = int(args.client_num_per_round)
+
+    def build():
+        managers = []
+        for role, rank in [("server", 0)] + [("client", r)
+                                             for r in range(1, n + 1)]:
+            a = copy.copy(args)
+            a.role, a.rank = role, rank
+            managers.append(CrossSiloRunner(a, fed, bundle).manager)
+        return managers
+
+    return run_inproc_session(args, build)
+
+
+def _run_cross_device_example(args):
+    from fedml_tpu.cross_device.runner import run_cross_device_inproc
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import model as model_mod
+    args.backend = "INPROC"
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    engines = None
+    if str(getattr(args, "device_engine", "")) == "native":
+        engines = ["native"] + [None] * (int(args.client_num_per_round) - 1)
+    return run_cross_device_inproc(args, fed, bundle, engines=engines)
+
+
+def _run_serving_example(args):
+    from fedml_tpu.cross_silo import run_inproc_session
+    from fedml_tpu.cross_silo.horizontal.runner import CrossSiloRunner
+    from fedml_tpu.runner import FedMLRunner
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import model as model_mod
+    args.backend = "INPROC"
+    args.serving_block = False  # the gate must not block on a live server
+    args.serving_port = 0
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    n = int(args.client_num_per_round)
+    box = {}
+
+    def build():
+        sa = copy.copy(args)
+        sa.role, sa.rank = "server", 0
+        server = FedMLRunner(sa, dataset=fed, model=bundle).runner
+
+        class ServerShim:  # capture the serving runner's return value
+            def run(self):
+                box["result"] = server.run()
+
+        clients = []
+        for r in range(1, n + 1):
+            a = copy.copy(args)
+            a.role, a.rank = "client", r
+            clients.append(CrossSiloRunner(a, fed, bundle).manager)
+        return [ServerShim()] + clients
+
+    run_inproc_session(args, build)
+    result = box.get("result")
+    assert result and result.get("serving_port")
+    # the endpoint is LIVE: round-trip a prediction on one test example
+    import numpy as np
+    sample = [np.asarray(fed.test["x"][0, 0], np.float32).reshape(-1)
+              .tolist()]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{result['serving_port']}/predict",
+        data=json.dumps({"inputs": sample}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        out = json.load(r)
+    assert "classes" in out
+    return result
+
+
 @pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: "/".join(
     p.split(os.sep)[-3:-1]))
-def test_example_config_parses_and_dispatches(path):
-    args = load_arguments(path)
-    assert args.training_type in ("simulation", "cross_silo", "cross_cloud",
-                                  "cross_device", "fedml_serving")
-    # simulation configs must resolve their model (heavy data not loaded)
-    if args.training_type == "simulation" and args.model != "causal_lm":
-        from fedml_tpu.model import create
-        create(args, 10)
+def test_example_trains_one_round(path, tmp_path):
+    args = _shrink(load_arguments(path), tmp_path)
+    ttype = str(args.training_type)
+    if ttype == "simulation":
+        result = _run_simulation_example(args)
+    elif ttype in ("cross_silo", "cross_cloud"):
+        result = _run_cross_silo_example(args)
+    elif ttype == "cross_device":
+        result = _run_cross_device_example(args)
+    elif ttype == "fedml_serving":
+        result = _run_serving_example(args)
+    else:
+        pytest.fail(f"unknown training_type {ttype!r}")
+    assert isinstance(result, dict), result
+    hist = result.get("history")
+    assert hist, f"{path} trained no rounds: {result}"
+    acc = result.get("final_test_acc")
+    assert acc is None or 0.0 <= acc <= 1.0
 
 
 def test_digits_example_end_to_end(tmp_path):
+    """The digits example keeps its stronger contract: real data, 8
+    rounds, real accuracy."""
     path = [p for p in EXAMPLES if "digits" in p][0]
     args = load_arguments(path)
     args.comm_round = 8
