@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import shlex
 import signal
@@ -34,6 +35,8 @@ import zipfile
 from typing import Any, Dict, List, Optional
 
 import yaml
+
+logger = logging.getLogger(__name__)
 
 
 def _runs_root() -> str:
@@ -103,6 +106,22 @@ def launch_job(yaml_file: str, api_key: Optional[str] = None,
     rdir = _run_dir(run_id)
     os.makedirs(rdir, exist_ok=True)
 
+    # resource matching (reference scheduler_matcher.py consulted at
+    # launch): a `computing: {device_slots: N}` section claims capacity
+    # in the sqlite allocation store; no fit = the launch fails loudly
+    device_id = None
+    slots = int((spec.get("computing") or {}).get("device_slots", 0) or 0)
+    if slots > 0:
+        from .scheduler import default_db
+        device_id = default_db().allocate(run_id, slots)
+        if device_id is None:
+            _write_meta(run_id, {
+                "run_id": run_id, "yaml": yaml_file,
+                "status": STATUS_FAILED,
+                "error": f"no device with {slots} free slots"})
+            return LaunchResult(
+                run_id, -1, f"no device with {slots} free slots")
+
     if "job" in spec:  # task job: shell command in a workspace
         workspace = os.path.expanduser(str(spec.get("workspace", ".")))
         if not os.path.isabs(workspace):
@@ -140,6 +159,7 @@ def launch_job(yaml_file: str, api_key: Optional[str] = None,
                                     stderr=subprocess.STDOUT,
                                     start_new_session=True)
     except OSError as e:  # e.g. workspace directory does not exist
+        _release_allocation(run_id)
         _write_meta(run_id, {
             "run_id": run_id, "kind": kind, "yaml": yaml_file,
             "workspace": workspace, "pid": -1, "started": time.time(),
@@ -151,6 +171,8 @@ def launch_job(yaml_file: str, api_key: Optional[str] = None,
         "cmd": " ".join(shlex.quote(c) for c in cmd),
         "workspace": workspace, "pid": proc.pid,
         "started": time.time(), "status": STATUS_RUNNING,
+        **({"device_id": device_id, "device_slots": slots}
+           if device_id else {}),
     })
     # remote observability: ship this run's log to the configured log
     # server (reference mlops_runtime_log_daemon.py:333 tails + uploads)
@@ -177,12 +199,22 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def _release_allocation(run_id: str) -> None:
+    """Free the run's resource claim; cheap no-op when it holds none."""
+    try:
+        from .scheduler import default_db
+        default_db().release(run_id)
+    except Exception:  # the allocation store must never break run paths
+        logger.exception("could not release allocation for %s", run_id)
+
+
 def _finalize(run_id: str, rc: Optional[int]) -> None:
     meta = _read_meta(run_id) or {}
     meta["status"] = STATUS_FINISHED if rc == 0 else STATUS_FAILED
     meta["exit_code"] = rc
     meta["ended"] = time.time()
     _write_meta(run_id, meta)
+    _release_allocation(run_id)
 
 
 def run_status(run_id: str) -> Optional[str]:
@@ -240,6 +272,7 @@ def run_stop(run_id: str) -> bool:
     meta["status"] = STATUS_KILLED
     meta["ended"] = time.time()
     _write_meta(run_id, meta)
+    _release_allocation(run_id)
     return True
 
 

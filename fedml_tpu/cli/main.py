@@ -135,6 +135,28 @@ def broker_cmd(port, insecure_open):
     b.stop()
 
 
+@cli.command("monitor", help="Run the job monitor daemon: detects runs "
+                             "whose process died without an exit record, "
+                             "releases their resource allocations, and "
+                             "restarts jobs that opted in (restart: true)")
+@click.option("--interval", type=float, default=2.0,
+              help="seconds between registry scans")
+@click.option("--max-restarts", type=int, default=3,
+              help="restart cap per job lineage")
+def monitor_cmd(interval, max_restarts):
+    import signal
+    import threading
+    from ..api.scheduler import JobMonitor
+    mon = JobMonitor(interval_s=interval, max_restarts=max_restarts).start()
+    click.echo(f"job monitor running (interval {interval}s, "
+               f"max_restarts {max_restarts})")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    mon.stop()
+
+
 @cli.group("run", help="Inspect and control runs")
 def run_group():
     pass

@@ -675,9 +675,16 @@ class TPUSimulator:
         rpd = max(int(getattr(args, "rounds_per_dispatch", 8) or 1), 1)
         round_idx = start_round
         while round_idx < rounds:
-            # run up to (and including) the next eval/checkpoint boundary
-            next_eval = (round_idx if round_idx % freq == 0
-                         else (round_idx // freq + 1) * freq)
+            # run up to (and including) the next eval/checkpoint boundary.
+            # freq <= 0 = never evaluate in-loop (bench timing mode; note
+            # x % -1 == 0 for every x, so -1 must not reach the modulo —
+            # it would force n_block=1 AND eval every round, the exact
+            # inverse of the intent)
+            if freq <= 0:
+                next_eval = rounds - 1
+            else:
+                next_eval = (round_idx if round_idx % freq == 0
+                             else (round_idx // freq + 1) * freq)
             stop = min(next_eval, rounds - 1, round_idx + rpd - 1)
             if self.ckpt.enabled:
                 # maybe_save fires when (r + 1) % every == 0 — the block
@@ -695,7 +702,7 @@ class TPUSimulator:
                 cnt = max(float(metrics["count"]), 1.0)
                 rec["train_loss"] = float(metrics["loss_sum"]) / cnt
                 rec["train_acc"] = float(metrics["correct"]) / cnt
-                if r % freq == 0 or r == rounds - 1:
+                if freq > 0 and (r % freq == 0 or r == rounds - 1):
                     stats = self._evaluate(self.params, self.fed.test["x"],
                                            self.fed.test["y"],
                                            self.fed.test["mask"])
@@ -714,11 +721,15 @@ class TPUSimulator:
         last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
                          None)
         if last_eval is None:
-            stats = self._evaluate(self.params, self.fed.test["x"],
-                                   self.fed.test["y"], self.fed.test["mask"])
-            n = max(float(stats["count"]), 1.0)
-            last_eval = {"test_acc": float(stats["correct"]) / n,
-                         "test_loss": float(stats["loss_sum"]) / n}
+            if freq <= 0:  # timing mode: no eval, in-loop or here
+                last_eval = {"test_acc": None}
+            else:
+                stats = self._evaluate(self.params, self.fed.test["x"],
+                                       self.fed.test["y"],
+                                       self.fed.test["mask"])
+                n = max(float(stats["count"]), 1.0)
+                last_eval = {"test_acc": float(stats["correct"]) / n,
+                             "test_loss": float(stats["loss_sum"]) / n}
         result = {"params": self.params, "history": self.history,
                   "wall_time_s": wall, "final_test_acc": last_eval["test_acc"],
                   "final_test_loss": last_eval.get("test_loss"),

@@ -1,0 +1,108 @@
+"""Quantify the fused-dispatch advantage robust mode forfeits
+(VERDICT r4 item 10, option b).
+
+Robust/defended rounds run per-round by design: the collect -> defend ->
+server-update pipeline crosses the host between jitted stages (ordering
+rows by sampled ids, attacker masks, contribution bookkeeping), so the
+multi-round ``lax.scan`` fusion (one dispatch per 8 rounds) cannot wrap
+it. This script measures what that costs on the flagship shape, printing
+three legs:
+
+  fused          run_rounds_fused, 8 rounds/dispatch (production default)
+  per_round      same engine, no defense, one dispatch per round
+  defended       multi-krum defense on (robust collect path), per round
+
+Defended overhead = defended - per_round (defense compute + collect
+path); forfeited fusion = per_round - fused (the dispatch amortization).
+Results are recorded in BASELINE.md §"Robust-mode dispatch cost".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def measure(n_clients=16, rounds_per_leg=8):
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    def args_for(defended: bool):
+        kw = dict(
+            dataset="cifar10", model="resnet56", precision="bfloat16",
+            client_num_in_total=n_clients, client_num_per_round=n_clients,
+            comm_round=rounds_per_leg, epochs=1, batch_size=32,
+            learning_rate=0.1, frequency_of_the_test=-1, random_seed=0,
+            allow_synthetic=True, synthetic_size=4000,
+            max_total_samples=4000)
+        if defended:
+            kw.update(enable_defense=True, defense_type="multi_krum",
+                      byzantine_client_num=2, krum_param_m=4)
+        return Arguments(**kw)
+
+    def force(sim):
+        return float(jax.tree_util.tree_leaves(sim.params)[0].sum())
+
+    def build(defended: bool):
+        a = args_for(defended)
+        fed, output_dim = load(a)
+        bundle = create(a, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        return a, TPUSimulator(a, fed, bundle, create_optimizer(a, spec),
+                               spec)
+
+    hyper = TrainHyper(learning_rate=jnp.float32(0.1), epochs=1)
+    out = {}
+
+    # fused (8 rounds per dispatch)
+    _, sim = build(False)
+    sim.run_rounds_fused(0, rounds_per_leg, hyper)
+    force(sim)
+    t0 = time.perf_counter()
+    sim.run_rounds_fused(rounds_per_leg, rounds_per_leg, hyper)
+    force(sim)
+    out["fused_round_s"] = (time.perf_counter() - t0) / rounds_per_leg
+
+    # per-round, undefended
+    _, sim = build(False)
+    sim.run_round(0, hyper)
+    force(sim)
+    t0 = time.perf_counter()
+    for r in range(1, rounds_per_leg + 1):
+        sim.run_round(r, hyper)
+    force(sim)
+    out["per_round_s"] = (time.perf_counter() - t0) / rounds_per_leg
+
+    # per-round, defended (robust collect path + multi-krum)
+    _, sim = build(True)
+    sim.run_round(0, hyper)
+    force(sim)
+    t0 = time.perf_counter()
+    for r in range(1, rounds_per_leg + 1):
+        sim.run_round(r, hyper)
+    force(sim)
+    out["defended_round_s"] = (time.perf_counter() - t0) / rounds_per_leg
+
+    out["forfeited_fusion_s"] = out["per_round_s"] - out["fused_round_s"]
+    out["defense_overhead_s"] = (out["defended_round_s"]
+                                 - out["per_round_s"])
+    out["defended_vs_fused"] = out["defended_round_s"] / out["fused_round_s"]
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps({k: round(v, 4) for k, v in measure().items()},
+                     indent=2))

@@ -1,0 +1,227 @@
+"""Resource scheduler + job monitor (VERDICT r4 item 7): sqlite
+allocation store with a matcher consulted by launch_job, and a periodic
+monitor that detects SIGKILLed runs, releases their capacity, and
+restarts opted-in jobs."""
+
+import os
+import signal
+import textwrap
+import time
+
+import pytest
+
+from fedml_tpu import api
+from fedml_tpu.api.scheduler import JobMonitor, ResourceDB, _reset_default_db
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def registry(tmp_path, monkeypatch):
+    monkeypatch.setenv("FEDML_TPU_RUNS_DIR", str(tmp_path / "runs"))
+    monkeypatch.setenv("FEDML_TPU_LOCAL_SLOTS", "2")
+    _reset_default_db()
+    yield tmp_path
+    _reset_default_db()
+
+
+def _yaml(tmp_path, body, name="job.yaml"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+class TestResourceDB:
+    def test_register_match_allocate_release(self, registry):
+        db = ResourceDB(str(registry / "r.db"))
+        db.register_device("tpu-a", 4)
+        db.register_device("tpu-b", 2)
+        # matcher: most free slots that fit
+        assert db.match(3) == "tpu-a"
+        assert db.allocate("run1", 3) == "tpu-a"
+        assert db.free_slots("tpu-a") == 1
+        # next 2-slot job must land on b (a has only 1 free)
+        assert db.allocate("run2", 2) == "tpu-b"
+        # nothing fits 2 anymore
+        assert db.allocate("run3", 2) is None
+        assert db.release("run1") is True
+        assert db.free_slots("tpu-a") == 4
+        assert db.allocate("run3", 2) == "tpu-a"
+        allocs = {a["run_id"]: a["device_id"] for a in db.allocations()}
+        assert allocs == {"run2": "tpu-b", "run3": "tpu-a"}
+
+    def test_release_unknown_is_false(self, registry):
+        db = ResourceDB(str(registry / "r2.db"))
+        assert db.release("nope") is False
+
+
+class TestLaunchIntegration:
+    def test_launch_claims_and_releases_capacity(self, registry):
+        from fedml_tpu.api.scheduler import default_db
+        yml = _yaml(registry, """
+            job: sleep 30
+            workspace: .
+            computing:
+              device_slots: 2
+        """)
+        res = api.launch_job(yml)
+        assert res.result_code == 0
+        db = default_db()
+        assert db.free_slots("local") == 0
+        # a second 1-slot job cannot fit
+        yml2 = _yaml(registry, """
+            job: "true"
+            workspace: .
+            computing:
+              device_slots: 1
+        """, name="job2.yaml")
+        res2 = api.launch_job(yml2)
+        assert res2.result_code != 0
+        assert "free slots" in res2.result_message
+        # stopping the first job frees the capacity
+        api.run_stop(res.run_id)
+        assert db.free_slots("local") == 2
+        res3 = api.launch_job(yml2)
+        assert res3.result_code == 0
+        api.run_wait(res3.run_id, timeout_s=30)
+        assert db.free_slots("local") == 2  # finalize released it
+
+
+class TestJobMonitor:
+    def test_kill_detect_restart(self, registry):
+        """Kill a running job's process with SIGKILL: the monitor marks
+        the run FAILED, releases its slots, and relaunches it (lineage
+        recorded), because the yaml opted in with restart: true."""
+        from fedml_tpu.api.scheduler import default_db
+        yml = _yaml(registry, """
+            job: sleep 60
+            workspace: .
+            restart: true
+            computing:
+              device_slots: 1
+        """)
+        res = api.launch_job(yml)
+        assert res.result_code == 0
+        assert api.run_status(res.run_id) == api.STATUS_RUNNING
+        mon = JobMonitor(interval_s=0.2, max_restarts=2)
+        mon.start()
+        try:
+            os.killpg(os.getpgid(res.inner_id), signal.SIGKILL)
+            deadline = time.time() + 15
+            while time.time() < deadline and res.run_id not in mon.restarted:
+                time.sleep(0.1)
+            assert res.run_id in mon.restarted, "monitor never restarted"
+            new_id = mon.restarted[res.run_id]
+            assert api.run_status(res.run_id) == api.STATUS_FAILED
+            assert api.run_status(new_id) == api.STATUS_RUNNING
+            meta = api._read_meta(new_id)
+            assert meta["restart_of"] == res.run_id
+            # capacity: dead run released, replacement claimed -> 1 used
+            assert default_db().free_slots("local") == 1
+            api.run_stop(new_id)
+        finally:
+            mon.stop()
+
+    def test_max_restarts_bounds_crash_loops(self, registry):
+        """A job that dies instantly is restarted at most max_restarts
+        times across its lineage."""
+        yml = _yaml(registry, """
+            job: sleep 60
+            workspace: .
+            restart: true
+        """)
+        res = api.launch_job(yml)
+        mon = JobMonitor(interval_s=0.15, max_restarts=2)
+        mon.start()
+        try:
+            current = res.run_id
+            killed = [current]
+            deadline = time.time() + 30
+            while time.time() < deadline and len(mon.restarted) < 2:
+                meta = api._read_meta(current)
+                pid = int(meta.get("pid", -1))
+                if (api.run_status(current) == api.STATUS_RUNNING
+                        and pid > 0):
+                    try:
+                        os.killpg(os.getpgid(pid), signal.SIGKILL)
+                    except OSError:
+                        pass
+                if current in mon.restarted:
+                    current = mon.restarted[current]
+                    killed.append(current)
+                time.sleep(0.1)
+            assert len(mon.restarted) == 2
+            # kill the last one too: no further restart beyond the cap
+            meta = api._read_meta(current)
+            pid = int(meta.get("pid", -1))
+            if pid > 0 and api.run_status(current) == api.STATUS_RUNNING:
+                try:
+                    os.killpg(os.getpgid(pid), signal.SIGKILL)
+                except OSError:
+                    pass
+            time.sleep(1.5)
+            assert len(mon.restarted) == 2  # capped
+        finally:
+            mon.stop()
+
+    def test_restart_fires_after_external_finalize(self, registry):
+        """A status poller may reconcile the dead run to FAILED before
+        the monitor's scan — crash detection is exit-record based, so the
+        restart must still fire exactly once."""
+        yml = _yaml(registry, """
+            job: sleep 60
+            workspace: .
+            restart: true
+        """)
+        res = api.launch_job(yml)
+        os.killpg(os.getpgid(res.inner_id), signal.SIGKILL)
+        os.waitpid(res.inner_id, 0)  # reap: in a real deployment init does
+        assert api.run_status(res.run_id) == api.STATUS_FAILED  # poller won
+        mon = JobMonitor(interval_s=0.2, max_restarts=2)
+        acted = mon.scan_once()
+        assert acted == [res.run_id]
+        assert res.run_id in mon.restarted
+        # a second scan (or a second monitor) must not restart it again
+        assert mon.scan_once() == []
+        mon2 = JobMonitor(interval_s=0.2, max_restarts=2)
+        assert mon2.scan_once() == []
+        api.run_stop(mon.restarted[res.run_id])
+
+    def test_restart_cap_persists_across_monitor_restarts(self, registry):
+        """restart_index lives in run meta: a fresh monitor process must
+        not grant a crash-looping lineage a new budget."""
+        yml = _yaml(registry, """
+            job: sleep 60
+            workspace: .
+            restart: true
+        """)
+        res = api.launch_job(yml)
+        current = res.run_id
+        for expected_idx in (1, 2):
+            meta = api._read_meta(current)
+            os.killpg(os.getpgid(int(meta["pid"])), signal.SIGKILL)
+            time.sleep(0.3)
+            mon = JobMonitor(interval_s=0.2, max_restarts=2)  # fresh each time
+            mon.scan_once()
+            assert current in mon.restarted
+            current = mon.restarted[current]
+            assert api._read_meta(current)["restart_index"] == expected_idx
+        # cap reached: a third fresh monitor refuses
+        meta = api._read_meta(current)
+        os.killpg(os.getpgid(int(meta["pid"])), signal.SIGKILL)
+        time.sleep(0.3)
+        mon = JobMonitor(interval_s=0.2, max_restarts=2)
+        acted = mon.scan_once()
+        assert acted == [current] and current not in mon.restarted
+
+    def test_monitor_ignores_healthy_and_finished_runs(self, registry):
+        yml = _yaml(registry, """
+            job: "true"
+            workspace: .
+        """)
+        res = api.launch_job(yml)
+        api.run_wait(res.run_id, timeout_s=30)
+        mon = JobMonitor(interval_s=0.2)
+        acted = mon.scan_once()
+        assert acted == []
+        assert api.run_status(res.run_id) == api.STATUS_FINISHED
