@@ -141,6 +141,16 @@ def load(args) -> Tuple[FederatedDataset, int]:
         if got is not None:
             return got
 
+    # FedNLP text-classification shards (reference data/fednlp h5 pair:
+    # <task>_data.h5 + <task>_partition.h5) from a local cache dir
+    if name.startswith("fednlp") and not raw_name.startswith("synthetic"):
+        from .fednlp_h5 import load_fednlp_text_classification
+        got = load_fednlp_text_classification(
+            os.path.join(cache_dir, name), bs, max_clients=num_clients,
+            partition_method=getattr(args, "partition_method", None))
+        if got is not None:
+            return got
+
     # image-directory datasets from a local cache (no egress):
     # ImageNet-style folder trees and Landmarks CSV-mapped user partitions
     if name in ("imagenet", "ilsvrc2012", "tiny_imagenet") \

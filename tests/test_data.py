@@ -441,3 +441,90 @@ class TestEdgeCaseBackdoor:
         asr_poisoned, acc_poisoned = run(poison=True)
         assert asr_poisoned > asr_clean + 0.3, (asr_clean, asr_poisoned)
         assert acc_poisoned > acc_clean - 0.1, (acc_clean, acc_poisoned)
+
+
+class TestFedNLPFormat:
+    """Reader for the reference FedNLP h5 pair (VERDICT r4 missing #7):
+    attributes JSON + X/<idx>, Y/<idx> datasets; partition file with
+    <method>/partition_data/<client>/{train,test} index lists — the exact
+    layout base_raw_data_loader.py:38-45 writes."""
+
+    def _write_fixture(self, d):
+        import h5py
+        import json as _json
+        texts = ["the cat sat", "stocks rallied", "goal scored late",
+                 "rain tomorrow", "new phone launch", "court ruling"]
+        labels = ["pets", "finance", "sports", "weather", "tech", "law"]
+        with h5py.File(d / "tiny_data.h5", "w") as f:
+            f["attributes"] = _json.dumps({
+                "task_type": "text_classification", "num_labels": 6,
+                "label_vocab": {l: i for i, l in enumerate(sorted(
+                    set(labels)))}})
+            for i, (x, y) in enumerate(zip(texts, labels)):
+                f[f"X/{i}"] = x
+                f[f"Y/{i}"] = y
+        with h5py.File(d / "tiny_partition.h5", "w") as f:
+            g = f.create_group("uniform")
+            g["n_clients"] = 2
+            pd = g.create_group("partition_data")
+            pd.create_group("0")["train"] = [0, 1]
+            pd["0"]["test"] = [2]
+            pd.create_group("1")["train"] = [3, 4]
+            pd["1"]["test"] = [5]
+
+    def test_load_exact_reference_layout(self, tmp_path):
+        from fedml_tpu.data.fednlp_h5 import load_fednlp_text_classification
+        d = tmp_path / "fednlp_tiny"
+        d.mkdir()
+        self._write_fixture(d)
+        fed, n_labels = load_fednlp_text_classification(str(d), batch_size=2)
+        assert n_labels == 6
+        assert fed.num_clients == 2
+        assert fed.provenance == "real"
+        # byte tokenization: fixed length, 0-padded, +1 offset
+        import numpy as np
+        x00 = np.asarray(fed.train.x[0, 0])
+        assert x00.shape[-1] == 128
+        assert x00.dtype == np.int32
+        # test split pooled from per-client test indices
+        assert int(np.asarray(fed.test["mask"]).sum()) == 2
+
+    def test_empty_client_and_missing_partition_method(self, tmp_path):
+        """A client with an empty train list must load (sparse niid
+        partitions do this), and a requested partition method absent
+        from the file falls back with a warning, not a KeyError."""
+        import h5py
+        import json as _json
+        from fedml_tpu.data.fednlp_h5 import load_fednlp_text_classification
+        d = tmp_path / "fednlp_sparse"
+        d.mkdir()
+        with h5py.File(d / "t_data.h5", "w") as f:
+            f["attributes"] = _json.dumps({"num_labels": 2,
+                                           "label_vocab": {"a": 0, "b": 1}})
+            for i in range(4):
+                f[f"X/{i}"] = f"text {i}"
+                f[f"Y/{i}"] = "a" if i % 2 else "b"
+        with h5py.File(d / "t_partition.h5", "w") as f:
+            g = f.create_group("niid")
+            g["n_clients"] = 2
+            pd = g.create_group("partition_data")
+            pd.create_group("0")["train"] = [0, 1, 2]
+            pd["0"]["test"] = [3]
+            pd.create_group("1")["train"] = []       # empty client
+            pd["1"]["test"] = []
+        fed, n = load_fednlp_text_classification(
+            str(d), batch_size=2, partition_method="uniform")  # absent
+        assert n == 2 and fed.num_clients == 2
+
+    def test_dispatch_through_data_loader(self, tmp_path):
+        from fedml_tpu import data as data_mod
+        from fedml_tpu.arguments import Arguments
+        d = tmp_path / "fednlp_tiny"
+        d.mkdir()
+        self._write_fixture(d)
+        args = Arguments(dataset="fednlp_tiny", model="lr",
+                         client_num_in_total=2, client_num_per_round=2,
+                         batch_size=2, data_cache_dir=str(tmp_path))
+        fed, output_dim = data_mod.load(args)
+        assert output_dim == 6
+        assert fed.provenance == "real"
