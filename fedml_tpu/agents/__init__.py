@@ -156,6 +156,10 @@ def _topic_stop(device_id: int) -> str:
     return f"flclient_agent/{device_id}/stop_train"
 
 
+def _topic_upgrade(device_id: int) -> str:
+    return f"flclient_agent/{device_id}/upgrade"
+
+
 class MessageCenter:
     """Broker client with a durable sender: publishes ride a queue drained
     by a sender thread with bounded retries, and sent/received records land
@@ -231,6 +235,21 @@ class MessageCenter:
             self._queue.append({"topic": topic, "payload": payload,
                                 "id": uuid.uuid4().hex, "tries": 0})
             self._queue_cv.notify()
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until the sender has drained the queue (best effort) —
+        needed before process replacement (OTA re-exec)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._queue_cv:
+                empty = not self._queue
+            if empty:
+                # the sender pops before sending — give the in-flight
+                # item a beat to hit the socket
+                time.sleep(0.25)
+                return True
+            time.sleep(0.05)
+        return False
 
     def _record(self, name: str, entry: dict) -> None:
         if not self._record_dir:
@@ -339,9 +358,16 @@ class SlaveAgent:
 
     def __init__(self, device_id: int, broker_host: str, broker_port: int,
                  poll_s: float = 0.5, secret: Optional[bytes] = None,
-                 insecure_open: bool = False):
+                 insecure_open: bool = False,
+                 device_token: Optional[str] = None):
         self.device_id = int(device_id)
         self.poll_s = poll_s
+        # per-device credential from the account registry (reference
+        # account_manager binding); shown in presence so a registry-wired
+        # master only schedules onto enrolled devices
+        self.device_token = (device_token
+                             or os.environ.get("FEDML_TPU_DEVICE_TOKEN"))
+        self.current_version: Optional[str] = None
         # secure by default: a daemon that executes arbitrary shell jobs
         # must not come up accepting ANY start_train published to its
         # topic — open deployment is an explicit flag, never a default
@@ -352,6 +378,7 @@ class SlaveAgent:
                 "FEDML_TPU_AGENT_SECRET (or pass secret=) so job dispatch "
                 "is authenticated, or pass insecure_open=True to "
                 "explicitly accept unauthenticated commands.")
+        self._insecure_open = insecure_open and self._secret is None
         from ..api import _runs_root
         # the replay ledger persists across daemon restarts: an in-memory
         # ledger alone would re-accept a captured frame replayed inside
@@ -359,12 +386,15 @@ class SlaveAgent:
         self._ledger_path = os.path.join(
             _runs_root(), f"agent_{device_id}", "seen-macs.log")
         self._seen_macs: Dict[str, float] = self._load_ledger()
+        will = {"device_id": self.device_id, "status": DEVICE_OFFLINE}
+        if self.device_token:
+            # the LWT must pass the same registry gate as live presence,
+            # or a bound device's crash would be silently dropped
+            will["device_token"] = self.device_token
         self.center = MessageCenter(
             broker_host, broker_port,
             record_dir=os.path.join(_runs_root(), f"agent_{device_id}"),
-            will_topic=TOPIC_ONLINE,
-            will_payload={"device_id": self.device_id,
-                          "status": DEVICE_OFFLINE})
+            will_topic=TOPIC_ONLINE, will_payload=will)
         # request run-id -> registry run-id (for stop routing)
         self.runs: Dict[str, str] = {}
         self._seen_requests = set()
@@ -424,9 +454,12 @@ class SlaveAgent:
         c = self.center
         c.subscribe(_topic_start(self.device_id), self._on_start)
         c.subscribe(_topic_stop(self.device_id), self._on_stop)
+        c.subscribe(_topic_upgrade(self.device_id), self._on_upgrade)
         c.start()
-        c.publish(TOPIC_ONLINE, {"device_id": self.device_id,
-                                 "status": DEVICE_IDLE})
+        presence = {"device_id": self.device_id, "status": DEVICE_IDLE}
+        if self.device_token:
+            presence["device_token"] = self.device_token
+        c.publish(TOPIC_ONLINE, presence)
 
     def stop(self) -> None:
         self.center.stop()
@@ -544,6 +577,100 @@ class SlaveAgent:
         api.run_stop(run_id)
         # the watcher thread reports the terminal KILLED status
 
+    def _on_upgrade(self, payload: dict) -> None:
+        """OTA agent upgrade (reference ``scheduler_core/ota_upgrade.py``):
+        a SIGNED command ships a zip package + version + sha256; the
+        agent verifies the digest, stages the package under its runs dir,
+        records the version, and reports UPGRADED. Process swap-over is
+        deployment policy: with FEDML_TPU_AGENT_ALLOW_REEXEC=1 the daemon
+        re-execs itself so the staged package (prepended to PYTHONPATH)
+        takes effect; otherwise the supervisor restarts it."""
+        import base64
+        import hashlib
+        import zipfile
+        from ..api import _runs_root
+        request_id = str(payload.get("request_id", ""))
+        reason = self._check(payload)
+        if reason is not None:
+            if reason == REASON_REPLAY:
+                # identical redelivery: re-announce, never fail (matches
+                # _on_start's anti-poisoning contract)
+                last = self._last_status.get(request_id)
+                if request_id in self._seen_requests and last:
+                    self._status(request_id, last["status"],
+                                 **{k: v for k, v in last.items()
+                                    if k != "status"})
+                else:
+                    logger.error("agent %s: dropping replayed upgrade %s",
+                                 self.device_id, request_id)
+                return
+            logger.error("agent %s: REFUSING upgrade %s — %s",
+                         self.device_id, request_id, reason)
+            if request_id not in self._seen_requests:
+                # unknown id only: an unauthenticated peer echoing a live
+                # request id must not flip it to FAILED
+                self._status(request_id, JOB_FAILED,
+                             error=f"upgrade refused: {reason}")
+            return
+        if request_id in self._seen_requests:
+            # at-least-once redelivery with a fresh MAC: re-announce
+            last = self._last_status.get(request_id)
+            if last:
+                self._status(request_id, last["status"],
+                             **{k: v for k, v in last.items()
+                                if k != "status"})
+            return
+        self._seen_requests.add(request_id)
+        version = str(payload.get("version", ""))
+        blob = base64.b64decode(payload.get("package_b64", ""))
+        digest = hashlib.sha256(blob).hexdigest()
+        if not version or digest != payload.get("sha256"):
+            logger.error("agent %s: upgrade %s digest mismatch",
+                         self.device_id, request_id)
+            self._status(request_id, JOB_FAILED,
+                         error="upgrade package digest mismatch")
+            return
+        pkg_dir = os.path.join(_runs_root(), f"agent_{self.device_id}",
+                               "pkgs", version)
+        os.makedirs(pkg_dir, exist_ok=True)
+        import io
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            # refuse traversal: every member must land inside pkg_dir
+            for m in z.namelist():
+                dest = os.path.realpath(os.path.join(pkg_dir, m))
+                if not dest.startswith(os.path.realpath(pkg_dir) + os.sep):
+                    self._status(request_id, JOB_FAILED,
+                                 error="upgrade package escapes target "
+                                       "dir")
+                    return
+            z.extractall(pkg_dir)
+        cur = os.path.join(_runs_root(), f"agent_{self.device_id}",
+                           "current_version.json")
+        with open(cur + ".tmp", "w") as f:
+            json.dump({"version": version, "path": pkg_dir,
+                       "ts": time.time()}, f)
+        os.replace(cur + ".tmp", cur)
+        self.current_version = version
+        logger.warning("agent %s: upgraded to %s (staged at %s)",
+                       self.device_id, version, pkg_dir)
+        self._status(request_id, "UPGRADED", version=version)
+        if os.environ.get("FEDML_TPU_AGENT_ALLOW_REEXEC"):
+            import sys
+            # the UPGRADED status rides the async sender — it must reach
+            # the wire BEFORE this process image is replaced
+            self.center.flush(timeout_s=10.0)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (pkg_dir + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            argv = [sys.executable, "-m", "fedml_tpu.cli", "agent",
+                    "--broker", f"{self.center._addr[0]}:"
+                                f"{self.center._addr[1]}",
+                    "--device-id", str(self.device_id)]
+            if self._insecure_open:
+                argv.append("--insecure-open")  # or the new process
+                # would refuse to start without the bind token
+            os.execve(sys.executable, argv, env)
+
 
 class MasterAgent:
     """Server-side agent (reference master protocol manager + status
@@ -551,13 +678,18 @@ class MasterAgent:
     the per-request job status FSM from the status topic; dispatches
     start/stop commands."""
 
-    def __init__(self, broker_host: str, broker_port: int):
+    def __init__(self, broker_host: str, broker_port: int, registry=None):
         from ..api import _runs_root
         self.center = MessageCenter(
             broker_host, broker_port,
             record_dir=os.path.join(_runs_root(), "agent_master"))
         self.devices: Dict[int, Dict[str, Any]] = {}
         self.jobs: Dict[str, Dict[str, Any]] = {}
+        # optional AccountRegistry: with one wired, presence from devices
+        # that are not enrolled (or present a bad/revoked token) is
+        # DROPPED — dispatch can only target bound devices (reference
+        # account_manager device binding)
+        self.registry = registry
         self._cv = threading.Condition()
 
     def start(self) -> None:
@@ -569,13 +701,34 @@ class MasterAgent:
         self.center.stop()
 
     def _on_presence(self, payload: dict) -> None:
+        did = int(payload.get("device_id", -1))
+        if self.registry is not None:
+            token = payload.get("device_token")
+            if not token or not self.registry.verify_device(str(did),
+                                                            str(token)):
+                logger.warning("master: dropping presence from unbound "
+                               "device %s", did)
+                return
         with self._cv:
-            did = int(payload.get("device_id", -1))
             self.devices[did] = {"status": payload.get("status"),
                                  "ts": time.time()}
             self._cv.notify_all()
 
     def _on_status(self, payload: dict) -> None:
+        did = int(payload.get("device_id", -1))
+        if self.registry is not None and did not in self.devices:
+            # device-table writes require a presence that passed the
+            # registry gate first — a broker peer must not conjure a
+            # dispatchable device (or poison the version column) by
+            # publishing job statuses for an unenrolled id
+            logger.warning("master: dropping status from unbound "
+                           "device %s", did)
+            return
+        if (payload.get("status") == "UPGRADED" and self.registry
+                and payload.get("version")):
+            # keep the registry's device-version column current
+            self.registry.record_version(
+                str(did), str(payload["version"]))
         with self._cv:
             rid = str(payload.get("request_id", ""))
             status = payload.get("status")
@@ -585,7 +738,6 @@ class MasterAgent:
             job["device_id"] = payload.get("device_id")
             if "run_id" in payload:
                 job["run_id"] = payload["run_id"]
-            did = int(payload.get("device_id", -1))
             dev = self.devices.setdefault(did, {})
             # a device is RUNNING while ANY of its jobs runs — one job's
             # PROVISIONING/terminal status must not mark a busy device idle
@@ -623,6 +775,26 @@ class MasterAgent:
         else:
             msg["job_yaml"] = path
         self.center.publish(_topic_start(device_id), sign_job(msg))
+        with self._cv:
+            self.jobs.setdefault(request_id, {"history": []})[
+                "device_id"] = device_id
+        return request_id
+
+    def dispatch_upgrade(self, device_id: int, package_zip: str,
+                         version: str,
+                         request_id: Optional[str] = None) -> str:
+        """OTA: ship a signed upgrade package (zip bytes + sha256 +
+        version) to a device agent. Returns the request id tracking the
+        UPGRADED/FAILED status."""
+        import base64
+        import hashlib
+        request_id = request_id or uuid.uuid4().hex
+        with open(package_zip, "rb") as f:
+            blob = f.read()
+        msg = {"request_id": request_id, "version": str(version),
+               "sha256": hashlib.sha256(blob).hexdigest(),
+               "package_b64": base64.b64encode(blob).decode()}
+        self.center.publish(_topic_upgrade(device_id), sign_job(msg))
         with self._cv:
             self.jobs.setdefault(request_id, {"history": []})[
                 "device_id"] = device_id

@@ -1,0 +1,148 @@
+"""Device-binding account registry for the agent plane.
+
+Parity target: reference
+``computing/scheduler/scheduler_core/account_manager.py:1-469`` — a
+device binds to an account with an API key and receives a persistent
+device identity + credential that later commands are checked against
+(the reference stores this against the MLOps platform; local-first here
+is a sqlite registry under the runs root).
+
+Model: an account is the hash of its API key (never stored raw); a
+device registration mints a random device token returned ONCE and kept
+only as a salted hash. Agents present ``(device_id, token)`` with their
+presence announcements; a master wired to the registry drops presence
+from unbound devices, so job dispatch can only target devices an
+operator actually enrolled — per-device revocation included, which the
+deployment-wide broker/bind secrets cannot give.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import secrets
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _hash(value: str, salt: str = "") -> str:
+    return hashlib.sha256((salt + value).encode()).hexdigest()
+
+
+class AccountRegistry:
+    """Sqlite account/device store (reference ``account_manager.py``)."""
+
+    def __init__(self, path: Optional[str] = None):
+        if path is None:
+            from ..api import _runs_root
+            path = os.path.join(_runs_root(), "accounts.db")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self.path = path
+        with self._conn() as c:
+            c.execute("""CREATE TABLE IF NOT EXISTS accounts (
+                account_id TEXT PRIMARY KEY,
+                api_key_hash TEXT NOT NULL,
+                created REAL NOT NULL)""")
+            c.execute("""CREATE TABLE IF NOT EXISTS devices (
+                device_id TEXT PRIMARY KEY,
+                account_id TEXT NOT NULL,
+                token_salt TEXT NOT NULL,
+                token_hash TEXT NOT NULL,
+                registered REAL NOT NULL,
+                last_seen REAL,
+                revoked INTEGER DEFAULT 0,
+                version TEXT DEFAULT '')""")
+
+    @contextlib.contextmanager
+    def _conn(self):
+        conn = sqlite3.connect(self.path, timeout=10.0)
+        conn.isolation_level = None
+        try:
+            yield conn
+        finally:
+            conn.close()
+
+    # --- accounts -----------------------------------------------------------
+    def login(self, api_key: str) -> str:
+        """Idempotent account creation from an API key; returns the
+        account id (reference ``login_with_api_key``)."""
+        account_id = _hash(api_key)[:16]
+        with self._conn() as c:
+            c.execute("INSERT OR IGNORE INTO accounts VALUES (?, ?, ?)",
+                      (account_id, _hash(api_key), time.time()))
+        return account_id
+
+    # --- devices ------------------------------------------------------------
+    def register_device(self, api_key: str,
+                        device_id: Optional[str] = None
+                        ) -> Tuple[str, str]:
+        """Bind a device to the API key's account. Returns
+        ``(device_id, device_token)`` — the token is shown exactly once;
+        only its salted hash persists. An existing (or revoked) device id
+        cannot be silently re-bound: re-binding would let anyone with any
+        API key hijack the identity or undo a revocation — explicitly
+        ``revoke`` + choose a NEW id instead.
+
+        Generated ids are numeric (the agent plane addresses devices by
+        integer id in its topics)."""
+        account_id = self.login(api_key)
+        device_id = device_id or str(secrets.randbelow(10 ** 9) + 10 ** 8)
+        token = secrets.token_hex(24)
+        salt = secrets.token_hex(8)
+        with self._conn() as c:
+            c.execute("BEGIN IMMEDIATE")
+            try:
+                row = c.execute("SELECT 1 FROM devices WHERE device_id=?",
+                                (device_id,)).fetchone()
+                if row is not None:
+                    c.execute("ROLLBACK")
+                    raise ValueError(
+                        f"device {device_id!r} is already registered "
+                        "(revoked identities stay dead; enroll a new id)")
+                c.execute("INSERT INTO devices "
+                          "VALUES (?, ?, ?, ?, ?, NULL, 0, '')",
+                          (device_id, account_id, salt,
+                           _hash(token, salt), time.time()))
+                c.execute("COMMIT")
+            except sqlite3.Error:
+                c.execute("ROLLBACK")
+                raise
+        return device_id, token
+
+    def verify_device(self, device_id: str, token: str) -> bool:
+        """Constant-time credential check; touches last_seen on success."""
+        import hmac
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT token_salt, token_hash, revoked FROM devices "
+                "WHERE device_id=?", (str(device_id),)).fetchone()
+            if row is None or int(row[2]):
+                return False
+            ok = hmac.compare_digest(_hash(str(token), row[0]), row[1])
+            if ok:
+                c.execute("UPDATE devices SET last_seen=? "
+                          "WHERE device_id=?", (time.time(),
+                                                str(device_id)))
+            return ok
+
+    def revoke_device(self, device_id: str) -> bool:
+        with self._conn() as c:
+            cur = c.execute("UPDATE devices SET revoked=1 "
+                            "WHERE device_id=?", (str(device_id),))
+            return cur.rowcount > 0
+
+    def record_version(self, device_id: str, version: str) -> None:
+        with self._conn() as c:
+            c.execute("UPDATE devices SET version=? WHERE device_id=?",
+                      (str(version), str(device_id)))
+
+    def devices(self) -> List[Dict[str, Any]]:
+        with self._conn() as c:
+            rows = c.execute(
+                "SELECT device_id, account_id, registered, last_seen, "
+                "revoked, version FROM devices").fetchall()
+        return [{"device_id": d, "account_id": a, "registered": r,
+                 "last_seen": ls, "revoked": bool(rv), "version": v}
+                for d, a, r, ls, rv, v in rows]

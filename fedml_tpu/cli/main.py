@@ -157,6 +157,45 @@ def monitor_cmd(interval, max_restarts):
     mon.stop()
 
 
+@cli.group("device", help="Device-binding account registry (enroll, "
+                          "list, revoke)")
+def device_group():
+    pass
+
+
+@device_group.command("bind")
+@click.argument("api_key")
+@click.option("--device-id", default=None, help="explicit device id")
+def device_bind_cmd(api_key, device_id):
+    """Enroll a device under the API key's account; prints the device id
+    and its ONE-TIME token (export as FEDML_TPU_DEVICE_TOKEN on the
+    agent)."""
+    from ..agents.accounts import AccountRegistry
+    did, token = AccountRegistry().register_device(api_key,
+                                                  device_id=device_id)
+    click.echo(f"device_id: {did}")
+    click.echo(f"device_token: {token}")
+    click.echo("export FEDML_TPU_DEVICE_TOKEN on the agent host; the "
+               "token is not stored and cannot be shown again.")
+
+
+@device_group.command("list")
+def device_list_cmd():
+    from ..agents.accounts import AccountRegistry
+    for d in AccountRegistry().devices():
+        click.echo(f"{d['device_id']}  account={d['account_id']} "
+                   f"revoked={d['revoked']} version={d['version'] or '-'}")
+
+
+@device_group.command("revoke")
+@click.argument("device_id")
+def device_revoke_cmd(device_id):
+    from ..agents.accounts import AccountRegistry
+    ok = AccountRegistry().revoke_device(device_id)
+    click.echo("revoked" if ok else "unknown device")
+    sys.exit(0 if ok else 1)
+
+
 @cli.group("run", help="Inspect and control runs")
 def run_group():
     pass

@@ -33,9 +33,13 @@ MAX_LEN = 128
 
 
 def _byte_ids(text: str, max_len: int) -> List[int]:
-    """Byte-level tokens (+1 so 0 stays the pad id), truncated/padded."""
-    ids = [b + 1 for b in text.encode("utf-8")[:max_len]]
-    return ids + [0] * (max_len - len(ids))
+    """THE LLM stack's ByteTokenizer id space (one tokenizer for the
+    whole framework — FedNLP shards stay directly consumable by
+    LLM-stack components), truncated and padded with its pad id."""
+    from ..llm.data import ByteTokenizer
+    tok = ByteTokenizer()
+    ids = tok.encode(text)[:max_len]
+    return ids + [tok.pad_id] * (max_len - len(ids))
 
 
 def load_fednlp_text_classification(data_dir: str, batch_size: int,
@@ -53,12 +57,20 @@ def load_fednlp_text_classification(data_dir: str, batch_size: int,
     part_files = [n for n in names if n.endswith("_partition.h5")]
     if not data_files or not part_files:
         return None
+    import contextlib
+
     import h5py
 
     from .containers import build_federated_dataset
-    data_f = h5py.File(os.path.join(data_dir, data_files[0]), "r")
-    part_f = h5py.File(os.path.join(data_dir, part_files[0]), "r")
-    try:
+    with contextlib.ExitStack() as stack:
+        try:
+            data_f = stack.enter_context(
+                h5py.File(os.path.join(data_dir, data_files[0]), "r"))
+            part_f = stack.enter_context(
+                h5py.File(os.path.join(data_dir, part_files[0]), "r"))
+        except OSError as e:  # corrupt/truncated cache: not-present
+            logger.warning("unusable FedNLP cache in %s: %s", data_dir, e)
+            return None
         attrs = json.loads(data_f["attributes"][()])
         label_vocab = attrs.get("label_vocab") or {}
         if not label_vocab:  # derive from the labels present
@@ -116,9 +128,6 @@ def load_fednlp_text_classification(data_dir: str, batch_size: int,
         logger.info("loaded FedNLP %s from %s: %d clients, %d labels",
                     data_files[0], data_dir, len(client_ids), num_labels)
         return fed, num_labels
-    finally:
-        data_f.close()
-        part_f.close()
 
 
 def _as_str(v) -> str:

@@ -332,3 +332,164 @@ class TestAuth:
         fresh = [q["payload"] for q in a.center._queue
                  if q["payload"].get("request_id") == "fresh"]
         assert fresh and fresh[-1]["status"] == "FAILED"
+
+
+class TestAccountRegistry:
+    """Device-binding account registry (reference account_manager.py):
+    devices enroll with an API key, get a one-time token, and a
+    registry-wired master only accepts presence from bound devices."""
+
+    def test_register_verify_revoke(self, registry):
+        from fedml_tpu.agents.accounts import AccountRegistry
+        reg = AccountRegistry(str(registry / "acc.db"))
+        did, token = reg.register_device("api-key-1", device_id="11")
+        assert reg.verify_device(did, token) is True
+        assert reg.verify_device(did, "wrong") is False
+        assert reg.verify_device("ghost", token) is False
+        # same api key -> same account for a second device
+        did2, _ = reg.register_device("api-key-1")
+        accounts = {d["account_id"] for d in reg.devices()}
+        assert len(accounts) == 1
+        assert reg.revoke_device(did) is True
+        assert reg.verify_device(did, token) is False  # revoked
+
+    def test_reregister_and_revoked_ids_stay_dead(self, registry):
+        """Re-binding an existing device id (any key) must be refused —
+        otherwise a revocation could be undone or an identity hijacked."""
+        from fedml_tpu.agents.accounts import AccountRegistry
+        reg = AccountRegistry(str(registry / "acc3.db"))
+        did, token = reg.register_device("key-a", device_id="77")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register_device("key-b", device_id="77")
+        reg.revoke_device(did)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register_device("key-a", device_id="77")
+        assert reg.verify_device(did, token) is False
+        # generated ids are numeric (agent topics address ints)
+        gen_id, _ = reg.register_device("key-a")
+        assert gen_id.isdigit()
+
+    def test_status_from_unbound_device_dropped(self, registry):
+        """With a registry wired, a broker peer must not conjure a
+        dispatchable device (or poison versions) via the status topic."""
+        from fedml_tpu.agents import MessageCenter
+        from fedml_tpu.agents.accounts import AccountRegistry
+        reg = AccountRegistry(str(registry / "acc4.db"))
+        broker = PubSubBroker()
+        try:
+            master = MasterAgent("127.0.0.1", broker.port, registry=reg)
+            master.start()
+            spy = MessageCenter("127.0.0.1", broker.port)
+            spy.start()
+            spy.publish("fl_client/mlops/status", {
+                "device_id": 44, "request_id": "x", "status": "FINISHED"})
+            spy.publish("fl_client/mlops/status", {
+                "device_id": 44, "request_id": "y", "status": "UPGRADED",
+                "version": "evil"})
+            time.sleep(0.8)
+            assert 44 not in master.devices
+            assert all(d["version"] != "evil" for d in reg.devices())
+            spy.stop()
+            master.stop()
+        finally:
+            broker.stop()
+
+    def test_master_drops_unbound_presence(self, registry):
+        from fedml_tpu.agents.accounts import AccountRegistry
+        reg = AccountRegistry(str(registry / "acc2.db"))
+        _, token = reg.register_device("k", device_id="5")
+        broker = PubSubBroker()
+        try:
+            master = MasterAgent("127.0.0.1", broker.port, registry=reg)
+            master.start()
+            # unbound device: no token
+            rogue = SlaveAgent(device_id=6, broker_host="127.0.0.1",
+                               broker_port=broker.port)
+            rogue.start()
+            # bound device: enrolled token
+            bound = SlaveAgent(device_id=5, broker_host="127.0.0.1",
+                               broker_port=broker.port,
+                               device_token=token)
+            bound.start()
+            assert master.wait_for_device(5, DEVICE_IDLE, timeout_s=10) \
+                == DEVICE_IDLE
+            assert 6 not in master.devices  # rogue presence dropped
+            rogue.stop()
+            bound.stop()
+            master.stop()
+        finally:
+            broker.stop()
+
+
+class TestOTAUpgrade:
+    """OTA agent upgrade (reference scheduler_core/ota_upgrade.py):
+    signed package with sha256, staged under the agent dir, version
+    recorded; bad digests and unsigned commands are refused."""
+
+    def _package(self, tmp, content="print('v2')"):
+        import hashlib
+        import zipfile
+        pkg = tmp / "agent_v2.zip"
+        with zipfile.ZipFile(pkg, "w") as z:
+            z.writestr("fedml_tpu_ext/__init__.py", content)
+        blob = pkg.read_bytes()
+        return str(pkg), hashlib.sha256(blob).hexdigest()
+
+    def test_upgrade_staged_and_version_recorded(self, cluster, registry):
+        import json as _json
+        _, master, slave = cluster
+        pkg, _sha = self._package(registry)
+        rid = master.dispatch_upgrade(7, pkg, version="2.0.0")
+        assert master.wait_for_status(rid, {"UPGRADED"}, timeout_s=20) \
+            == "UPGRADED"
+        assert slave.current_version == "2.0.0"
+        staged = (registry / "runs" / "agent_7" / "pkgs" / "2.0.0"
+                  / "fedml_tpu_ext" / "__init__.py")
+        assert staged.exists()
+        cur = _json.loads((registry / "runs" / "agent_7"
+                           / "current_version.json").read_text())
+        assert cur["version"] == "2.0.0"
+
+    def test_bad_digest_refused(self, cluster, registry):
+        import base64
+        from fedml_tpu.agents import sign_job, _topic_upgrade
+        _, master, slave = cluster
+        pkg, _sha = self._package(registry)
+        msg = {"request_id": "bad-digest", "version": "6.6.6",
+               "sha256": "0" * 64,
+               "package_b64": base64.b64encode(
+                   open(pkg, "rb").read()).decode()}
+        master.center.publish(_topic_upgrade(7), sign_job(msg))
+        assert master.wait_for_status("bad-digest", {"FAILED"},
+                                      timeout_s=20) == "FAILED"
+        assert slave.current_version != "6.6.6"
+
+    def test_unsigned_upgrade_refused(self, cluster, registry):
+        from fedml_tpu.agents import _topic_upgrade
+        _, master, slave = cluster
+        master.center.publish(_topic_upgrade(7), {
+            "request_id": "evil-up", "version": "9.9.9",
+            "sha256": "x", "package_b64": ""})
+        assert master.wait_for_status("evil-up", {"FAILED"},
+                                      timeout_s=20) == "FAILED"
+        assert slave.current_version is None \
+            or slave.current_version != "9.9.9"
+
+    def test_traversal_package_refused(self, cluster, registry):
+        import base64
+        import hashlib
+        import zipfile
+        from fedml_tpu.agents import sign_job, _topic_upgrade
+        _, master, _ = cluster
+        pkg = registry / "evil.zip"
+        with zipfile.ZipFile(pkg, "w") as z:
+            z.writestr("../../escape.py", "boom")
+        blob = pkg.read_bytes()
+        msg = {"request_id": "trav", "version": "3.0.0",
+               "sha256": hashlib.sha256(blob).hexdigest(),
+               "package_b64": base64.b64encode(blob).decode()}
+        master.center.publish(_topic_upgrade(7), sign_job(msg))
+        assert master.wait_for_status("trav", {"FAILED"},
+                                      timeout_s=20) == "FAILED"
+        assert not (registry / "runs" / "agent_7" / "escape.py").exists()
+        assert not (registry / "escape.py").exists()
