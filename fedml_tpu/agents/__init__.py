@@ -386,15 +386,15 @@ class SlaveAgent:
         self._ledger_path = os.path.join(
             _runs_root(), f"agent_{device_id}", "seen-macs.log")
         self._seen_macs: Dict[str, float] = self._load_ledger()
-        will = {"device_id": self.device_id, "status": DEVICE_OFFLINE}
-        if self.device_token:
-            # the LWT must pass the same registry gate as live presence,
-            # or a bound device's crash would be silently dropped
-            will["device_token"] = self.device_token
+        # the LWT must pass the same registry gate as live presence, or a
+        # bound device's crash would be silently dropped; its proof is
+        # necessarily computed now (the broker fires it at crash time),
+        # so the master verifies OFFLINE proofs without freshness
         self.center = MessageCenter(
             broker_host, broker_port,
             record_dir=os.path.join(_runs_root(), f"agent_{device_id}"),
-            will_topic=TOPIC_ONLINE, will_payload=will)
+            will_topic=TOPIC_ONLINE,
+            will_payload=self._presence(DEVICE_OFFLINE))
         # request run-id -> registry run-id (for stop routing)
         self.runs: Dict[str, str] = {}
         self._seen_requests = set()
@@ -450,18 +450,50 @@ class SlaveAgent:
             self._remember_mac(payload)
         return reason
 
-    def start(self) -> None:
+    def _presence(self, status: str) -> dict:
+        """Presence payload. With a device token, it carries an HMAC
+        PROOF over (device_id, status, ts, nonce) — never the token
+        itself, which a broker peer could harvest from the shared
+        topic."""
+        p = {"device_id": self.device_id, "status": status}
+        if self.device_token:
+            from .accounts import presence_proof
+            p["ts"] = time.time()
+            p["nonce"] = uuid.uuid4().hex
+            p["proof"] = presence_proof(self.device_token,
+                                        str(self.device_id), status,
+                                        p["ts"], p["nonce"])
+        return p
+
+    def start(self, presence_interval_s: float = 30.0) -> None:
         c = self.center
         c.subscribe(_topic_start(self.device_id), self._on_start)
         c.subscribe(_topic_stop(self.device_id), self._on_stop)
         c.subscribe(_topic_upgrade(self.device_id), self._on_upgrade)
         c.start()
-        presence = {"device_id": self.device_id, "status": DEVICE_IDLE}
-        if self.device_token:
-            presence["device_token"] = self.device_token
-        c.publish(TOPIC_ONLINE, presence)
+        c.publish(TOPIC_ONLINE, self._presence(DEVICE_IDLE))
+        # heartbeat: the broker retains nothing, so a master that starts
+        # (or restarts) after this agent would otherwise never see it —
+        # and a registry-wired master gates ALL traffic on presence
+        self._presence_interval = float(presence_interval_s)
+        self._presence_stop = threading.Event()
+        t = threading.Thread(target=self._presence_loop, daemon=True)
+        self._presence_thread = t
+        t.start()
+
+    def _presence_loop(self) -> None:
+        stop = self._presence_stop
+        while not stop.wait(self._presence_interval):
+            try:
+                self.center.publish(TOPIC_ONLINE,
+                                    self._presence(DEVICE_IDLE))
+            except Exception:
+                logger.exception("presence heartbeat failed")
 
     def stop(self) -> None:
+        stop = getattr(self, "_presence_stop", None)
+        if stop is not None:
+            stop.set()
         self.center.stop()
 
     def _status(self, request_id: str, status: str, **extra) -> None:
@@ -630,20 +662,39 @@ class SlaveAgent:
             self._status(request_id, JOB_FAILED,
                          error="upgrade package digest mismatch")
             return
+        import re as _re
+        if not _re.fullmatch(r"[A-Za-z0-9._-]{1,64}", version) \
+                or version in (".", ".."):
+            # the version names the staging directory — a signed payload
+            # is still not trusted to choose arbitrary paths
+            self._status(request_id, JOB_FAILED,
+                         error="upgrade version must be a plain "
+                               "identifier")
+            return
         pkg_dir = os.path.join(_runs_root(), f"agent_{self.device_id}",
                                "pkgs", version)
         os.makedirs(pkg_dir, exist_ok=True)
         import io
-        with zipfile.ZipFile(io.BytesIO(blob)) as z:
-            # refuse traversal: every member must land inside pkg_dir
-            for m in z.namelist():
-                dest = os.path.realpath(os.path.join(pkg_dir, m))
-                if not dest.startswith(os.path.realpath(pkg_dir) + os.sep):
-                    self._status(request_id, JOB_FAILED,
-                                 error="upgrade package escapes target "
-                                       "dir")
-                    return
-            z.extractall(pkg_dir)
+        try:
+            with zipfile.ZipFile(io.BytesIO(blob)) as z:
+                # refuse traversal: every member must land inside pkg_dir
+                for m in z.namelist():
+                    dest = os.path.realpath(os.path.join(pkg_dir, m))
+                    if not dest.startswith(
+                            os.path.realpath(pkg_dir) + os.sep):
+                        self._status(request_id, JOB_FAILED,
+                                     error="upgrade package escapes "
+                                           "target dir")
+                        return
+                z.extractall(pkg_dir)
+        except (zipfile.BadZipFile, OSError, ValueError) as e:
+            # a digest-valid but unreadable package must still resolve
+            # the request (the master is waiting on this id)
+            logger.error("agent %s: upgrade %s unusable package: %s",
+                         self.device_id, request_id, e)
+            self._status(request_id, JOB_FAILED,
+                         error=f"upgrade package unusable: {e}")
+            return
         cur = os.path.join(_runs_root(), f"agent_{self.device_id}",
                            "current_version.json")
         with open(cur + ".tmp", "w") as f:
@@ -702,16 +753,21 @@ class MasterAgent:
 
     def _on_presence(self, payload: dict) -> None:
         did = int(payload.get("device_id", -1))
+        status = payload.get("status")
         if self.registry is not None:
-            token = payload.get("device_token")
-            if not token or not self.registry.verify_device(str(did),
-                                                            str(token)):
+            # OFFLINE = last-will: its proof was computed at connect time
+            # (the broker fires it at crash time), so skip freshness —
+            # replaying it can only re-mark a dead device dead
+            ok = self.registry.verify_presence(
+                str(did), str(status), payload.get("ts"),
+                payload.get("nonce"), payload.get("proof"),
+                check_freshness=(status != DEVICE_OFFLINE))
+            if not ok:
                 logger.warning("master: dropping presence from unbound "
                                "device %s", did)
                 return
         with self._cv:
-            self.devices[did] = {"status": payload.get("status"),
-                                 "ts": time.time()}
+            self.devices[did] = {"status": status, "ts": time.time()}
             self._cv.notify_all()
 
     def _on_status(self, payload: dict) -> None:
@@ -726,9 +782,15 @@ class MasterAgent:
             return
         if (payload.get("status") == "UPGRADED" and self.registry
                 and payload.get("version")):
-            # keep the registry's device-version column current
-            self.registry.record_version(
-                str(did), str(payload["version"]))
+            # record only for upgrades THIS master dispatched to THAT
+            # device — statuses carry no MAC, so an arbitrary peer could
+            # otherwise poison any bound device's version column
+            with self._cv:
+                job = self.jobs.get(str(payload.get("request_id", "")))
+            if (job and job.get("kind") == "upgrade"
+                    and int(job.get("device_id", -2)) == did):
+                self.registry.record_version(
+                    str(did), str(payload["version"]))
         with self._cv:
             rid = str(payload.get("request_id", ""))
             status = payload.get("status")
@@ -796,8 +858,9 @@ class MasterAgent:
                "package_b64": base64.b64encode(blob).decode()}
         self.center.publish(_topic_upgrade(device_id), sign_job(msg))
         with self._cv:
-            self.jobs.setdefault(request_id, {"history": []})[
-                "device_id"] = device_id
+            job = self.jobs.setdefault(request_id, {"history": []})
+            job["device_id"] = device_id
+            job["kind"] = "upgrade"
         return request_id
 
     def stop_job(self, request_id: str) -> None:

@@ -8,12 +8,17 @@ device identity + credential that later commands are checked against
 is a sqlite registry under the runs root).
 
 Model: an account is the hash of its API key (never stored raw); a
-device registration mints a random device token returned ONCE and kept
-only as a salted hash. Agents present ``(device_id, token)`` with their
-presence announcements; a master wired to the registry drops presence
-from unbound devices, so job dispatch can only target devices an
-operator actually enrolled — per-device revocation included, which the
-deployment-wide broker/bind secrets cannot give.
+device registration mints a random device token returned ONCE. The
+registry keeps a salted hash of the token (for direct ``verify_device``
+checks) plus a DERIVED mac key — presence announcements never carry the
+token itself, only an HMAC proof over (device_id, status, ts, nonce)
+computed from the derived key, so a broker peer watching the presence
+topic cannot harvest a credential it can replay as its own enrollment
+(proofs are freshness-bound; see :meth:`verify_presence`). A master
+wired to the registry drops presence from unbound devices, so job
+dispatch only targets devices an operator actually enrolled —
+per-device revocation included, which the deployment-wide broker/bind
+secrets cannot give.
 """
 
 from __future__ import annotations
@@ -31,6 +36,25 @@ def _hash(value: str, salt: str = "") -> str:
     return hashlib.sha256((salt + value).encode()).hexdigest()
 
 
+def mac_key_for(token: str) -> bytes:
+    """Presence-proof key derived from the device token. The registry
+    stores THIS (a server-side verifier, like any symmetric-key store),
+    never the token; the agent derives it locally from its token."""
+    return hashlib.sha256(b"fedml-tpu/presence-mac:"
+                          + token.encode()).digest()
+
+
+def presence_proof(token: str, device_id: str, status: str, ts: float,
+                   nonce: str) -> str:
+    import hmac
+    body = f"{device_id}|{status}|{ts}|{nonce}".encode()
+    return hmac.new(mac_key_for(token), body,
+                    hashlib.sha256).hexdigest()
+
+
+PRESENCE_TTL_S = 300.0
+
+
 class AccountRegistry:
     """Sqlite account/device store (reference ``account_manager.py``)."""
 
@@ -38,7 +62,8 @@ class AccountRegistry:
         if path is None:
             from ..api import _runs_root
             path = os.path.join(_runs_root(), "accounts.db")
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
         self.path = path
         with self._conn() as c:
             c.execute("""CREATE TABLE IF NOT EXISTS accounts (
@@ -50,6 +75,7 @@ class AccountRegistry:
                 account_id TEXT NOT NULL,
                 token_salt TEXT NOT NULL,
                 token_hash TEXT NOT NULL,
+                mac_key TEXT NOT NULL,
                 registered REAL NOT NULL,
                 last_seen REAL,
                 revoked INTEGER DEFAULT 0,
@@ -102,9 +128,10 @@ class AccountRegistry:
                         f"device {device_id!r} is already registered "
                         "(revoked identities stay dead; enroll a new id)")
                 c.execute("INSERT INTO devices "
-                          "VALUES (?, ?, ?, ?, ?, NULL, 0, '')",
+                          "VALUES (?, ?, ?, ?, ?, ?, NULL, 0, '')",
                           (device_id, account_id, salt,
-                           _hash(token, salt), time.time()))
+                           _hash(token, salt),
+                           mac_key_for(token).hex(), time.time()))
                 c.execute("COMMIT")
             except sqlite3.Error:
                 c.execute("ROLLBACK")
@@ -121,6 +148,36 @@ class AccountRegistry:
             if row is None or int(row[2]):
                 return False
             ok = hmac.compare_digest(_hash(str(token), row[0]), row[1])
+            if ok:
+                c.execute("UPDATE devices SET last_seen=? "
+                          "WHERE device_id=?", (time.time(),
+                                                str(device_id)))
+            return ok
+
+    def verify_presence(self, device_id: str, status: str, ts, nonce,
+                        proof, check_freshness: bool = True) -> bool:
+        """Verify a presence HMAC proof (the token itself never rides the
+        topic). ``check_freshness=False`` is for LAST-WILL payloads: the
+        broker fires them at crash time with the proof computed at
+        connect time, so their ts is legitimately stale — the only thing
+        a replayed OFFLINE can do is re-mark a dead device dead."""
+        import hmac
+        try:
+            ts_f = float(ts)
+        except (TypeError, ValueError):
+            return False
+        if check_freshness and abs(time.time() - ts_f) > PRESENCE_TTL_S:
+            return False
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT mac_key, revoked FROM devices WHERE device_id=?",
+                (str(device_id),)).fetchone()
+            if row is None or int(row[1]):
+                return False
+            body = f"{device_id}|{status}|{ts}|{nonce}".encode()
+            want = hmac.new(bytes.fromhex(row[0]), body,
+                            hashlib.sha256).hexdigest()
+            ok = hmac.compare_digest(str(proof), want)
             if ok:
                 c.execute("UPDATE devices SET last_seen=? "
                           "WHERE device_id=?", (time.time(),

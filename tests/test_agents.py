@@ -475,6 +475,60 @@ class TestOTAUpgrade:
         assert slave.current_version is None \
             or slave.current_version != "9.9.9"
 
+    def test_non_zip_package_resolves_failed(self, cluster, registry):
+        """A digest-valid but unreadable package must still resolve the
+        request id — the master is blocked on it."""
+        notzip = registry / "notes.txt"
+        notzip.write_text("not a zip")
+        _, master, _ = cluster
+        rid = master.dispatch_upgrade(7, str(notzip), version="4.0")
+        assert master.wait_for_status(rid, {"FAILED"}, timeout_s=20) \
+            == "FAILED"
+
+    def test_path_choosing_version_refused(self, cluster, registry):
+        """A signed payload must not choose the staging directory: the
+        version string is an identifier, not a path."""
+        pkg, _ = self._package(registry)
+        _, master, _ = cluster
+        rid = master.dispatch_upgrade(7, pkg, version="../../../tmp/evil")
+        assert master.wait_for_status(rid, {"FAILED"}, timeout_s=20) \
+            == "FAILED"
+        assert not (registry / "tmp").exists()
+
+    def test_presence_heartbeat_heals_late_master(self, registry):
+        """A registry-wired master that starts AFTER the agent still
+        learns of it via the presence heartbeat (no retained messages),
+        and the proof on the wire is an HMAC — never the raw token."""
+        from fedml_tpu.agents import MessageCenter
+        from fedml_tpu.agents.accounts import AccountRegistry
+        reg = AccountRegistry(str(registry / "acc5.db"))
+        _, token = reg.register_device("k", device_id="21")
+        broker = PubSubBroker()
+        seen = []
+        try:
+            slave = SlaveAgent(device_id=21, broker_host="127.0.0.1",
+                               broker_port=broker.port,
+                               device_token=token)
+            spy = MessageCenter("127.0.0.1", broker.port)
+            spy.subscribe("fl_client/agent/online",
+                          lambda p: seen.append(p))
+            spy.start()
+            slave.start(presence_interval_s=0.3)
+            # master arrives late: first presence long gone
+            time.sleep(0.5)
+            master = MasterAgent("127.0.0.1", broker.port, registry=reg)
+            master.start()
+            assert master.wait_for_device(21, DEVICE_IDLE, timeout_s=10) \
+                == DEVICE_IDLE
+            # the credential itself never rides the topic
+            assert seen and all(token not in str(p) for p in seen)
+            assert all("proof" in p for p in seen)
+            slave.stop()
+            spy.stop()
+            master.stop()
+        finally:
+            broker.stop()
+
     def test_traversal_package_refused(self, cluster, registry):
         import base64
         import hashlib
