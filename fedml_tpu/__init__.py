@@ -44,17 +44,23 @@ def _enable_compile_cache() -> None:
     once per (program, topology) ever, across processes."""
     if os.environ.get("FEDML_TPU_NO_COMPILE_CACHE"):
         return
+    plat = (os.environ.get("JAX_PLATFORMS", "") or "default").replace(
+        ",", "_")
+    # primary platform decides (JAX_PLATFORMS is a priority list:
+    # "tpu,cpu" is a TPU process with CPU fallback and must keep the
+    # cache; only a cpu-PRIMARY process skips it)
+    if plat.split("_")[0] == "cpu":
+        # no cache for CPU processes: under the compile tunnel even CPU
+        # programs are AOT-compiled on the remote terminal machine, and
+        # re-loading those executables on this host trips machine-feature
+        # mismatch warnings (and, in the worst case, SIGILL). CPU runs
+        # are tests — their compiles are small; the cache's whole value
+        # is the TPU path's minutes-long remote compiles.
+        return
     try:
         import jax
-        # the cache MUST be platform-scoped: under the tunnel, programs
-        # (including auxiliary CPU executables) are AOT-compiled on the
-        # remote terminal machine, and a local CPU process loading such
-        # an entry runs code built for a different CPU's features
-        # (observed: stalled collectives -> rendezvous abort). Keying the
-        # directory by the process's JAX_PLATFORMS keeps tunnel-compiled
-        # and host-compiled artifacts apart.
-        plat = (os.environ.get("JAX_PLATFORMS", "") or "default").replace(
-            ",", "_")
+        # platform-scoped: tunnel-compiled artifacts must never be loaded
+        # by a process running a different platform
         cache_dir = os.path.join(os.environ.get(
             "FEDML_TPU_COMPILE_CACHE_DIR",
             os.path.expanduser("~/.cache/fedml_tpu/jaxcache")), plat)

@@ -485,8 +485,14 @@ class SlaveAgent:
         stop = self._presence_stop
         while not stop.wait(self._presence_interval):
             try:
-                self.center.publish(TOPIC_ONLINE,
-                                    self._presence(DEVICE_IDLE))
+                # announce the ACTUAL state: a heartbeat claiming IDLE
+                # while jobs run would mislead schedulers gating on it
+                busy = any(t.is_alive()
+                           for t in self._watchers.values())
+                self.center.publish(
+                    TOPIC_ONLINE,
+                    self._presence(DEVICE_RUNNING if busy
+                                   else DEVICE_IDLE))
             except Exception:
                 logger.exception("presence heartbeat failed")
 
@@ -741,6 +747,11 @@ class MasterAgent:
         # DROPPED — dispatch can only target bound devices (reference
         # account_manager device binding)
         self.registry = registry
+        # single-use presence nonces: a harvested proof (incl. the LWT,
+        # whose freshness is necessarily exempt) must not be replayable —
+        # at worst a captured LWT can be spent ONCE early, which the
+        # heartbeat heals within one interval
+        self._presence_nonces: Dict[str, float] = {}
         self._cv = threading.Condition()
 
     def start(self) -> None:
@@ -756,8 +767,8 @@ class MasterAgent:
         status = payload.get("status")
         if self.registry is not None:
             # OFFLINE = last-will: its proof was computed at connect time
-            # (the broker fires it at crash time), so skip freshness —
-            # replaying it can only re-mark a dead device dead
+            # (the broker fires it at crash time), so skip freshness; the
+            # nonce ledger below still makes every proof single-use
             ok = self.registry.verify_presence(
                 str(did), str(status), payload.get("ts"),
                 payload.get("nonce"), payload.get("proof"),
@@ -766,8 +777,27 @@ class MasterAgent:
                 logger.warning("master: dropping presence from unbound "
                                "device %s", did)
                 return
+            nonce = f"{did}:{payload.get('nonce')}"
+            with self._cv:
+                if nonce in self._presence_nonces:
+                    logger.warning("master: dropping replayed presence "
+                                   "for device %s", did)
+                    return
+                now = time.time()
+                self._presence_nonces[nonce] = now
+                if len(self._presence_nonces) > 8192:
+                    for k, t in list(self._presence_nonces.items()):
+                        if now - t > 600:
+                            del self._presence_nonces[k]
         with self._cv:
-            self.devices[did] = {"status": status, "ts": time.time()}
+            dev = self.devices.setdefault(did, {})
+            # MERGE, don't clobber: a heartbeat must not erase the
+            # running-jobs bookkeeping _on_status maintains — a device
+            # with live jobs stays RUNNING regardless of what the
+            # (job-agnostic) presence loop says
+            dev["ts"] = time.time()
+            if status == DEVICE_OFFLINE or not dev.get("running"):
+                dev["status"] = status
             self._cv.notify_all()
 
     def _on_status(self, payload: dict) -> None:

@@ -44,11 +44,17 @@ def mac_key_for(token: str) -> bytes:
                           + token.encode()).digest()
 
 
+def _presence_body(device_id: str, status: str, ts, nonce) -> bytes:
+    """ONE definition of the signed presence body — prover and verifier
+    must never drift apart on field order/format."""
+    return f"{device_id}|{status}|{ts}|{nonce}".encode()
+
+
 def presence_proof(token: str, device_id: str, status: str, ts: float,
                    nonce: str) -> str:
     import hmac
-    body = f"{device_id}|{status}|{ts}|{nonce}".encode()
-    return hmac.new(mac_key_for(token), body,
+    return hmac.new(mac_key_for(token),
+                    _presence_body(device_id, status, ts, nonce),
                     hashlib.sha256).hexdigest()
 
 
@@ -80,6 +86,14 @@ class AccountRegistry:
                 last_seen REAL,
                 revoked INTEGER DEFAULT 0,
                 version TEXT DEFAULT '')""")
+            # migration: a pre-mac_key devices table gains the column
+            # with an empty default — those devices fail presence proofs
+            # (graceful: re-enroll) instead of crashing every callback
+            cols = [r[1] for r in
+                    c.execute("PRAGMA table_info(devices)").fetchall()]
+            if "mac_key" not in cols:
+                c.execute("ALTER TABLE devices ADD COLUMN mac_key TEXT "
+                          "NOT NULL DEFAULT ''")
 
     @contextlib.contextmanager
     def _conn(self):
@@ -127,7 +141,11 @@ class AccountRegistry:
                     raise ValueError(
                         f"device {device_id!r} is already registered "
                         "(revoked identities stay dead; enroll a new id)")
-                c.execute("INSERT INTO devices "
+                # named columns: a migrated (pre-mac_key) table has the
+                # new column LAST, so positional inserts would scramble
+                c.execute("INSERT INTO devices (device_id, account_id, "
+                          "token_salt, token_hash, mac_key, registered, "
+                          "last_seen, revoked, version) "
                           "VALUES (?, ?, ?, ?, ?, ?, NULL, 0, '')",
                           (device_id, account_id, salt,
                            _hash(token, salt),
@@ -172,10 +190,11 @@ class AccountRegistry:
             row = c.execute(
                 "SELECT mac_key, revoked FROM devices WHERE device_id=?",
                 (str(device_id),)).fetchone()
-            if row is None or int(row[1]):
-                return False
-            body = f"{device_id}|{status}|{ts}|{nonce}".encode()
-            want = hmac.new(bytes.fromhex(row[0]), body,
+            if row is None or int(row[1]) or not row[0]:
+                return False  # unknown, revoked, or pre-migration row
+            want = hmac.new(bytes.fromhex(row[0]),
+                            _presence_body(str(device_id), str(status),
+                                           ts, nonce),
                             hashlib.sha256).hexdigest()
             ok = hmac.compare_digest(str(proof), want)
             if ok:

@@ -394,6 +394,100 @@ class TestAccountRegistry:
         finally:
             broker.stop()
 
+    def test_schema_migration_from_pre_mac_key_db(self, registry):
+        """An accounts.db created before the mac_key column must open,
+        migrate, and degrade gracefully (old devices fail proofs —
+        re-enroll — instead of crashing every presence callback)."""
+        import sqlite3
+        from fedml_tpu.agents.accounts import AccountRegistry
+        path = str(registry / "old.db")
+        con = sqlite3.connect(path)
+        con.execute("""CREATE TABLE devices (
+            device_id TEXT PRIMARY KEY, account_id TEXT NOT NULL,
+            token_salt TEXT NOT NULL, token_hash TEXT NOT NULL,
+            registered REAL NOT NULL, last_seen REAL,
+            revoked INTEGER DEFAULT 0, version TEXT DEFAULT '')""")
+        con.execute("INSERT INTO devices VALUES "
+                    "('9', 'a', 's', 'h', 1.0, NULL, 0, '')")
+        con.commit()
+        con.close()
+        reg = AccountRegistry(path)  # migrates
+        assert reg.verify_presence("9", "IDLE", 1.0, "n", "p") is False
+        did, token = reg.register_device("k")  # new enrolls still work
+        from fedml_tpu.agents.accounts import presence_proof
+        import time as _t
+        ts = _t.time()
+        assert reg.verify_presence(did, "IDLE", ts, "n1",
+                                   presence_proof(token, did, "IDLE",
+                                                  ts, "n1"))
+
+    def test_replayed_presence_nonce_rejected(self, registry):
+        """A harvested presence proof (incl. the freshness-exempt LWT)
+        is single-use at the master."""
+        from fedml_tpu.agents import MessageCenter
+        from fedml_tpu.agents.accounts import (AccountRegistry,
+                                               presence_proof)
+        import time as _t
+        reg = AccountRegistry(str(registry / "acc6.db"))
+        did, token = reg.register_device("k", device_id="31")
+        broker = PubSubBroker()
+        try:
+            master = MasterAgent("127.0.0.1", broker.port, registry=reg)
+            master.start()
+            spy = MessageCenter("127.0.0.1", broker.port)
+            spy.start()
+            ts = _t.time()
+            frame = {"device_id": 31, "status": "OFFLINE", "ts": ts,
+                     "nonce": "nn", "proof": presence_proof(
+                         token, "31", "OFFLINE", ts, "nn")}
+            spy.publish("fl_client/agent/online", dict(frame))
+            assert master.wait_for_device(31, "OFFLINE", timeout_s=10) \
+                == "OFFLINE"
+            # device comes back IDLE; the replayed OFFLINE must not land
+            ts2 = _t.time()
+            spy.publish("fl_client/agent/online", {
+                "device_id": 31, "status": "IDLE", "ts": ts2,
+                "nonce": "n2", "proof": presence_proof(
+                    token, "31", "IDLE", ts2, "n2")})
+            assert master.wait_for_device(31, "IDLE", timeout_s=10) \
+                == "IDLE"
+            spy.publish("fl_client/agent/online", dict(frame))  # replay
+            time.sleep(0.6)
+            assert master.devices[31]["status"] == "IDLE"
+            spy.stop()
+            master.stop()
+        finally:
+            broker.stop()
+
+    def test_heartbeat_does_not_clobber_running_device(self, registry):
+        """A presence heartbeat must not erase the master's running-jobs
+        bookkeeping (it would make schedulers dispatch onto a busy
+        device)."""
+        broker = PubSubBroker()
+        try:
+            master = MasterAgent("127.0.0.1", broker.port)
+            master.start()
+            slave = SlaveAgent(device_id=8, broker_host="127.0.0.1",
+                               broker_port=broker.port, poll_s=0.1)
+            slave.start(presence_interval_s=0.2)
+            assert master.wait_for_device(8, DEVICE_IDLE, timeout_s=10) \
+                == DEVICE_IDLE
+            yml = _job_yaml(registry, """
+                job: sleep 30
+                workspace: .
+            """, name="busy.yaml")
+            rid = master.dispatch(8, yml)
+            assert master.wait_for_status(rid, JOB_RUNNING,
+                                          timeout_s=30) == JOB_RUNNING
+            time.sleep(0.8)  # several heartbeats later...
+            assert master.devices[8]["status"] == "RUNNING"
+            master.stop_job(rid)
+            master.wait_for_status(rid, {JOB_KILLED}, timeout_s=30)
+            slave.stop()
+            master.stop()
+        finally:
+            broker.stop()
+
     def test_master_drops_unbound_presence(self, registry):
         from fedml_tpu.agents.accounts import AccountRegistry
         reg = AccountRegistry(str(registry / "acc2.db"))
