@@ -34,7 +34,6 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.distributed.communication.pubsub import (_recv_frame,
                                                      _send_frame,
-                                                     broker_secret,
                                                      client_connect)
 
 logger = logging.getLogger(__name__)

@@ -22,7 +22,6 @@ its docs advertise). TPU-first redesign:
 
 from __future__ import annotations
 
-import json
 import logging
 import time
 import uuid
