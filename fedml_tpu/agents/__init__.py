@@ -172,10 +172,15 @@ class MessageCenter:
     def __init__(self, broker_host: str, broker_port: int,
                  record_dir: Optional[str] = None,
                  will_topic: Optional[str] = None,
-                 will_payload: Optional[dict] = None):
+                 will_payload=None):
         self._addr = (broker_host, int(broker_port))
         self._handlers: Dict[str, Callable[[dict], None]] = {}
         self._subs: List[str] = []
+        # will_payload may be a dict or a CALLABLE returning one: the LWT
+        # is re-installed on every reconnect, and a proof-carrying will
+        # must be minted fresh each time (the master's nonce ledger makes
+        # proofs single-use — a reused will would be dropped as replay
+        # exactly when the device actually dies)
         self._will = (will_topic, will_payload)
         self._sock: Optional[socket.socket] = None
         self._sock_lock = threading.Lock()
@@ -216,8 +221,11 @@ class MessageCenter:
         for topic in self._subs:
             _send_frame(sock, {"kind": "sub", "topic": topic})
         if self._will[0] is not None:
+            will = self._will[1]
+            if callable(will):
+                will = will()  # fresh nonce/proof per connection
             _send_frame(sock, {"kind": "lwt", "topic": self._will[0],
-                               "payload": json.dumps(self._will[1])})
+                               "payload": json.dumps(will)})
         self._sock = sock
 
     # --- pub/sub -----------------------------------------------------------
@@ -386,14 +394,15 @@ class SlaveAgent:
             _runs_root(), f"agent_{device_id}", "seen-macs.log")
         self._seen_macs: Dict[str, float] = self._load_ledger()
         # the LWT must pass the same registry gate as live presence, or a
-        # bound device's crash would be silently dropped; its proof is
-        # necessarily computed now (the broker fires it at crash time),
-        # so the master verifies OFFLINE proofs without freshness
+        # bound device's crash would be silently dropped; it is a FACTORY
+        # so every reconnect installs a fresh nonce/proof (the master's
+        # ledger makes proofs single-use), and the master verifies
+        # OFFLINE proofs without freshness (computed at connect time)
         self.center = MessageCenter(
             broker_host, broker_port,
             record_dir=os.path.join(_runs_root(), f"agent_{device_id}"),
             will_topic=TOPIC_ONLINE,
-            will_payload=self._presence(DEVICE_OFFLINE))
+            will_payload=lambda: self._presence(DEVICE_OFFLINE))
         # request run-id -> registry run-id (for stop routing)
         self.runs: Dict[str, str] = {}
         self._seen_requests = set()
@@ -449,6 +458,19 @@ class SlaveAgent:
             self._remember_mac(payload)
         return reason
 
+    def _reannounce(self, request_id: str) -> bool:
+        """Re-publish the request's ACTUAL last status (the anti-
+        poisoning contract for duplicates/replays: never hardcode RUNNING
+        — it would resurrect a finished job — and never emit FAILED for
+        a live one). True if a status was re-announced."""
+        last = self._last_status.get(request_id)
+        if request_id in self._seen_requests and last:
+            self._status(request_id, last["status"],
+                         **{k: v for k, v in last.items()
+                            if k != "status"})
+            return True
+        return False
+
     def _presence(self, status: str) -> dict:
         """Presence payload. With a device token, it carries an HMAC
         PROOF over (device_id, status, ts, nonce) — never the token
@@ -485,9 +507,10 @@ class SlaveAgent:
         while not stop.wait(self._presence_interval):
             try:
                 # announce the ACTUAL state: a heartbeat claiming IDLE
-                # while jobs run would mislead schedulers gating on it
+                # while jobs run would mislead schedulers gating on it.
+                # list() snapshot: the receive thread mutates _watchers
                 busy = any(t.is_alive()
-                           for t in self._watchers.values())
+                           for t in list(self._watchers.values()))
                 self.center.publish(
                     TOPIC_ONLINE,
                     self._presence(DEVICE_RUNNING if busy
@@ -513,16 +536,9 @@ class SlaveAgent:
         reason = self._check(payload)
         if reason is not None:
             if reason == REASON_REPLAY:
-                # byte-identical redelivery (at-least-once sender retry, or
-                # an actual replay): re-announce the request's ACTUAL last
-                # status — hardcoding RUNNING would resurrect a finished
-                # job, publishing FAILED would poison a live one
-                last = self._last_status.get(request_id)
-                if request_id in self._seen_requests and last:
-                    self._status(request_id, last["status"],
-                                 **{k: v for k, v in last.items()
-                                    if k != "status"})
-                else:
+                # byte-identical redelivery (at-least-once sender retry,
+                # or an actual replay)
+                if not self._reannounce(request_id):
                     logger.error("agent %s: dropping replayed start_train "
                                  "%s", self.device_id, request_id)
                 return
@@ -541,15 +557,9 @@ class SlaveAgent:
         # idempotency: the master re-publishes start_train until it sees a
         # status (the broker has no retained messages, so a command sent
         # before this agent subscribed is simply gone) — a duplicate must
-        # re-announce the request's ACTUAL last status (a freshly-signed
-        # redispatch arriving after the job finished must not resurrect
-        # it to RUNNING), never re-execute
+        # re-announce, never re-execute
         if request_id in self._seen_requests:
-            last = self._last_status.get(request_id)
-            if last:
-                self._status(request_id, last["status"],
-                             **{k: v for k, v in last.items()
-                                if k != "status"})
+            self._reannounce(request_id)
             return
         self._seen_requests.add(request_id)
         self._status(request_id, JOB_PROVISIONING)
@@ -630,14 +640,8 @@ class SlaveAgent:
         reason = self._check(payload)
         if reason is not None:
             if reason == REASON_REPLAY:
-                # identical redelivery: re-announce, never fail (matches
-                # _on_start's anti-poisoning contract)
-                last = self._last_status.get(request_id)
-                if request_id in self._seen_requests and last:
-                    self._status(request_id, last["status"],
-                                 **{k: v for k, v in last.items()
-                                    if k != "status"})
-                else:
+                # identical redelivery: re-announce, never fail
+                if not self._reannounce(request_id):
                     logger.error("agent %s: dropping replayed upgrade %s",
                                  self.device_id, request_id)
                 return
@@ -650,12 +654,7 @@ class SlaveAgent:
                              error=f"upgrade refused: {reason}")
             return
         if request_id in self._seen_requests:
-            # at-least-once redelivery with a fresh MAC: re-announce
-            last = self._last_status.get(request_id)
-            if last:
-                self._status(request_id, last["status"],
-                             **{k: v for k, v in last.items()
-                                if k != "status"})
+            self._reannounce(request_id)  # fresh-MAC redelivery
             return
         self._seen_requests.add(request_id)
         version = str(payload.get("version", ""))
@@ -788,6 +787,12 @@ class MasterAgent:
                     for k, t in list(self._presence_nonces.items()):
                         if now - t > 600:
                             del self._presence_nonces[k]
+                    while len(self._presence_nonces) > 8192:
+                        # flood of still-fresh nonces: evict oldest-first
+                        # rather than growing (and scanning) forever
+                        self._presence_nonces.pop(
+                            min(self._presence_nonces,
+                                key=self._presence_nonces.get))
         with self._cv:
             dev = self.devices.setdefault(did, {})
             # MERGE, don't clobber: a heartbeat must not erase the

@@ -259,7 +259,15 @@ class JobMonitor:
                 crashed = int(meta.get("pid", -1)) > 0
             elif status in (STATUS_FINISHED, STATUS_KILLED,
                             STATUS_FAILED):
-                _release_allocation(run_id)
+                # only runs that ever CLAIMED capacity need a release, and
+                # only once — _finalize/run_stop already released them, so
+                # this is a belt-and-braces sweep, not a per-scan sqlite
+                # DELETE for every historical run forever
+                if (meta.get("device_id")
+                        and not meta.get("allocation_released")):
+                    _release_allocation(run_id)
+                    meta["allocation_released"] = True
+                    _write_meta(run_id, meta)
                 continue
             else:
                 continue

@@ -148,8 +148,8 @@ class SplitNNServerManager(FedMLCommManager):
             return
         # round complete
         self._active_pos = 0
-        if (self.round_idx % self.freq == 0
-                or self.round_idx == self.rounds - 1):
+        if self.freq > 0 and (self.round_idx % self.freq == 0
+                              or self.round_idx == self.rounds - 1):
             # evaluate with the FIRST party's bottom (SP sim evaluates
             # client 0's pair; any one pair is a valid split model)
             self.send_message(Message(SplitMsg.S2C_EVALUATE, self.rank,

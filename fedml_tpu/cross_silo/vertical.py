@@ -195,9 +195,9 @@ class VFLServerManager(FedMLCommManager):
         if self.step_idx < self.steps:
             self._send_batch()
             return
-        # round complete
-        if (self.round_idx % self.freq == 0
-                or self.round_idx == self.rounds - 1):
+        # round complete (freq <= 0: never evaluate in-loop)
+        if self.freq > 0 and (self.round_idx % self.freq == 0
+                              or self.round_idx == self.rounds - 1):
             self._eval_contribs = {}
             self._broadcast(VFLMsg.S2P_EVALUATE)
             return

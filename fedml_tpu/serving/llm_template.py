@@ -132,8 +132,11 @@ class CausalLMPredictor(FedMLPredictor):
         concatenated user/system turns (the instruction-tuning format the
         federated fine-tune trained on: instruction ++ SEP ++ response)."""
         messages = request.get("messages") or []
+        # keep EVERY turn (assistant replies included) — dropping the
+        # model's own prior turns would make multi-turn continuations
+        # incoherent
         prompt = "\n".join(str(m.get("content", "")) for m in messages
-                           if m.get("role") in ("system", "user"))
+                           if m.get("content"))
         out = self.generate(
             prompt,
             max_new_tokens=int(request.get("max_tokens", 64)),
