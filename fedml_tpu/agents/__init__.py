@@ -186,6 +186,7 @@ class MessageCenter:
         self._sock_lock = threading.Lock()
         self._queue: List[dict] = []
         self._queue_cv = threading.Condition()
+        self._in_flight = False   # sender popped an item it hasn't settled
         self._running = False
         self._record_dir = record_dir
         if record_dir:
@@ -244,18 +245,18 @@ class MessageCenter:
             self._queue_cv.notify()
 
     def flush(self, timeout_s: float = 10.0) -> bool:
-        """Block until the sender has drained the queue (best effort) —
-        needed before process replacement (OTA re-exec)."""
+        """Block until the sender has drained the queue AND settled the
+        item it popped (sent or dropped) — needed before process
+        replacement (OTA re-exec): the sender pops before sending, so
+        queue-empty alone would let execve clobber an UPGRADED status
+        that is still on its way to the socket."""
         deadline = time.time() + timeout_s
-        while time.time() < deadline:
-            with self._queue_cv:
-                empty = not self._queue
-            if empty:
-                # the sender pops before sending — give the in-flight
-                # item a beat to hit the socket
-                time.sleep(0.25)
-                return True
-            time.sleep(0.05)
+        with self._queue_cv:
+            while time.time() < deadline:
+                if not self._queue and not self._in_flight:
+                    return True
+                self._queue_cv.wait(timeout=min(
+                    0.05, max(deadline - time.time(), 0.001)))
         return False
 
     def _record(self, name: str, entry: dict) -> None:
@@ -275,39 +276,55 @@ class MessageCenter:
                 if not self._running:
                     return
                 item = self._queue.pop(0)
-            self._record("message-sent-records.log",
-                         {"id": item["id"], "topic": item["topic"],
-                          "ts": time.time()})
-            ok = False
-            while item["tries"] < self.RETRY_COUNT and not ok:
-                item["tries"] += 1
-                try:
-                    with self._sock_lock:
-                        if self._sock is None:
-                            if not self._running:
-                                break  # stopped: don't resurrect the
-                                # socket (it would re-install the LWT and
-                                # later fire a spurious OFFLINE)
-                            self._connect()
-                        _send_frame(self._sock, {
-                            "kind": "pub", "topic": item["topic"],
-                            "payload": json.dumps(item["payload"])})
-                    ok = True
-                except OSError as e:
-                    logger.warning("message center: publish failed "
-                                   "(try %d/%d): %s", item["tries"],
-                                   self.RETRY_COUNT, e)
-                    with self._sock_lock:
-                        self._sock = None
-                    time.sleep(self.RETRY_DELAY_S * item["tries"])
-            if ok:
-                self._record("message-sent-success-records.log",
+                self._in_flight = True
+            try:
+                self._record("message-sent-records.log",
                              {"id": item["id"], "topic": item["topic"],
                               "ts": time.time()})
-            else:
+                ok = False
+                while item["tries"] < self.RETRY_COUNT and not ok:
+                    item["tries"] += 1
+                    try:
+                        with self._sock_lock:
+                            if self._sock is None:
+                                if not self._running:
+                                    break  # stopped: don't resurrect the
+                                    # socket (it would re-install the LWT
+                                    # and later fire a spurious OFFLINE)
+                                self._connect()
+                            _send_frame(self._sock, {
+                                "kind": "pub", "topic": item["topic"],
+                                "payload": json.dumps(item["payload"])})
+                        ok = True
+                    except OSError as e:
+                        logger.warning("message center: publish failed "
+                                       "(try %d/%d): %s", item["tries"],
+                                       self.RETRY_COUNT, e)
+                        with self._sock_lock:
+                            self._sock = None
+                        time.sleep(self.RETRY_DELAY_S * item["tries"])
+                if ok:
+                    self._record("message-sent-success-records.log",
+                                 {"id": item["id"], "topic": item["topic"],
+                                  "ts": time.time()})
+                else:
+                    self._record("message-dropped-records.log",
+                                 {"id": item["id"], "topic": item["topic"],
+                                  "ts": time.time()})
+            except Exception:  # e.g. unserializable payload: drop the
+                # item, keep the sender alive for the rest of the queue
+                logger.exception("message center: dropping unsendable "
+                                 "message %s", item["id"])
                 self._record("message-dropped-records.log",
                              {"id": item["id"], "topic": item["topic"],
                               "ts": time.time()})
+            finally:
+                # ALWAYS settle, even if publish raised something beyond
+                # OSError (e.g. an unserializable payload) — a stuck
+                # in-flight flag would make every future flush() time out
+                with self._queue_cv:
+                    self._in_flight = False
+                    self._queue_cv.notify_all()
 
     def _recv_loop(self) -> None:
         backoff = 0.2

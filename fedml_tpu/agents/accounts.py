@@ -75,6 +75,7 @@ class AccountRegistry:
             c.execute("""CREATE TABLE IF NOT EXISTS accounts (
                 account_id TEXT PRIMARY KEY,
                 api_key_hash TEXT NOT NULL,
+                api_key_salt TEXT NOT NULL DEFAULT '',
                 created REAL NOT NULL)""")
             c.execute("""CREATE TABLE IF NOT EXISTS devices (
                 device_id TEXT PRIMARY KEY,
@@ -94,6 +95,13 @@ class AccountRegistry:
             if "mac_key" not in cols:
                 c.execute("ALTER TABLE devices ADD COLUMN mac_key TEXT "
                           "NOT NULL DEFAULT ''")
+            # migration: pre-salt accounts keep salt '' — _hash(key, '')
+            # equals the legacy unsalted digest, so old rows still match
+            acc_cols = [r[1] for r in
+                        c.execute("PRAGMA table_info(accounts)").fetchall()]
+            if "api_key_salt" not in acc_cols:
+                c.execute("ALTER TABLE accounts ADD COLUMN api_key_salt "
+                          "TEXT NOT NULL DEFAULT ''")
 
     @contextlib.contextmanager
     def _conn(self):
@@ -107,11 +115,36 @@ class AccountRegistry:
     # --- accounts -----------------------------------------------------------
     def login(self, api_key: str) -> str:
         """Idempotent account creation from an API key; returns the
-        account id (reference ``login_with_api_key``)."""
-        account_id = _hash(api_key)[:16]
+        account id (reference ``login_with_api_key``). The key persists
+        only as a SALTED hash — parity with the device-token hashing in
+        the same table; an unsalted digest would let one rainbow table
+        hit every deployment — and the account id derives from the
+        salted digest, so no column leaks a precomputable digest.
+        Idempotency without an unsalted lookup key means scanning the
+        (operator-scale, a handful of rows) account list and re-hashing
+        against each row's salt; legacy salt-less rows compare with
+        ``_hash(key, '')`` which equals their original unsalted digest."""
+        import hmac
         with self._conn() as c:
-            c.execute("INSERT OR IGNORE INTO accounts VALUES (?, ?, ?)",
-                      (account_id, _hash(api_key), time.time()))
+            c.execute("BEGIN IMMEDIATE")  # serialize concurrent first-logins
+            try:
+                rows = c.execute("SELECT account_id, api_key_hash, "
+                                 "api_key_salt FROM accounts").fetchall()
+                for account_id, key_hash, salt in rows:
+                    if hmac.compare_digest(_hash(api_key, salt or ""),
+                                           key_hash):
+                        c.execute("COMMIT")
+                        return account_id
+                salt = secrets.token_hex(8)
+                digest = _hash(api_key, salt)
+                account_id = digest[:16]
+                c.execute("INSERT INTO accounts (account_id, api_key_hash,"
+                          " api_key_salt, created) VALUES (?, ?, ?, ?)",
+                          (account_id, digest, salt, time.time()))
+                c.execute("COMMIT")
+            except sqlite3.Error:
+                c.execute("ROLLBACK")
+                raise
         return account_id
 
     # --- devices ------------------------------------------------------------

@@ -217,21 +217,24 @@ def _finalize(run_id: str, rc: Optional[int]) -> None:
     _release_allocation(run_id)
 
 
+def _read_exit_code(run_id: str) -> Optional[int]:
+    """The run's recorded exit code, or None when absent/unreadable. A
+    recorded code is authoritative even if the pid has been recycled by
+    an unrelated process (reboot/wraparound)."""
+    rc_path = os.path.join(_run_dir(run_id), "exit_code")
+    try:
+        return int(open(rc_path).read().strip())
+    except (OSError, ValueError):
+        return None
+
+
 def run_status(run_id: str) -> Optional[str]:
     """Current status; polls the pid for liveness and finalizes on exit."""
     meta = _read_meta(run_id)
     if meta is None:
         return None
     if meta.get("status") == STATUS_RUNNING:
-        # exit_code first: a recorded code is authoritative even if the pid
-        # has been recycled by an unrelated process (reboot/wraparound)
-        rc_path = os.path.join(_run_dir(run_id), "exit_code")
-        rc: Optional[int] = None
-        if os.path.exists(rc_path):
-            try:
-                rc = int(open(rc_path).read().strip())
-            except ValueError:
-                rc = None
+        rc = _read_exit_code(run_id)
         if rc is None:
             pid = int(meta.get("pid", -1))
             if pid > 0 and _pid_alive(pid):

@@ -232,8 +232,9 @@ class JobMonitor:
     def scan_once(self) -> List[str]:
         """One scan; returns run ids newly detected as crashed."""
         from . import (STATUS_FAILED, STATUS_FINISHED, STATUS_KILLED,
-                       STATUS_RUNNING, _read_meta, _release_allocation,
-                       _run_dir, _write_meta, launch_job, run_list)
+                       STATUS_RUNNING, _finalize, _read_exit_code,
+                       _read_meta, _release_allocation, _run_dir,
+                       _write_meta, launch_job, run_list)
         acted = []
         for meta in run_list():  # run_list reconciles statuses itself
             run_id = meta.get("run_id")
@@ -243,14 +244,26 @@ class JobMonitor:
             if status == STATUS_RUNNING:
                 if not _pid_dead(int(meta.get("pid", -1))):
                     continue
-                # dead (incl. zombie) while still RUNNING in the
-                # registry: finalize it ourselves
-                fresh = _read_meta(run_id) or meta
-                fresh["status"] = STATUS_FAILED
-                fresh["error"] = "process died without exit record"
-                _write_meta(fresh["run_id"], fresh)
-                meta = fresh
-                crashed = not rc_recorded
+                # the pid poll and the exit-record stat race the job's
+                # shutdown: a run can write exit_code between run_list's
+                # reconcile and our poll. Re-check the record NOW, before
+                # forcing FAILED — a recorded rc means a normal exit and
+                # is authoritative (finalize with it instead).
+                rc = _read_exit_code(run_id)
+                if rc is not None:
+                    _finalize(run_id, rc)   # writes terminal meta AND
+                    meta = _read_meta(run_id) or meta   # releases the
+                    meta["allocation_released"] = True  # allocation
+                    crashed = False
+                else:
+                    # dead (incl. zombie) with no exit record: a silent
+                    # death (SIGKILL/OOM) — finalize it ourselves
+                    fresh = _read_meta(run_id) or meta
+                    fresh["status"] = STATUS_FAILED
+                    fresh["error"] = "process died without exit record"
+                    _write_meta(fresh["run_id"], fresh)
+                    meta = fresh
+                    crashed = True
             elif status == STATUS_FAILED and not rc_recorded:
                 # run_status (ours or any other poller's) already marked
                 # the silent death — still OUR crash to handle, once.
@@ -274,8 +287,10 @@ class JobMonitor:
             if meta.get("monitor_handled"):
                 continue
             meta["monitor_handled"] = True
+            if not meta.get("allocation_released"):
+                _release_allocation(run_id)
+                meta["allocation_released"] = True
             _write_meta(run_id, meta)
-            _release_allocation(run_id)
             if not crashed:
                 continue
             acted.append(run_id)

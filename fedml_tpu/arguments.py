@@ -74,6 +74,12 @@ _SCHEMA: Dict[str, Any] = {
     "backend": "tpu",
     "grpc_ipconfig_path": None,
     "mqtt_config_path": None,
+    # wire-efficiency for cross-silo updates (utils/compression.py). Off by
+    # default: the wire stays byte-identical to the dense float32 path.
+    "comm_compression": None,            # topk|randk|qsgd|topk_qsgd|randk_qsgd
+    "comm_compression_ratio": 0.1,       # sparsifier keep-ratio in (0, 1]
+    "comm_quantize_levels": 127,         # QSGD levels (int8 wire, <= 127)
+    "comm_compression_broadcast": "full",  # server->client: full|bf16|compress
     # tracking_args
     "enable_wandb": False,
     "log_file_dir": "~/.cache/fedml_tpu/logs",

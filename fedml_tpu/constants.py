@@ -91,6 +91,23 @@ MSG_ARG_KEY_ROUND_INDEX = "round_idx"
 CLIENT_STATUS_ONLINE = "ONLINE"
 CLIENT_STATUS_FINISHED = "FINISHED"
 
+# Wire-efficiency for cross-silo updates (``comm_compression`` knobs):
+# sparsification/quantization of the client->server update with per-client
+# error feedback, plus the server->client sync dtype. Off by default —
+# payloads stay byte-identical to the dense float32 path.
+COMM_COMPRESSION_TOPK = "topk"
+COMM_COMPRESSION_RANDK = "randk"
+COMM_COMPRESSION_QSGD = "qsgd"
+COMM_COMPRESSION_TOPK_QSGD = "topk_qsgd"
+COMM_COMPRESSION_RANDK_QSGD = "randk_qsgd"
+COMM_COMPRESSION_METHODS = (
+    COMM_COMPRESSION_TOPK, COMM_COMPRESSION_RANDK, COMM_COMPRESSION_QSGD,
+    COMM_COMPRESSION_TOPK_QSGD, COMM_COMPRESSION_RANDK_QSGD,
+)
+COMM_BROADCAST_FULL = "full"          # dense float32 server->client sync
+COMM_BROADCAST_BF16 = "bf16"          # dense sync at half the bytes
+COMM_BROADCAST_COMPRESS = "compress"  # sync ships the compressed global delta
+
 # Mesh axis names — the vocabulary of the whole framework.
 AXIS_CLIENT = "client"   # FL round-level data parallelism (one+ clients/chip)
 AXIS_DATA = "data"       # intra-silo data parallelism (DDP analogue)

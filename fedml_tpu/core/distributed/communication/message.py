@@ -10,10 +10,55 @@ numpy + dtype + shape.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict
 
 import msgpack
 import numpy as np
+
+
+class WireStats:
+    """Bytes-on-wire ledger at the encode seam: every ``Message.encode``
+    records its serialized size under the message type, so any transport
+    (in-proc, TCP, gRPC, pub/sub) gets per-message-type accounting for
+    free. Thread-safe; one process-wide instance (``WIRE_STATS``) because
+    a process is one rank — readers diff :meth:`snapshot` across rounds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_type: Dict[Any, Dict[str, int]] = {}
+        self._total_bytes = 0
+        self._total_msgs = 0
+
+    def record(self, msg_type: Any, nbytes: int) -> None:
+        with self._lock:
+            ent = self._by_type.setdefault(msg_type,
+                                           {"bytes": 0, "messages": 0})
+            ent["bytes"] += int(nbytes)
+            ent["messages"] += 1
+            self._total_bytes += int(nbytes)
+            self._total_msgs += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"total_bytes": self._total_bytes,
+                    "total_messages": self._total_msgs,
+                    "by_type": {str(t): dict(v)
+                                for t, v in self._by_type.items()}}
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes
+
+    def reset(self) -> None:
+        with self._lock:
+            self._by_type.clear()
+            self._total_bytes = 0
+            self._total_msgs = 0
+
+
+WIRE_STATS = WireStats()
 
 
 class Message:
@@ -64,8 +109,10 @@ class Message:
 
     # --- wire format --------------------------------------------------------
     def encode(self) -> bytes:
-        return msgpack.packb(self.msg_params, default=_pack_np,
+        blob = msgpack.packb(self.msg_params, default=_pack_np,
                              use_bin_type=True)
+        WIRE_STATS.record(self.get_type(), len(blob))
+        return blob
 
     @classmethod
     def decode(cls, blob: bytes) -> "Message":
@@ -127,6 +174,34 @@ def tree_to_wire(tree) -> Dict[str, Any]:
                        for p in path)
         flat[key] = np.asarray(leaf)
     return flat
+
+
+WIRE_DTYPE_BF16 = "bf16"
+
+
+def tree_to_wire_bf16(tree) -> Dict[str, Any]:
+    """Half-width variant of :func:`tree_to_wire`: leaves cross as the
+    uint16 bit pattern of their bfloat16 rounding (ml_dtypes' bfloat16 has
+    dtype.str ``<V2``, which the numpy ext codec cannot round-trip — the
+    bit view is codec-neutral). Tag the message with
+    ``WIRE_DTYPE_BF16`` so the receiver knows to reinterpret."""
+    import jax.numpy as jnp
+    flat = tree_to_wire(tree)
+    bf16 = np.dtype(jnp.bfloat16)
+    return {k: np.asarray(v, bf16).view(np.uint16) for k, v in flat.items()}
+
+
+def bf16_wire_to_tree(flat: Dict[str, Any], template):
+    """Inverse of :func:`tree_to_wire_bf16`; leaves come back as the
+    template's dtype (float32 weights widen from the bf16 rounding)."""
+    import jax.numpy as jnp
+    bf16 = np.dtype(jnp.bfloat16)
+    widened = {k: np.asarray(np.asarray(v, np.uint16).view(bf16))
+               for k, v in flat.items()}
+    tree = wire_to_tree(widened, template)
+    import jax
+    return jax.tree_util.tree_map(
+        lambda leaf, t: np.asarray(leaf, np.asarray(t).dtype), tree, template)
 
 
 def wire_to_tree(flat: Dict[str, Any], template):

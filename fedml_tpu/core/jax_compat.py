@@ -1,0 +1,22 @@
+"""Version compatibility for jax APIs the framework uses.
+
+The codebase targets the modern surface (``jax.shard_map`` with
+``check_vma``); older jaxlib builds (< 0.6) only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent knob is spelled
+``check_rep``. Import ``shard_map`` from here instead of from jax so both
+generations of the runtime work unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_sm
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _experimental_sm(f, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_rep=check_vma,
+                                **kw)

@@ -112,6 +112,17 @@ def log_round_info(total_rounds: int, round_idx: int) -> None:
     _emit("round", {"round_idx": round_idx, "total_rounds": total_rounds})
 
 
+def log_comm_round(round_idx: int, wire_bytes: int,
+                   compression: Optional[str] = None,
+                   by_type: Optional[Dict[str, Any]] = None) -> None:
+    """Bytes-on-wire for one FL round, as recorded by the ``WireStats``
+    ledger at the ``Message.encode`` seam (``wire_bytes`` is the diff of
+    the ledger across the round; ``by_type`` optionally carries the
+    per-message-type breakdown of a full snapshot)."""
+    _emit("comm", {"round_idx": round_idx, "wire_bytes": int(wire_bytes),
+                   "compression": compression, "by_type": by_type})
+
+
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:
     _emit("status", {"role": "client", "status": status})
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...jax_compat import shard_map
 from . import robust_agg
 
 # defenses expressible as: selection weights from psum'd statistics, then a
@@ -99,7 +100,7 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
                                    byzantine_count, multi_k)
         return robust_agg.weighted_mean(mat_s, sel_w)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(), P(), P()),
         out_specs=P(axis),

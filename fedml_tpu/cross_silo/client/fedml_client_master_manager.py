@@ -16,9 +16,14 @@ import jax
 import numpy as np
 
 from ...core import mlops
-from ...core.distributed.communication.message import (Message, tree_to_wire,
+from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
+                                                       Message,
+                                                       bf16_wire_to_tree,
+                                                       tree_to_wire,
                                                        wire_to_tree)
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...utils.compression import (decompress_vec, ef_compress_vec,
+                                  is_compressed_payload, spec_from_args)
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
@@ -31,6 +36,16 @@ class ClientMasterManager(FedMLCommManager):
         self.trainer = trainer
         self.round_idx = 0
         self.server_rank = 0
+        # wire-efficient updates: when a spec is configured the upload is
+        # the compressed delta vs the RECEIVED global model, with this
+        # client's error-feedback residual carried across rounds so the
+        # biased sparsifier still converges. None = dense path, unchanged.
+        self.cc_spec = spec_from_args(args)
+        self._cc_residual = None
+        self._global_vec = None   # f32 vector of the last received global
+        self._cc_rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 97),
+            self.rank)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -60,18 +75,51 @@ class ClientMasterManager(FedMLCommManager):
     def handle_message_receive_model_from_server(self, msg: Message) -> None:
         self._train_and_report(msg)
 
-    def _train_and_report(self, msg: Message) -> None:
+    def _receive_global(self, msg: Message):
+        """Reassemble the server's sync payload: dense f32 (default),
+        dense bf16 (``wire_dtype`` tag), or a compressed delta vs the last
+        received global (``comm_compression_broadcast: compress``)."""
+        update = msg.get(MyMessage.MSG_ARG_KEY_MODEL_UPDATE)
+        if is_compressed_payload(update):
+            if self._global_vec is None:
+                raise RuntimeError(
+                    "compressed sync before a dense init model")
+            self._global_vec = self._global_vec + decompress_vec(update)
+            return self.trainer.vec_to_params(self._global_vec)
         wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        if msg.get(MyMessage.MSG_ARG_KEY_WIRE_DTYPE) == WIRE_DTYPE_BF16:
+            params = bf16_wire_to_tree(wire, self.trainer.params_template)
+        else:
+            params = wire_to_tree(wire, self.trainer.params_template)
+        if self.cc_spec is not None and self.cc_spec.method is not None:
+            self._global_vec = self.trainer.params_to_vec(params)
+        return params
+
+    def _train_and_report(self, msg: Message) -> None:
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, 0))
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
-        params = wire_to_tree(wire, self.trainer.params_template)
+        params = self._receive_global(msg)
         with mlops.event("train", round_idx=self.round_idx):
             new_params, n_samples, metrics = self.trainer.train(
                 params, client_idx, self.round_idx)
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
                       self.server_rank)
-        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                       tree_to_wire(new_params))
+        if self.cc_spec is not None and self.cc_spec.method is not None:
+            # broadcast-only specs (method None, e.g. bf16 downlink) keep
+            # the dense uplink below
+            delta = self.trainer.params_to_vec(new_params) - self._global_vec
+            blob, self._cc_residual = ef_compress_vec(
+                delta, self._cc_residual, self.cc_spec,
+                jax.random.fold_in(self._cc_rng, self.round_idx))
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_UPDATE, blob)
+            # a delta is only meaningful against the round's broadcast
+            # base — tag it so the server can drop stragglers from a
+            # timed-out round instead of reconstructing against the
+            # wrong base (dense path omits this: byte-identical wire)
+            out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+        else:
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           tree_to_wire(new_params))
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n_samples))
         out.add_params(MyMessage.MSG_ARG_KEY_CLIENT_METRICS,
                        {k: float(v) for k, v in (metrics or {}).items()})

@@ -44,6 +44,20 @@ class SiloTrainer:
                  "client_state": {}, "hyper": hyper})
         return new_params, metrics
 
+    # --- flat-vector views (wire-efficient update path) ---------------------
+    def params_to_vec(self, params):
+        """Host float32 vector view of a params tree (leaf order is the
+        template's — both FL sides flatten the same structure)."""
+        import numpy as np
+
+        from ...core.collectives import tree_flatten_to_vector
+        return np.asarray(tree_flatten_to_vector(params), np.float32)
+
+    def vec_to_params(self, vec):
+        from ...core.collectives import vector_to_tree_like
+        return vector_to_tree_like(jnp.asarray(vec, jnp.float32),
+                                   self.params_template)
+
     def train(self, params, client_idx: int, round_idx: int
               ) -> Tuple[dict, float, Dict[str, float]]:
         cdata = jax.tree_util.tree_map(lambda a: a[client_idx],

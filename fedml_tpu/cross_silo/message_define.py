@@ -18,6 +18,12 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
     MSG_ARG_KEY_CLIENT_METRICS = "client_metrics"
+    # wire-efficient updates (utils/compression.py): a compressed delta
+    # blob replaces MODEL_PARAMS in whichever direction is compressed;
+    # WIRE_DTYPE tags a dense payload whose leaves cross at reduced
+    # precision (bf16 bit views)
+    MSG_ARG_KEY_MODEL_UPDATE = "model_update"
+    MSG_ARG_KEY_WIRE_DTYPE = "wire_dtype"
     # statuses
     MSG_CLIENT_STATUS_ONLINE = "ONLINE"
     MSG_CLIENT_STATUS_IDLE = "IDLE"

@@ -21,10 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...core.collectives import tree_weighted_average
+from ...core.collectives import (tree_weighted_average,
+                                 vector_to_tree_like)
 from ...core.dp import FedMLDifferentialPrivacy
 from ...core.security import FedMLDefender, stack_to_matrix
-from ...core.collectives import vector_to_tree_like
 
 logger = logging.getLogger(__name__)
 
@@ -54,6 +54,32 @@ class FedMLAggregator:
             self.sample_num_dict[index] = float(sample_num)
             self.flag_client_model_uploaded_dict[index] = True
             self._lock.notify_all()
+
+    def add_local_trained_delta(self, index: int, delta_vec,
+                                sample_num: float,
+                                base_vec=None) -> None:
+        """Wire-efficient upload path: reconstruct the sender's full model
+        from a decompressed update delta (host f32 vector, flattened in
+        the global tree's leaf order), then store it like any dense
+        upload — weighted aggregation, defenses, and DP all run
+        downstream in float32, unchanged.
+
+        ``base_vec`` is the model vector the SENDER trained from. It must
+        be supplied when the broadcast itself was compressed: the clients
+        hold a reconstruction that differs from the server's exact global,
+        and adding their deltas to the wrong base re-injects that gap into
+        the average every round (a systematic bias that diverges). When
+        the broadcast was dense, the current global IS the base."""
+        if base_vec is not None:
+            vec = jnp.asarray(base_vec, jnp.float32) + jnp.asarray(
+                delta_vec, jnp.float32)
+            params = vector_to_tree_like(vec, self.global_params)
+        else:
+            delta = vector_to_tree_like(jnp.asarray(delta_vec, jnp.float32),
+                                        self.global_params)
+            params = jax.tree_util.tree_map(
+                lambda g, d: jnp.asarray(g) + d, self.global_params, delta)
+        self.add_local_trained_result(index, params, sample_num)
 
     def check_whether_all_receive(self) -> bool:
         with self._lock:

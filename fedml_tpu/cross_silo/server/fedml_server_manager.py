@@ -16,9 +16,16 @@ import jax
 import numpy as np
 
 from ...core import mlops
-from ...core.distributed.communication.message import (Message, tree_to_wire,
+from ...core.collectives import tree_flatten_to_vector
+from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
+                                                       WIRE_STATS, Message,
+                                                       bf16_wire_to_tree,
+                                                       tree_to_wire,
+                                                       tree_to_wire_bf16,
                                                        wire_to_tree)
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...utils.compression import (decompress_vec, ef_compress_vec,
+                                  is_compressed_payload, spec_from_args)
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
@@ -44,6 +51,28 @@ class FedMLServerManager(FedMLCommManager):
         self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
         self._round_lock = threading.Lock()
         self._round_timer: Optional[threading.Timer] = None
+        # wire-efficient updates: clients upload compressed deltas that
+        # handle_message_receive_model_from_client decompresses; the
+        # sync broadcast optionally ships bf16 or (with its own server-side
+        # error-feedback residual) a compressed global delta.
+        self.cc_spec = spec_from_args(args)
+        self._bcast_prev_vec = None   # what the CLIENTS have reconstructed
+        self._bcast_residual = None
+        self._cc_rng = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0)) + 53)
+        # bytes-on-wire ledger mark for per-round accounting (counts this
+        # process's encodes: all S2C traffic; in-proc sessions also count
+        # the client threads' uploads, which is what the bench wants)
+        self._wire_mark = WIRE_STATS.total_bytes
+
+    def _global_f32_vec(self) -> np.ndarray:
+        """The global model flattened to a host f32 vector — the SINGLE
+        definition of the base-tracking representation the compressed-delta
+        protocol hangs on (clients flatten the same way via params_to_vec;
+        any divergence in dtype/ordering silently corrupts every delta)."""
+        return np.asarray(
+            tree_flatten_to_vector(self.aggregator.global_params),
+            np.float32)
 
     # --- FSM wiring ---------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -67,11 +96,23 @@ class FedMLServerManager(FedMLCommManager):
             self.send_init_msg()
 
     def send_init_msg(self) -> None:
-        """(reference :48-86) ship round-0 model + data-silo index."""
+        """(reference :48-86) ship round-0 model + data-silo index. Always
+        dense: the init model is the common reference both sides compute
+        deltas against (a ``compress`` broadcast needs every client to hold
+        the exact vector the server tracks in ``_bcast_prev_vec``)."""
         client_indexes = self.aggregator.client_selection(
             self.round_idx, int(self.args.client_num_in_total),
             self.client_num)
         wire = tree_to_wire(self.aggregator.global_params)
+        if self.cc_spec is not None and self.cc_spec.method is not None:
+            # whenever clients upload deltas the server must track the base
+            # they refer to (what the clients reconstruct) — for EVERY
+            # broadcast mode, including dense 'full': the upload handler
+            # captures this base under _round_lock, so a round-timeout
+            # aggregation racing a late upload cannot swap the base
+            # mid-flight. After a dense init it is the exact global vector.
+            # Broadcast-only specs (method None) get no deltas: skip.
+            self._bcast_prev_vec = self._global_f32_vec()
         for i, rank in enumerate(sorted(self.client_online_status)):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
@@ -82,10 +123,35 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
-        wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
-        params = wire_to_tree(wire, self.aggregator.global_params)
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
-        self.aggregator.add_local_trained_result(sender, params, n)
+        update = msg.get(MyMessage.MSG_ARG_KEY_MODEL_UPDATE)
+        if is_compressed_payload(update):  # delta vs the broadcast model
+            up_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+            delta = decompress_vec(update)  # stateless: outside the lock
+            with self._round_lock:
+                stale = (up_round is not None
+                         and int(up_round) != self.round_idx)
+                if not stale:
+                    # the add must share the stale check's lock
+                    # acquisition: a round-timeout aggregation slipping
+                    # between them would advance the round and let this
+                    # round's model land in the NEXT round's pool
+                    self.aggregator.add_local_trained_delta(
+                        sender, delta, n, base_vec=self._bcast_prev_vec)
+            if stale:
+                # a straggler from a timed-out round: its delta refers
+                # to a base the server already advanced past —
+                # reconstructing against the new base would store a
+                # model that is neither the sender's nor anyone's
+                logger.warning(
+                    "server: dropping stale compressed update from silo "
+                    "%s (round %s, now %d)", sender, up_round,
+                    self.round_idx)
+                return
+        else:
+            wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+            params = wire_to_tree(wire, self.aggregator.global_params)
+            self.aggregator.add_local_trained_result(sender, params, n)
         if not self.aggregator.check_whether_all_receive():
             # elastic rounds (capability beyond the reference, SURVEY §5.3):
             # a dead silo must not stall the barrier forever — arm a
@@ -127,29 +193,86 @@ class FedMLServerManager(FedMLCommManager):
             import jax.random as jrandom
             round_key = jrandom.fold_in(self._root_key, self.round_idx)
             self.aggregator.aggregate(round_key)
+            # close the round under the SAME lock acquisition that
+            # aggregates: a straggler arriving during the (slow) server
+            # eval below must already see the new round_idx, or its
+            # compressed delta would pass the stale check and be
+            # reconstructed against the advanced base
+            completed_round = self.round_idx
+            self.round_idx += 1
         stats = self.aggregator.test_on_server()
-        rec = {"round": self.round_idx}
+        rec = {"round": completed_round}
         if stats:
             rec.update(stats)
-            logger.info("server round %d: %s", self.round_idx, stats)
+            logger.info("server round %d: %s", completed_round, stats)
+        # bytes-on-wire this round (diff of the process-wide encode ledger)
+        total = WIRE_STATS.total_bytes
+        rec["wire_bytes"] = total - self._wire_mark
+        self._wire_mark = total
+        mlops.log_comm_round(completed_round, rec["wire_bytes"],
+                             compression=getattr(self.cc_spec, "method",
+                                                 None))
         self.history.append(rec)
-        mlops.log_round_info(self.round_num, self.round_idx)
-        with self._round_lock:
-            self.round_idx += 1
+        mlops.log_round_info(self.round_num, completed_round)
         if self.round_idx >= self.round_num:
             self.finish_session()
             return
         self.sync_model_to_clients()
 
+    def _sync_payload(self):
+        """Build the per-round sync payload once (shared by every client):
+        list of (param_key, value) pairs added to each sync message."""
+        spec = self.cc_spec
+        if (spec is not None and spec.broadcast == "compress"
+                and self._bcast_prev_vec is not None):
+            # ship the compressed delta of the global model vs what the
+            # clients currently hold; the server's own error-feedback
+            # residual carries the truncated mass — and _bcast_prev_vec
+            # advances by the DECODED delta so it keeps tracking the
+            # clients' reconstruction, not the exact global. The
+            # decompress_vec of our own blob is deliberate: it is the
+            # same host routine every client runs, so the tracked base
+            # is BIT-identical to theirs — the algebraic shortcut
+            # (comp - residual) is not bit-exact in f32 and would let
+            # the bases drift apart by an accumulating rounding gap
+            gvec = self._global_f32_vec()
+            blob, self._bcast_residual = ef_compress_vec(
+                gvec - self._bcast_prev_vec, self._bcast_residual, spec,
+                jax.random.fold_in(self._cc_rng, self.round_idx))
+            self._bcast_prev_vec = self._bcast_prev_vec + decompress_vec(blob)
+            return [(MyMessage.MSG_ARG_KEY_MODEL_UPDATE, blob)]
+        if spec is not None and spec.broadcast == "bf16":
+            wire = tree_to_wire_bf16(self.aggregator.global_params)
+            if spec.method is not None:
+                # the clients reconstruct the bf16 ROUNDING of the global —
+                # track that as the base their compressed deltas refer to
+                # (adding deltas to the exact f32 global instead would fold
+                # the broadcast's rounding gap into every aggregate).
+                # Decode the wire payload with the same routine the clients
+                # run, so the tracked base is definitionally what they hold
+                self._bcast_prev_vec = np.asarray(tree_flatten_to_vector(
+                    bf16_wire_to_tree(wire, self.aggregator.global_params)),
+                    np.float32)
+            return [(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire),
+                    (MyMessage.MSG_ARG_KEY_WIRE_DTYPE, WIRE_DTYPE_BF16)]
+        if spec is not None and spec.method is not None:
+            # dense 'full' broadcast with compressed uplinks: the clients
+            # will train from (and delta against) the exact f32 global —
+            # refresh the tracked base now, before any client can reply
+            self._bcast_prev_vec = self._global_f32_vec()
+        return [(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                 tree_to_wire(self.aggregator.global_params))]
+
     def sync_model_to_clients(self) -> None:
         client_indexes = self.aggregator.client_selection(
             self.round_idx, int(self.args.client_num_in_total),
             self.client_num)
-        wire = tree_to_wire(self.aggregator.global_params)
+        payload = self._sync_payload()
         for i, rank in enumerate(sorted(self.client_online_status)):
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                           self.rank, rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+            for key, value in payload:
+                msg.add_params(key, value)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                            int(client_indexes[i % len(client_indexes)]))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)

@@ -78,8 +78,22 @@ def load_fednlp_text_classification(data_dir: str, batch_size: int,
                            for k in data_f["Y"]})
             label_vocab = {lab: i for i, lab in enumerate(seen)}
         num_labels = int(attrs.get("num_labels") or len(label_vocab))
-        if num_labels <= 0:
-            num_labels = len(label_vocab)
+
+        def label_id(lab: str) -> int:
+            """An INCOMPLETE declared vocab (partial/corrupt cache) would
+            KeyError on the first undeclared label — extend the vocab on
+            the fly instead (ids after the declared ones; num_labels is
+            re-widened below before it is used). Lazy so the healthy
+            complete-vocab path never pays a full Y scan."""
+            idx = label_vocab.get(lab)
+            if idx is None:
+                idx = max(label_vocab.values(), default=-1) + 1
+                label_vocab[lab] = idx
+                logger.warning(
+                    "FedNLP label_vocab in %s lacks label %r present in "
+                    "Y; extending the vocab (id %d)", data_files[0], lab,
+                    idx)
+            return idx
 
         avail = list(part_f.keys())
         if partition_method and partition_method in part_f:
@@ -104,7 +118,7 @@ def load_fednlp_text_classification(data_dir: str, batch_size: int,
             xs = np.asarray([_byte_ids(_as_str(data_f["X"][str(i)][()]),
                                        max_len) for i in idx_list],
                             np.int32)
-            ys = np.asarray([label_vocab[_as_str(data_f["Y"][str(i)][()])]
+            ys = np.asarray([label_id(_as_str(data_f["Y"][str(i)][()]))
                              for i in idx_list], np.int64)
             return xs, ys
 
@@ -119,6 +133,9 @@ def load_fednlp_text_classification(data_dir: str, batch_size: int,
                 test_chunks.append(read(te_idx))
         if not test_chunks:
             return None
+        max_id = max(label_vocab.values(), default=-1)
+        if num_labels <= max_id:  # every id must fit the output dim
+            num_labels = max_id + 1
         test_x = np.concatenate([c[0] for c in test_chunks])
         test_y = np.concatenate([c[1] for c in test_chunks])
         fed = build_federated_dataset(cxs, cys, test_x, test_y,

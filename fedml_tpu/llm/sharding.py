@@ -123,7 +123,7 @@ def make_ring_forward(model_apply: Callable, mesh: Mesh,
     logits`` (sharded on the sequence axis); ``attn_mask`` is a [b, S]
     key-padding mask (1 = real token) sharded over ``sp`` alongside the
     tokens — it rotates with K/V inside ring attention."""
-    from jax import shard_map
+    from ..core.jax_compat import shard_map
 
     size = mesh.shape[axis_name]
 

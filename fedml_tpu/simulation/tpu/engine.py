@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...constants import AXIS_CLIENT
+from ...core.jax_compat import shard_map
 from ...core.algframe.types import ClientData, TrainHyper
 from ...core.algframe.local_training import evaluate
 from ...core.collectives import (
@@ -276,7 +277,7 @@ class TPUSimulator:
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             return new_params, new_sstate, states, metrics
 
-        shard_fn = jax.shard_map(
+        shard_fn = shard_map(
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
@@ -320,7 +321,7 @@ class TPUSimulator:
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             return params, server_state, states, metrics  # metrics: [R]
 
-        shard_fn = jax.shard_map(
+        shard_fn = shard_map(
             rounds_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
@@ -395,7 +396,7 @@ class TPUSimulator:
             upd_stack = jax.tree_util.tree_map(lambda a: a[None], upd_stack)
             return upd_stack, w_stack[None], agg_extras, states, metrics
 
-        shard_fn = jax.shard_map(
+        shard_fn = shard_map(
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
@@ -635,6 +636,14 @@ class TPUSimulator:
             keys.append(jax.random.fold_in(self.rng, r))
             ridxs.append(r)
             part += len(sampled) / max(self.fed.num_clients, 1)
+        # build_schedule buckets slot counts per round (powers of two), so
+        # rounds in one block can disagree on width — pad to the block's
+        # max; padded slots carry active=0 and are masked in the round body
+        width = max(i.shape[1] for i in idxs)
+        idxs = [np.pad(np.asarray(i), ((0, 0), (0, width - i.shape[1])))
+                for i in idxs]
+        acts = [np.pad(np.asarray(a), ((0, 0), (0, width - a.shape[1])))
+                for a in acts]
         sched_sharding = NamedSharding(self.mesh, P(None, AXIS_CLIENT))
         idxs = jax.device_put(jnp.stack([jnp.asarray(i) for i in idxs],
                                         axis=0), sched_sharding)

@@ -9,10 +9,13 @@ pytrees for the WAN managers, whose payloads shrink by the sparsity factor.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 PyTree = Any
 
@@ -65,3 +68,188 @@ def decompress_tree(blob: Dict[str, Any], template: PyTree) -> PyTree:
     vec = decompress(jnp.asarray(blob["values"]),
                      jnp.asarray(blob["indices"]), int(blob["length"]))
     return vector_to_tree_like(vec, template)
+
+
+# --- wire-efficient cross-silo updates -------------------------------------
+#
+# QSGD-style stochastic int8 quantization (Alistarh et al., 2017) composed
+# with top-k/rand-k sparsification and per-sender error feedback (Lin et
+# al., 2018 Deep Gradient Compression; Karimireddy et al., 2019 EF-SGD).
+# The compress cores are jit-able pure functions on flat f32 vectors; the
+# WAN managers carry the residual across rounds so biased compressors
+# still converge.
+
+#: int8 carries sign * level with level in [0, 127]
+QSGD_MAX_LEVELS = 127
+
+#: marker key identifying a compressed-update payload on the wire
+WIRE_FLAG = "__cc__"
+
+from ..constants import (COMM_BROADCAST_BF16, COMM_BROADCAST_COMPRESS,
+                         COMM_BROADCAST_FULL, COMM_COMPRESSION_METHODS)
+
+
+@dataclass(frozen=True)
+class CommCompressionSpec:
+    """Parsed ``comm_compression`` config (see ``arguments.py`` knobs).
+    ``method=None`` is a broadcast-only spec (bf16 downlink, dense f32
+    uplink)."""
+    method: Optional[str]       # one of COMM_COMPRESSION_METHODS, or None
+    ratio: float = 0.1          # sparsifier keep-ratio (ignored by 'qsgd')
+    levels: int = QSGD_MAX_LEVELS   # quantization levels (<= 127 for int8)
+    broadcast: str = "full"     # server->client sync: full | bf16 | compress
+
+    def __post_init__(self):
+        if self.method is not None \
+                and self.method not in COMM_COMPRESSION_METHODS:
+            raise ValueError(
+                f"unknown comm_compression method {self.method!r} "
+                f"(one of {COMM_COMPRESSION_METHODS})")
+        if not 0.0 < float(self.ratio) <= 1.0:
+            raise ValueError(f"comm_compression_ratio must be in (0, 1], "
+                             f"got {self.ratio}")
+        if not 1 <= int(self.levels) <= QSGD_MAX_LEVELS:
+            raise ValueError(f"comm_quantize_levels must be in [1, "
+                             f"{QSGD_MAX_LEVELS}], got {self.levels}")
+        if self.broadcast not in (COMM_BROADCAST_FULL, COMM_BROADCAST_BF16,
+                                  COMM_BROADCAST_COMPRESS):
+            raise ValueError(f"comm_compression_broadcast must be full|"
+                             f"bf16|compress, got {self.broadcast!r}")
+        if self.method is None and self.broadcast == COMM_BROADCAST_COMPRESS:
+            raise ValueError(
+                "comm_compression_broadcast=compress needs a compressor: "
+                f"set comm_compression (one of {COMM_COMPRESSION_METHODS})")
+
+    @property
+    def quantized(self) -> bool:
+        return bool(self.method) and self.method.endswith("qsgd")
+
+
+def spec_from_args(args) -> Optional[CommCompressionSpec]:
+    """Build the spec from flat config; None = compression off (the
+    default — wire payloads stay byte-identical to the uncompressed
+    path)."""
+    method = getattr(args, "comm_compression", None)
+    if not method or str(method).lower() in ("none", "off", "false", "0"):
+        method = None
+    # None-checks, not `or`: an explicit 0 must reach the spec validation
+    # and be rejected there, not silently become the default
+    ratio = getattr(args, "comm_compression_ratio", None)
+    levels = getattr(args, "comm_quantize_levels", None)
+    broadcast = getattr(args, "comm_compression_broadcast", None)
+    broadcast = "full" if broadcast is None else str(broadcast).lower()
+    if method is None and broadcast == COMM_BROADCAST_FULL:
+        return None
+    # a non-full broadcast alone still yields a spec (bf16-only downlink
+    # must not be silently ignored; compress-only is rejected in __post_init__)
+    return CommCompressionSpec(
+        method=None if method is None else str(method).lower(),
+        ratio=0.1 if ratio is None else float(ratio),
+        levels=QSGD_MAX_LEVELS if levels is None else int(levels),
+        broadcast=broadcast)
+
+
+def _stochastic_round(x: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+    """Unbiased randomized rounding: E[floor(x + U[0,1))] = x."""
+    return jnp.floor(x + jax.random.uniform(rng, x.shape, x.dtype))
+
+
+def qsgd_quantize(vec: jnp.ndarray, levels: int, rng: jax.Array
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stochastic uniform quantization to int8 sign*level: returns
+    (q[int8], scale[f32]) with E[dequantize(q, scale)] = vec."""
+    levels = int(levels)
+    vec = vec.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(vec)) if vec.shape[0] else jnp.float32(0)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    mag = _stochastic_round(jnp.abs(vec) / safe * levels, rng)
+    q = jnp.sign(vec) * jnp.clip(mag, 0, levels)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def qsgd_dequantize(q: jnp.ndarray, scale, levels: int) -> jnp.ndarray:
+    return q.astype(jnp.float32) * (jnp.float32(scale) / int(levels))
+
+
+@functools.lru_cache(maxsize=None)
+def _ef_compress_core(method: str, d: int, k: int, levels: int):
+    """Jitted (compensate -> sparsify -> quantize -> residual) core for a
+    given static shape/config. Returns (values, indices, scale, residual)
+    with indices/scale possibly unused depending on the method."""
+
+    def core(vec, residual, rng):
+        comp = vec.astype(jnp.float32) + residual
+        srng, qrng = jax.random.split(rng)
+        if method.startswith("topk"):
+            vals, idx = topk_compress(comp, k)
+        elif method.startswith("randk"):
+            # contractive rand-k (no d/k rescale): error feedback re-injects
+            # the dropped mass next round — the unbiased rescale of
+            # randk_compress would make the residual grow without bound here
+            idx = jax.random.choice(srng, d, shape=(k,),
+                                    replace=False).astype(jnp.int32)
+            vals = comp[idx]
+        else:  # pure qsgd: dense quantization
+            vals, idx = comp, jnp.arange(d, dtype=jnp.int32)
+        if method.endswith("qsgd"):
+            q, scale = qsgd_quantize(vals, levels, qrng)
+            deq = qsgd_dequantize(q, scale, levels)
+            out_vals: Any = q
+        else:
+            deq = vals
+            scale = jnp.float32(0)
+            out_vals = vals
+        restored = jnp.zeros(d, jnp.float32).at[idx].set(deq)
+        return out_vals, idx, scale, comp - restored
+
+    return jax.jit(core)
+
+
+def ef_compress_vec(vec, residual, spec: CommCompressionSpec,
+                    rng: jax.Array) -> Tuple[Dict[str, Any], np.ndarray]:
+    """Compress a flat f32 update with error feedback.
+
+    ``residual`` is the sender's carry-over from previous rounds (None on
+    round 0). Returns ``(wire_blob, new_residual)`` — the blob is a
+    msgpack-friendly dict of host numpy arrays; the residual must be fed
+    back on the next call so compression error is re-injected instead of
+    lost (this is what makes biased sparsifiers converge)."""
+    vec = np.asarray(vec, np.float32).ravel()
+    d = int(vec.shape[0])
+    if residual is None:
+        residual = np.zeros(d, np.float32)
+    k = max(int(d * float(spec.ratio)), 1) if spec.method != "qsgd" else d
+    vals, idx, scale, new_residual = _ef_compress_core(
+        spec.method, d, k, int(spec.levels))(vec, np.asarray(residual,
+                                                            np.float32), rng)
+    blob: Dict[str, Any] = {WIRE_FLAG: 1, "m": spec.method, "d": d,
+                            "v": np.asarray(vals)}
+    if spec.method != "qsgd":  # dense qsgd needs no index list
+        host_idx = np.asarray(idx)
+        blob["i"] = host_idx.astype(
+            np.uint16 if d <= np.iinfo(np.uint16).max else np.int32)
+    if spec.quantized:
+        blob["s"] = float(scale)
+        blob["L"] = int(spec.levels)
+    return blob, np.asarray(new_residual)
+
+
+def is_compressed_payload(payload: Any) -> bool:
+    return isinstance(payload, dict) and bool(payload.get(WIRE_FLAG))
+
+
+def decompress_vec(blob: Dict[str, Any]) -> np.ndarray:
+    """Inverse of :func:`ef_compress_vec` (host-side, numpy only — the
+    receiver need not touch the accelerator to reassemble the update)."""
+    d = int(blob["d"])
+    vals = np.asarray(blob["v"])
+    if "s" in blob:  # quantized values: int8 sign*level -> f32
+        vals = vals.astype(np.float32) * (float(blob["s"])
+                                          / int(blob["L"]))
+    else:
+        vals = vals.astype(np.float32)
+    if "i" not in blob:
+        return vals.astype(np.float32, copy=False)
+    out = np.zeros(d, np.float32)
+    out[np.asarray(blob["i"]).astype(np.int64)] = vals
+    return out

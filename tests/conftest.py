@@ -8,6 +8,7 @@ var alone does not win — ``jax.config.update`` does.
 """
 
 import os
+import subprocess
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -20,8 +21,57 @@ if "collective_call_terminate" not in flags:
     # legitimately keeps busy devices computing for minutes while padded
     # devices idle at the all-reduce — raise the limits; slowness on a
     # TEST mesh is not an error condition.
-    flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
-              " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    #
+    # These flags are version-dependent, and XLA ABORTS the process on an
+    # unknown flag at first backend init (parse_flags_from_env.cc) — which
+    # would kill the whole pytest run. Probe support in a throwaway
+    # subprocess and only keep them if that survives; support is a pure
+    # function of the installed jaxlib, so cache the verdict per version.
+    candidate = (flags
+                 + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=300"
+                 + " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+    import hashlib
+    import tempfile
+    # key the verdict on the EXACT candidate string, not just the jaxlib
+    # version: pre-existing env XLA_FLAGS are embedded in the candidate, so
+    # a verdict from one environment must not be reused in another
+    try:  # no dist metadata for conda/source/vendored jaxlib builds —
+        # the hash of the candidate still keys the cache, just coarser
+        import importlib.metadata
+        jaxlib_ver = importlib.metadata.version("jaxlib")
+    except Exception:
+        jaxlib_ver = "unknown"
+    cand_key = hashlib.sha256(candidate.encode()).hexdigest()[:12]
+    cache = os.path.join(
+        tempfile.gettempdir(),
+        f"fedml_tpu_xla_flag_probe_{jaxlib_ver}_{cand_key}")
+    try:
+        verdict = open(cache).read().strip()
+    except OSError:
+        cacheable = True
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env={**os.environ, "XLA_FLAGS": candidate},
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=120)
+            verdict = "ok" if probe.returncode == 0 else "bad"
+            if probe.returncode < 0:
+                # killed by a signal (OOM/SIGKILL): environment trouble,
+                # not a flag verdict — don't cache it
+                cacheable = False
+        except subprocess.TimeoutExpired:
+            # a loaded host, not a flag verdict: skip the flags this run
+            # but don't poison the cache with a permanent 'bad'
+            verdict, cacheable = "bad", False
+        if cacheable:
+            try:
+                with open(cache, "w") as f:
+                    f.write(verdict)
+            except OSError:
+                pass  # unwritable tmp: just probe again next run
+    if verdict == "ok":
+        flags = candidate
 os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402

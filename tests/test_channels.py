@@ -4,6 +4,11 @@ SecAgg/LSA runtimes rely on so the server routes only ciphertext."""
 import numpy as np
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="core/mpc/channels.py needs the cryptography package (not"
+           " bundled in every runtime image)")
+
 from fedml_tpu.core.mpc import channels
 
 

@@ -516,6 +516,33 @@ class TestFedNLPFormat:
             str(d), batch_size=2, partition_method="uniform")  # absent
         assert n == 2 and fed.num_clients == 2
 
+    def test_incomplete_label_vocab_extends_instead_of_keyerror(
+            self, tmp_path):
+        """A declared vocab missing labels present in Y (partial/corrupt
+        cache) must not KeyError: undeclared labels get fresh ids past the
+        declared ones and num_labels widens to fit them."""
+        import h5py
+        import json as _json
+        import numpy as np
+        from fedml_tpu.data.fednlp_h5 import load_fednlp_text_classification
+        d = tmp_path / "fednlp_partial"
+        d.mkdir()
+        with h5py.File(d / "t_data.h5", "w") as f:
+            f["attributes"] = _json.dumps({
+                "num_labels": 2, "label_vocab": {"a": 0, "b": 1}})
+            for i, lab in enumerate(["a", "b", "c", "c"]):  # c undeclared
+                f[f"X/{i}"] = f"text {i}"
+                f[f"Y/{i}"] = lab
+        with h5py.File(d / "t_partition.h5", "w") as f:
+            g = f.create_group("uniform")
+            g["n_clients"] = 1
+            pd = g.create_group("partition_data")
+            pd.create_group("0")["train"] = [0, 1, 2]
+            pd["0"]["test"] = [3]
+        fed, n = load_fednlp_text_classification(str(d), batch_size=2)
+        assert n == 3                       # widened past declared 2
+        assert int(np.asarray(fed.test["y"]).max()) == 2  # c -> id 2
+
     def test_dispatch_through_data_loader(self, tmp_path):
         from fedml_tpu import data as data_mod
         from fedml_tpu.arguments import Arguments

@@ -104,7 +104,7 @@ def test_flash_all_masked_row_is_zero():
     np.testing.assert_allclose(np.asarray(dense)[:, 4:],
                                np.asarray(out)[:, 4:], atol=1e-5)
     # same contract for ring attention (mask rotates with K/V)
-    from jax import shard_map
+    from fedml_tpu.core.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from fedml_tpu.core.mesh import build_mesh
     mesh = build_mesh({"sp": 4}, devices=jax.devices()[:4])
@@ -154,7 +154,7 @@ def test_flash_bwd_never_materializes_scores():
 
 
 def test_ring_matches_dense_multidevice():
-    from jax import shard_map
+    from fedml_tpu.core.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from fedml_tpu.core.mesh import build_mesh
 
@@ -176,7 +176,7 @@ def test_ring_gradients_match_dense():
     """Ring attention must be TRAINABLE: gradients through the ppermute
     accumulation (sequence-parallel backward) match the dense single-
     device gradients — the property a long-context fine-tune relies on."""
-    from jax import shard_map
+    from fedml_tpu.core.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from fedml_tpu.core.mesh import build_mesh
 
@@ -215,7 +215,7 @@ def test_ring_bwd_residuals_stay_linear_in_s():
     compiled temp memory stays well under the full [s, s] score matrix
     (the un-remat'd form measures ~3x over this bound at s=4096 and the
     gap grows with s)."""
-    from jax import shard_map
+    from fedml_tpu.core.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from fedml_tpu.core.mesh import build_mesh
 

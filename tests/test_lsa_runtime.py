@@ -6,6 +6,11 @@ import threading
 import numpy as np
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="core/mpc/channels.py needs the cryptography package (not"
+           " bundled in every runtime image)")
+
 from fedml_tpu import data as data_mod
 from fedml_tpu import model as model_mod
 from fedml_tpu.arguments import Arguments
