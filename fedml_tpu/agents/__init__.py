@@ -543,9 +543,19 @@ class SlaveAgent:
 
     def _status(self, request_id: str, status: str, **extra) -> None:
         self._last_status[request_id] = {"status": status, **extra}
-        self.center.publish(TOPIC_STATUS, {
-            "device_id": self.device_id, "request_id": request_id,
-            "status": status, "ts": time.time(), **extra})
+        payload = {"device_id": self.device_id, "request_id": request_id,
+                   "status": status, "ts": time.time(), **extra}
+        if self.device_token:
+            # status frames carry an HMAC like presence proofs: without
+            # one, any broker-authenticated peer could flip this device's
+            # live job to FAILED/FINISHED on a registry-wired master.
+            # Re-announcements mint a fresh nonce (proofs are single-use)
+            from .accounts import status_proof
+            payload["nonce"] = uuid.uuid4().hex
+            payload["proof"] = status_proof(
+                self.device_token, str(self.device_id), request_id,
+                status, payload["ts"], payload["nonce"])
+        self.center.publish(TOPIC_STATUS, payload)
 
     def _on_start(self, payload: dict) -> None:
         from .. import api
@@ -777,6 +787,27 @@ class MasterAgent:
     def stop(self) -> None:
         self.center.stop()
 
+    def _spend_nonce(self, key: str) -> bool:
+        """Single-use nonce ledger shared by presence and status proofs
+        (callers namespace their keys). False = already spent. Pruning:
+        age out entries past the freshness window; under a flood of
+        still-fresh nonces, evict oldest-first down to the cap rather
+        than growing (and scanning) forever."""
+        with self._cv:
+            if key in self._presence_nonces:
+                return False
+            now = time.time()
+            self._presence_nonces[key] = now
+            if len(self._presence_nonces) > 8192:
+                for k, t in list(self._presence_nonces.items()):
+                    if now - t > 600:
+                        del self._presence_nonces[k]
+                while len(self._presence_nonces) > 8192:
+                    self._presence_nonces.pop(
+                        min(self._presence_nonces,
+                            key=self._presence_nonces.get))
+            return True
+
     def _on_presence(self, payload: dict) -> None:
         did = int(payload.get("device_id", -1))
         status = payload.get("status")
@@ -792,24 +823,10 @@ class MasterAgent:
                 logger.warning("master: dropping presence from unbound "
                                "device %s", did)
                 return
-            nonce = f"{did}:{payload.get('nonce')}"
-            with self._cv:
-                if nonce in self._presence_nonces:
-                    logger.warning("master: dropping replayed presence "
-                                   "for device %s", did)
-                    return
-                now = time.time()
-                self._presence_nonces[nonce] = now
-                if len(self._presence_nonces) > 8192:
-                    for k, t in list(self._presence_nonces.items()):
-                        if now - t > 600:
-                            del self._presence_nonces[k]
-                    while len(self._presence_nonces) > 8192:
-                        # flood of still-fresh nonces: evict oldest-first
-                        # rather than growing (and scanning) forever
-                        self._presence_nonces.pop(
-                            min(self._presence_nonces,
-                                key=self._presence_nonces.get))
+            if not self._spend_nonce(f"{did}:{payload.get('nonce')}"):
+                logger.warning("master: dropping replayed presence "
+                               "for device %s", did)
+                return
         with self._cv:
             dev = self.devices.setdefault(did, {})
             # MERGE, don't clobber: a heartbeat must not erase the
@@ -823,19 +840,33 @@ class MasterAgent:
 
     def _on_status(self, payload: dict) -> None:
         did = int(payload.get("device_id", -1))
-        if self.registry is not None and did not in self.devices:
-            # device-table writes require a presence that passed the
-            # registry gate first — a broker peer must not conjure a
-            # dispatchable device (or poison the version column) by
-            # publishing job statuses for an unenrolled id
-            logger.warning("master: dropping status from unbound "
-                           "device %s", did)
-            return
+        if self.registry is not None:
+            # status frames must carry a device-credential HMAC (like
+            # presence proofs): a broker-authenticated peer without the
+            # bind token must not be able to flip a bound device's live
+            # job to FAILED/FINISHED, conjure a dispatchable device, or
+            # poison the version column. verify_status also rejects
+            # unenrolled/revoked devices and stale timestamps.
+            ok = self.registry.verify_status(
+                str(did), str(payload.get("request_id", "")),
+                str(payload.get("status")), payload.get("ts"),
+                payload.get("nonce"), payload.get("proof"))
+            if not ok:
+                logger.warning("master: dropping unauthenticated status "
+                               "for device %s", did)
+                return
+            # single-use, same ledger/pruning as presence nonces (the
+            # 'status:' prefix keeps the namespaces apart)
+            if not self._spend_nonce(f"status:{did}:{payload.get('nonce')}"):
+                logger.warning("master: dropping replayed status for "
+                               "device %s", did)
+                return
         if (payload.get("status") == "UPGRADED" and self.registry
                 and payload.get("version")):
             # record only for upgrades THIS master dispatched to THAT
-            # device — statuses carry no MAC, so an arbitrary peer could
-            # otherwise poison any bound device's version column
+            # device: the MAC gate above authenticates the sender, but a
+            # validly-bound device still must not rewrite its own version
+            # column via UPGRADED statuses for jobs never dispatched
             with self._cv:
                 job = self.jobs.get(str(payload.get("request_id", "")))
             if (job and job.get("kind") == "upgrade"

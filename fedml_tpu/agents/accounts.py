@@ -58,6 +58,27 @@ def presence_proof(token: str, device_id: str, status: str, ts: float,
                     hashlib.sha256).hexdigest()
 
 
+def _status_body(device_id: str, request_id: str, status: str, ts,
+                 nonce) -> bytes:
+    """ONE definition of the signed job-status body (prover = slave,
+    verifier = registry-wired master). The leading 'status:' tag domain-
+    separates it from presence proofs — the two share the mac key, and a
+    harvested presence proof must never verify as a job status (or vice
+    versa)."""
+    return f"status:{device_id}|{request_id}|{status}|{ts}|{nonce}".encode()
+
+
+def status_proof(token: str, device_id: str, request_id: str, status: str,
+                 ts: float, nonce: str) -> str:
+    """HMAC proof a slave attaches to job-status frames: without it, any
+    broker-authenticated peer could flip a bound device's live job to
+    FAILED/FINISHED on the master (status poisoning)."""
+    import hmac
+    return hmac.new(mac_key_for(token),
+                    _status_body(device_id, request_id, status, ts, nonce),
+                    hashlib.sha256).hexdigest()
+
+
 PRESENCE_TTL_S = 300.0
 
 
@@ -235,6 +256,34 @@ class AccountRegistry:
                           "WHERE device_id=?", (time.time(),
                                                 str(device_id)))
             return ok
+
+    def verify_status(self, device_id: str, request_id: str, status: str,
+                      ts, nonce, proof) -> bool:
+        """Verify a job-status HMAC proof (freshness-bound like live
+        presence; statuses are minted at event time, so a stale ts means
+        replay or broken clocks either way)."""
+        import hmac
+        try:
+            ts_f = float(ts)
+        except (TypeError, ValueError):
+            return False
+        if abs(time.time() - ts_f) > PRESENCE_TTL_S:
+            return False
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT mac_key, revoked FROM devices WHERE device_id=?",
+                (str(device_id),)).fetchone()
+            if row is None or int(row[1]) or not row[0]:
+                return False  # unknown, revoked, or pre-migration row
+            want = hmac.new(bytes.fromhex(row[0]),
+                            _status_body(str(device_id), str(request_id),
+                                         str(status), ts, nonce),
+                            hashlib.sha256).hexdigest()
+            # deliberately NO last_seen touch here: the master's replay
+            # (nonce) check runs AFTER this verification, so a replayed
+            # frame would otherwise keep refreshing liveness for a dead
+            # device — presence proofs remain the only liveness signal
+            return hmac.compare_digest(str(proof), want)
 
     def revoke_device(self, device_id: str) -> bool:
         with self._conn() as c:

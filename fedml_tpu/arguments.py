@@ -70,6 +70,19 @@ _SCHEMA: Dict[str, Any] = {
     "mesh_shape": None,          # e.g. {"client": 8} or {"client": 4, "fsdp": 2}
     "clients_per_device": None,  # schedule width; derived if None
     "precision": "float32",      # or "bfloat16" for the compute path
+    "rounds_per_dispatch": 8,    # fused-block length (rounds per dispatch)
+    # auto: defended rounds fuse train->attack->defense->CDP->server into
+    # ONE dispatch whenever the sharded defense path applies; host forces
+    # the 3-dispatch host-orchestrated pipeline; fused refuses configs
+    # that cannot fuse instead of silently degrading
+    "robust_fused": "auto",
+    # auto: feature-sharded (no host materialization) defense whenever the
+    # configured defense supports it; false/host forces the host kernels
+    "sharded_defense": "auto",
+    # donate params/server_state/client_states buffers to the round
+    # programs (outputs replace them 1:1) — halves model-state HBM peak;
+    # off-switch for debugging aliasing suspicions only
+    "donate_buffers": True,
     # comm_args
     "backend": "tpu",
     "grpc_ipconfig_path": None,

@@ -108,6 +108,17 @@ COMM_BROADCAST_FULL = "full"          # dense float32 server->client sync
 COMM_BROADCAST_BF16 = "bf16"          # dense sync at half the bytes
 COMM_BROADCAST_COMPRESS = "compress"  # sync ships the compressed global delta
 
+# Robust-round fusion (``robust_fused`` knob): with a sharded-capable
+# defense the whole defended round — training, model-attack injection,
+# feature-sharded defense, central-DP noise, server transform — runs as
+# ONE jitted SPMD program (and scans over rounds in fused blocks), so the
+# update stack never leaves device. ``host`` keeps the 3-dispatch
+# host-orchestrated pipeline (required by contribution assessment / user
+# ServerAggregators / host-only defenses, which AUTO falls back to).
+ROBUST_FUSED_AUTO = "auto"
+ROBUST_FUSED_FUSED = "fused"
+ROBUST_FUSED_HOST = "host"
+
 # Mesh axis names — the vocabulary of the whole framework.
 AXIS_CLIENT = "client"   # FL round-level data parallelism (one+ clients/chip)
 AXIS_DATA = "data"       # intra-silo data parallelism (DDP analogue)

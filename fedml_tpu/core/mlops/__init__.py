@@ -123,6 +123,53 @@ def log_comm_round(round_idx: int, wire_bytes: int,
                    "compression": compression, "by_type": by_type})
 
 
+def log_dispatch(name: str, wall_s: float, rounds: int = 1,
+                 compiles: int = 0) -> None:
+    """One device dispatch at the engine seam: host-side wall time of the
+    dispatch call, how many FL rounds it carried (fused blocks > 1), and
+    how many XLA compiles it triggered (the recompile counter — a steady
+    state of 0 is the invariant; anything else is shape instability)."""
+    _emit("dispatch", {"dispatch": name, "wall_s": round(float(wall_s), 6),
+                       "rounds": int(rounds), "compiles": int(compiles)})
+
+
+# --- XLA compile counter ---------------------------------------------------
+# Process-wide count of backend compiles, fed by jax.monitoring duration
+# events ('/jax/core/compile/backend_compile_duration' fires once per
+# non-cache-hit compile). Engines snapshot it around dispatches to expose
+# a per-dispatch recompile delta; tests pin it to catch shape-instability
+# regressions that would otherwise recompile silently every round.
+
+_compile_counter: Dict[str, Any] = {"count": 0, "installed": False}
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def install_compile_counter() -> None:
+    """Idempotent: registers the jax.monitoring listener once per
+    process. Safe to call before any jit runs."""
+    if _compile_counter["installed"]:
+        return
+    try:
+        import jax.monitoring as _jm
+
+        def _on_event_duration(event: str, duration: float, **kw) -> None:
+            if event == _COMPILE_EVENT:
+                _compile_counter["count"] += 1
+
+        _jm.register_event_duration_secs_listener(_on_event_duration)
+        _compile_counter["installed"] = True
+    except Exception as e:  # pragma: no cover - jax without monitoring
+        logger.warning("compile counter unavailable (%s); dispatch "
+                       "records will report compiles=0", e)
+        _compile_counter["installed"] = True  # don't retry every round
+
+
+def compile_count() -> int:
+    """Backend compiles observed so far in this process (0 until
+    :func:`install_compile_counter` has run)."""
+    return int(_compile_counter["count"])
+
+
 def log_training_status(status: str, run_id: Optional[str] = None) -> None:
     _emit("status", {"role": "client", "status": status})
 

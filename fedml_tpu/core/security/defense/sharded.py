@@ -64,6 +64,39 @@ def _apply_attack_shard(attack_type: str, mat_s, byz_mask, key, scale,
     return mat_s
 
 
+def defend_shard(mat_s: jnp.ndarray, weights: jnp.ndarray, axis: str,
+                 defense_type: str, byzantine_count: int = 0,
+                 multi_k: int = 1,
+                 trim_fraction: float = 0.1) -> jnp.ndarray:
+    """The per-shard defense kernel: [K, D/n] feature shard + replicated
+    [K] weights -> defended aggregate shard [D/n]. Pure SPMD body meant to
+    run INSIDE an existing ``shard_map`` over ``axis`` — this is the ONE
+    implementation shared by :func:`defend_matrix_sharded` (host-dispatch
+    path) and the engine's fused robust round program; any drift between
+    the two would silently break their client-for-client parity."""
+    if defense_type in ("coordinate_median", "median"):
+        vec, _ = robust_agg.coordinate_median(mat_s, weights)
+        return vec
+    if defense_type == "trimmed_mean":
+        vec, _ = robust_agg.trimmed_mean(mat_s, weights, trim_fraction)
+        return vec
+    if defense_type == "three_sigma":
+        # host parity: score_i = ||u_i - coord_median||; keep within
+        # median(score) + 3 * 1.4826 * MAD(score)
+        med = jnp.median(mat_s, axis=0)
+        part = jnp.sum((mat_s - med[None]) ** 2, axis=1)
+        scores = jnp.sqrt(jax.lax.psum(part, axis))
+        mu = jnp.median(scores)
+        sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
+        keep = (scores <= mu + 3.0 * sd).astype(weights.dtype)
+        return robust_agg.weighted_mean(mat_s, weights * keep)
+    partial_d = robust_agg.pairwise_sq_dists(mat_s)
+    dists = jax.lax.psum(partial_d, axis)
+    sel_w = _selection_weights(defense_type, dists, weights,
+                               byzantine_count, multi_k)
+    return robust_agg.weighted_mean(mat_s, sel_w)
+
+
 @lru_cache(maxsize=32)
 def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
                       byzantine_count: int, multi_k: int,
@@ -78,27 +111,8 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
         if attack_type is not None:
             mat_s = _apply_attack_shard(attack_type, mat_s, byz_mask, key,
                                         attack_scale, axis)
-        if defense_type in ("coordinate_median", "median"):
-            vec, _ = robust_agg.coordinate_median(mat_s, weights)
-            return vec
-        if defense_type == "trimmed_mean":
-            vec, _ = robust_agg.trimmed_mean(mat_s, weights, trim_fraction)
-            return vec
-        if defense_type == "three_sigma":
-            # host parity: score_i = ||u_i - coord_median||; keep within
-            # median(score) + 3 * 1.4826 * MAD(score)
-            med = jnp.median(mat_s, axis=0)
-            part = jnp.sum((mat_s - med[None]) ** 2, axis=1)
-            scores = jnp.sqrt(jax.lax.psum(part, axis))
-            mu = jnp.median(scores)
-            sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
-            keep = (scores <= mu + 3.0 * sd).astype(weights.dtype)
-            return robust_agg.weighted_mean(mat_s, weights * keep)
-        partial_d = robust_agg.pairwise_sq_dists(mat_s)
-        dists = jax.lax.psum(partial_d, axis)
-        sel_w = _selection_weights(defense_type, dists, weights,
-                                   byzantine_count, multi_k)
-        return robust_agg.weighted_mean(mat_s, sel_w)
+        return defend_shard(mat_s, weights, axis, defense_type,
+                            byzantine_count, multi_k, trim_fraction)
 
     return jax.jit(shard_map(
         body, mesh=mesh,

@@ -108,6 +108,15 @@ class TPUSimulator:
         self.client_sharding = NamedSharding(self.mesh, P(AXIS_CLIENT))
         self.repl_sharding = NamedSharding(self.mesh, P())
 
+        # donate round inputs (params/server_state/client_states) back to
+        # XLA: the round program's outputs replace them 1:1, so donation
+        # lets the compiler alias in/out buffers and halves the model-state
+        # HBM peak. Off-switch kept for debugging aliasing suspicions.
+        self._donate = bool(getattr(args, "donate_buffers", True))
+        mlops.install_compile_counter()
+        self.dispatch_stats: Dict[str, Any] = {"dispatches": 0,
+                                               "compiles": 0}
+
         self.attacker = FedMLAttacker(args)
         self.defender = FedMLDefender(args)
         self.dp = FedMLDifferentialPrivacy(args)
@@ -145,9 +154,16 @@ class TPUSimulator:
                 "configured: the defense takes precedence and the user "
                 "aggregator is SKIPPED", self.defender.defense_type)
         _check_extras_compat(self.opt, self.params, self.dp, defended_mode)
-        self._round_fn = (self._build_collect_fn() if self.robust_mode
+        # ONE dispatch per defended round: when the defense has a sharded
+        # kernel, the whole robust pipeline (train -> attack -> defense ->
+        # CDP -> server transform) fuses into a single jitted program
+        self.robust_fused = self._resolve_robust_fused()
+        self._round_fn = (self._build_robust_fn() if self.robust_fused
+                          else self._build_collect_fn() if self.robust_mode
                           else self._build_round_fn())
-        self._server_update = jax.jit(self.opt.server_update)
+        self._server_update = jax.jit(
+            self.opt.server_update,
+            donate_argnums=(0, 1) if self._donate else ())
         self._evaluate = jax.jit(lambda p, x, y, m: evaluate(spec, p, x, y, m))
         self.ckpt = RoundCheckpointer(
             getattr(args, "checkpoint_dir", None),
@@ -262,6 +278,28 @@ class TPUSimulator:
 
         return core
 
+    def _donate_args(self, *argnums: int):
+        """donate_argnums for the round programs: params / server_state /
+        client_states are replaced 1:1 by outputs of the same shape and
+        sharding, so XLA can alias them in-place (client DATA is never
+        donated — it is reused every round)."""
+        return argnums if self._donate else ()
+
+    def _traced(self, name: str, n_rounds: int, fn, *args):
+        """Per-dispatch observability at the mlops seam: wall time of the
+        dispatch call (host-side cost; device work is async) plus the
+        process-wide XLA-compile delta it triggered — the recompile
+        counter that makes shape instability loud instead of silent."""
+        c0 = mlops.compile_count()
+        t0 = time.perf_counter()
+        out = fn(*args)
+        wall = time.perf_counter() - t0
+        compiles = mlops.compile_count() - c0
+        self.dispatch_stats["dispatches"] += 1
+        self.dispatch_stats["compiles"] += compiles
+        mlops.log_dispatch(name, wall, rounds=n_rounds, compiles=compiles)
+        return out
+
     def _build_round_fn(self):
         core = self._make_round_core()
 
@@ -285,7 +323,7 @@ class TPUSimulator:
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
-        return jax.jit(shard_fn)
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
 
     def _build_fused_fn(self):
         """R rounds in ONE dispatch: an outer lax.scan over per-round
@@ -330,26 +368,22 @@ class TPUSimulator:
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
-        return jax.jit(shard_fn)
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
 
     # ------------------------------------------------------------------
-    def _build_collect_fn(self):
-        """Robust-mode round: instead of the psum fast path, emit every
-        scheduled client's raw update (sharded [D, S, ...]) so the host can
-        run the attack->defense pipeline on the full update matrix — the
-        mesh equivalent of the reference ServerAggregator receiving the
-        individual client models (``fedml_aggregator.py:58-78``)."""
+    def _make_collect_core(self):
+        """Per-shard slot scan on SQUEEZED local blocks that keeps every
+        scheduled client's raw update as a [S, ...] stack (plus the psum-
+        ready extras/weight/metrics accumulators). Shared by the host-
+        dispatch collect program and the fused robust program — one
+        training implementation, or their parity would silently drift."""
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
 
-        def round_body(params, server_state, local_data, local_states,
-                       sched_idx, sched_active, round_key, hyper):
+        def core(params, server_state, local_data, local_states,
+                 sched_idx, sched_active, round_key, hyper):
             dev = jax.lax.axis_index(AXIS_CLIENT)
-            local_data = jax.tree_util.tree_map(lambda a: a[0], local_data)
-            local_states = jax.tree_util.tree_map(lambda a: a[0], local_states)
-            sched_idx = sched_idx[0]
-            sched_active = sched_active[0]
             zero_extras = opt.server_extras_zero(params)
             zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
                             "count": jnp.float32(0)}
@@ -386,7 +420,27 @@ class TPUSimulator:
             init = (local_states, zero_extras, jnp.float32(0), zero_metrics)
             (states, acc_ex, acc_w, acc_m), (upd_stack, w_stack) = jax.lax.scan(
                 slot, init, jnp.arange(sched_idx.shape[0]))
+            return upd_stack, w_stack, states, acc_ex, acc_w, acc_m
 
+        return core
+
+    def _build_collect_fn(self):
+        """Robust-mode round, host-dispatch flavor: instead of the psum
+        fast path, emit every scheduled client's raw update (sharded
+        [D, S, ...]) so the host can run the attack->defense pipeline on
+        the full update matrix — the mesh equivalent of the reference
+        ServerAggregator receiving the individual client models
+        (``fedml_aggregator.py:58-78``). Contribution assessment and user
+        ServerAggregators always take this path; sharded-capable defenses
+        take :meth:`_build_robust_fn` unless ``robust_fused`` says host."""
+        core = self._make_collect_core()
+
+        def round_body(params, server_state, local_data, local_states,
+                       sched_idx, sched_active, round_key, hyper):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            upd_stack, w_stack, states, acc_ex, acc_w, acc_m = core(
+                params, server_state, sq(local_data), sq(local_states),
+                sched_idx[0], sched_active[0], round_key, hyper)
             total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
             denom = jnp.maximum(total_w, 1e-12)
             agg_extras = jax.tree_util.tree_map(
@@ -404,7 +458,165 @@ class TPUSimulator:
             out_specs=(P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
-        return jax.jit(shard_fn)
+        # params/server_state are NOT donated here: the host still needs
+        # them after this dispatch (defense ordering + _server_update)
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(3))
+
+    # ------------------------------------------------------------------
+    def _make_robust_core(self):
+        """The per-shard FUSED robust round: slot-scan training, on-device
+        model-attack injection, the feature-sharded defense, central-DP
+        noise, and the server transform — the whole defended round with no
+        host round-trip. The [D, S, ...] update stack never leaves device:
+        an ``all_to_all`` turns rows-with-all-features into all-rows-with-
+        a-feature-shard, landing bit-for-bit the same [K, D/n] layout (and
+        attack/defense PRNG streams) as the host-dispatch sharded path in
+        :meth:`_robust_aggregate`, so the two are parity-testable."""
+        from ...core.security.defense import sharded as sharded_defense
+        collect = self._make_collect_core()
+        opt = self.opt
+        dp = self.dp
+        n_dev = self.n_devices
+        dfd = self.defender
+        attack_type = (self.attacker.attack_type
+                       if self.attacker.is_model_attack() else None)
+        attack_scale = float(getattr(self.attacker, "attack_scale", 1.0))
+
+        def core(params, server_state, local_data, local_states,
+                 sched_idx, sched_active, rows, byz_mask, round_key, hyper):
+            upd_stack, w_stack, states, acc_ex, acc_w, acc_m = collect(
+                params, server_state, local_data, local_states,
+                sched_idx, sched_active, round_key, hyper)
+            # [S, ...] stack -> [S, D] f32 local matrix: same leaf order
+            # and dtype cast as stack_to_matrix on the host path
+            leaves = jax.tree_util.tree_leaves(upd_stack)
+            n_slots = leaves[0].shape[0]
+            local_mat = jnp.concatenate(
+                [jnp.reshape(l, (n_slots, -1)).astype(jnp.float32)
+                 for l in leaves], axis=1)
+            true_d = local_mat.shape[1]
+            pad = (-true_d) % n_dev
+            if pad:  # even feature shards, as on the host path
+                local_mat = jnp.pad(local_mat, ((0, 0), (0, pad)))
+            grid = jax.lax.all_to_all(local_mat, AXIS_CLIENT, split_axis=1,
+                                      concat_axis=0, tiled=True)
+            mat_s = grid[rows]          # [K, D/n] in sampled-client order
+            w = jax.lax.all_gather(w_stack, AXIS_CLIENT, tiled=True)[rows]
+            if attack_type is not None:
+                mat_s = sharded_defense._apply_attack_shard(
+                    attack_type, mat_s, byz_mask,
+                    jax.random.fold_in(round_key, ATTACK_FOLD),
+                    attack_scale, AXIS_CLIENT)
+            vec_s = sharded_defense.defend_shard(
+                mat_s, w, AXIS_CLIENT, dfd.defense_type,
+                byzantine_count=dfd.byzantine_count,
+                multi_k=dfd.krum_param_m,
+                trim_fraction=float(dfd.trim_fraction))
+            vec = jax.lax.all_gather(vec_s, AXIS_CLIENT, tiled=True)[:true_d]
+            agg_update = vector_to_tree_like(vec, params)
+            if dp.is_global_dp_enabled():
+                agg_update = dp.add_global_noise(
+                    agg_update, jax.random.fold_in(round_key, DP_CDP_FOLD))
+            total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
+            denom = jnp.maximum(total_w, 1e-12)
+            agg_extras = jax.tree_util.tree_map(
+                lambda x: x / denom.astype(x.dtype), psum_tree(acc_ex))
+            metrics = psum_tree(acc_m)
+            new_params, new_sstate = opt.server_update(
+                params, server_state, agg_update, agg_extras,
+                hyper.round_idx)
+            return new_params, new_sstate, states, metrics
+
+        return core
+
+    def _build_robust_fn(self):
+        """ONE dispatch per defended round (vs three-plus-host-work on the
+        host-dispatch path)."""
+        core = self._make_robust_core()
+
+        def round_body(params, server_state, local_data, local_states,
+                       sched_idx, sched_active, rows, byz_mask, round_key,
+                       hyper):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            new_params, new_sstate, states, metrics = core(
+                params, server_state, sq(local_data), sq(local_states),
+                sched_idx[0], sched_active[0], rows, byz_mask, round_key,
+                hyper)
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            return new_params, new_sstate, states, metrics
+
+        shard_fn = shard_map(
+            round_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+            check_vma=False,
+        )
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
+
+    def _build_robust_fused_fn(self):
+        """R defended rounds in ONE dispatch: the robust core under an
+        outer ``lax.scan``, mirroring :meth:`_build_fused_fn` — defended
+        runs amortize the same ~120 ms dispatch constant (BASELINE.md §3b)
+        the undefended fused path already eliminates."""
+        core = self._make_robust_core()
+
+        def rounds_body(params, server_state, local_data, local_states,
+                        sched_idxs, sched_actives, rows_r, byz_r,
+                        round_keys, round_idxs, hyper):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            local_data = sq(local_data)
+            local_states = sq(local_states)
+            sched_idxs = sched_idxs[:, 0]      # [R, 1, S] block -> [R, S]
+            sched_actives = sched_actives[:, 0]
+
+            def one_round(carry, xs):
+                params, server_state, states = carry
+                idx_r, act_r, rows_i, byz_i, key_r, ridx_r = xs
+                hyper_r = hyper.replace(round_idx=ridx_r)
+                new_p, new_s, states, metrics = core(
+                    params, server_state, local_data, states,
+                    idx_r, act_r, rows_i, byz_i, key_r, hyper_r)
+                return (new_p, new_s, states), metrics
+
+            (params, server_state, states), metrics = jax.lax.scan(
+                one_round, (params, server_state, local_states),
+                (sched_idxs, sched_actives, rows_r, byz_r, round_keys,
+                 round_idxs))
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            return params, server_state, states, metrics  # metrics: [R]
+
+        shard_fn = shard_map(
+            rounds_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(None, AXIS_CLIENT), P(None, AXIS_CLIENT), P(),
+                      P(), P(), P(), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+            check_vma=False,
+        )
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
+
+    def _resolve_robust_fused(self) -> bool:
+        """``robust_fused`` knob: auto (default) fuses whenever the
+        sharded defense path applies; ``host`` keeps the 3-dispatch
+        host-orchestrated pipeline; ``fused`` demands fusion and refuses
+        configs that cannot fuse (contribution assessment, user
+        ServerAggregators, defenses without a sharded kernel)."""
+        pref = str(getattr(self.args, "robust_fused", "auto")
+                   or "auto").lower()
+        if pref in ("false", "0", "no", "host"):
+            return False
+        ok = self.robust_mode and self._use_sharded_defense()
+        if pref in ("true", "1", "yes", "fused") and self.robust_mode \
+                and not ok:
+            raise ValueError(
+                "robust_fused: this config cannot fuse the robust round "
+                "(it needs a sharded-capable defense and no contribution "
+                "assessment / user ServerAggregator); use robust_fused: "
+                "auto or host")
+        return ok
 
     def _use_sharded_defense(self) -> bool:
         """Sharded (feature-parallel, no host materialization) defense is
@@ -422,6 +634,25 @@ class TPUSimulator:
                 and self.server_aggregator is None
                 and not self.contribution.enabled)
 
+    def _robust_rows(self, sampled, n_slots: int):
+        """Map sampled client ids onto the device-major [D*S] update grid:
+        ``rows[k]`` is client k's row, ``byz[k]`` its byzantine-mask entry
+        (zeros when no model attack is configured). Shared by the host-
+        dispatch and fused robust paths — identical ordering is what makes
+        their defense verdicts comparable client-for-client."""
+        counts = [0] * self.n_devices
+        rows = []
+        for cid in sampled:
+            d = cid // self.cpd
+            rows.append(d * n_slots + counts[d])
+            counts[d] += 1
+        ids = np.asarray(sampled)
+        if self.attacker.is_model_attack():
+            byz = np.asarray(self.attacker.byzantine_mask(ids), np.float32)
+        else:
+            byz = np.zeros(len(sampled), np.float32)
+        return np.asarray(rows, np.int32), byz
+
     def _robust_aggregate(self, upd_stack, w_stack, sampled, n_slots,
                           round_key, round_idx):
         """Order the [D, S] update grid into sampled-client order, run
@@ -430,13 +661,8 @@ class TPUSimulator:
         from ...core.security.defense import stack_to_matrix
         from ...core.security.defense.robust_agg import weighted_mean
         from ...core.security.defense import sharded
-        counts = [0] * self.n_devices
-        rows = []
-        for cid in sampled:
-            d = cid // self.cpd
-            rows.append(d * n_slots + counts[d])
-            counts[d] += 1
-        rows = jnp.asarray(np.asarray(rows, np.int32))
+        rows_np, _ = self._robust_rows(sampled, n_slots)
+        rows = jnp.asarray(rows_np)
         ids = np.asarray(sampled)
 
         if self._use_sharded_defense():
@@ -580,70 +806,107 @@ class TPUSimulator:
                 np.any(real_batches > 0, axis=-1), axis=-1)))
             steps = n_sampled * int(hyper.epochs) * mean_real
             return per_batch * steps
-        except Exception:
+        except Exception as e:
+            # never crash a bench over cost analysis — but a silent 0.0
+            # zeroes the MFU column with no trace, so say why ONCE
+            if not getattr(self, "_flops_cost_warned", False):
+                self._flops_cost_warned = True
+                logger.warning(
+                    "round_cost_flops failed (MFU will report 0): %s: %s",
+                    type(e).__name__, e, exc_info=True)
             return 0.0
 
     def run_round(self, round_idx: int, hyper: TrainHyper) -> Dict[str, float]:
-        sampled, (idx, active) = self._schedule_for(round_idx)
+        pad_to = self._canonical_width() if self.robust_fused else None
+        sampled, (idx, active) = self._schedule_for(round_idx,
+                                                    pad_to=pad_to)
         idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
         active = jax.device_put(jnp.asarray(active), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, round_idx)
         hyper_r = hyper.replace(round_idx=jnp.int32(round_idx))
+        if self.robust_fused:
+            rows, byz = self._robust_rows(sampled, int(idx.shape[1]))
+            (self.params, self.server_state, self.client_states,
+             metrics) = self._traced(
+                "robust_round_fused", 1, self._round_fn,
+                self.params, self.server_state, self.train_data,
+                self.client_states, idx, active, jnp.asarray(rows),
+                jnp.asarray(byz), round_key, hyper_r)
+            self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
+            return metrics
         if self.robust_mode:
             (upd_stack, w_stack, agg_extras, self.client_states,
-             metrics) = self._round_fn(
+             metrics) = self._traced(
+                "robust_collect", 1, self._round_fn,
                 self.params, self.server_state, self.train_data,
                 self.client_states, idx, active, round_key, hyper_r)
             agg_update = self._robust_aggregate(
                 upd_stack, w_stack, sampled, int(idx.shape[1]),
                 round_key, round_idx)
-            self.params, self.server_state = self._server_update(
+            self.params, self.server_state = self._traced(
+                "server_update", 1, self._server_update,
                 self.params, self.server_state, agg_update, agg_extras,
                 jnp.int32(round_idx))
             self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
             return metrics
         (self.params, self.server_state, self.client_states,
-         metrics) = self._round_fn(
+         metrics) = self._traced(
+            "round", 1, self._round_fn,
             self.params, self.server_state, self.train_data,
             self.client_states, idx, active, round_key, hyper_r)
         self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
         return metrics
 
-    def _schedule_for(self, round_idx: int):
+    def _canonical_width(self) -> int:
+        """The simulator-canonical schedule width: the cap build_schedule
+        buckets against. Padding every round to THIS width (instead of a
+        per-block max) keeps the fused programs at exactly one compile per
+        run — padded slots carry active=0 and are masked in the round
+        body, so results are unchanged."""
+        return min(self.cpd, int(self.args.client_num_per_round))
+
+    def _schedule_for(self, round_idx: int, pad_to: Optional[int] = None):
         sampled = client_sampling(round_idx, self.fed.num_clients,
                                   int(self.args.client_num_per_round))
         max_slots = min(self.cpd, int(self.args.client_num_per_round))
-        return sampled, build_schedule(sampled, self.n_devices, self.cpd,
-                                       max_slots=max_slots)
+        idx, active = build_schedule(sampled, self.n_devices, self.cpd,
+                                     max_slots=max_slots)
+        if pad_to is not None and idx.shape[1] < pad_to:
+            extra = pad_to - idx.shape[1]
+            idx = np.pad(idx, ((0, 0), (0, extra)))
+            active = np.pad(active, ((0, 0), (0, extra)))
+        return sampled, (idx, active)
 
     def run_rounds_fused(self, start_round: int, n_rounds: int,
                          hyper: TrainHyper) -> List[Dict[str, float]]:
         """Run ``n_rounds`` rounds as ONE device dispatch (schedules and
         round keys precomputed host-side, stacked, scanned on-device).
-        Returns the per-round metrics list. Robust mode falls back to the
-        per-round path (its defense pipeline is host-side by design)."""
-        if self.robust_mode or n_rounds == 1:
+        Returns the per-round metrics list. Robust mode fuses too when the
+        sharded defense path applies (``robust_fused``); only host-bound
+        robust configs (contribution assessment, user ServerAggregators,
+        host-only defenses) fall back to the per-round path."""
+        if n_rounds == 1 or (self.robust_mode and not self.robust_fused):
             return [self.run_round(start_round + i, hyper)
                     for i in range(n_rounds)]
-        if not hasattr(self, "_fused_fn"):
-            self._fused_fn = self._build_fused_fn()
-        idxs, acts, keys, ridxs = [], [], [], []
+        idxs, acts, keys, ridxs, rows_r, byz_r = [], [], [], [], [], []
+        # every round pads to the simulator-canonical width (padded slots
+        # carry active=0 and are masked in the round body): build_schedule
+        # buckets slot counts per round (powers of two), and a per-block
+        # max would recompile the fused program whenever blocks disagree
+        # on width — canonical padding compiles it exactly once per run
+        width = self._canonical_width()
         part = 0.0
         for r in range(start_round, start_round + n_rounds):
-            sampled, (idx, active) = self._schedule_for(r)
+            sampled, (idx, active) = self._schedule_for(r, pad_to=width)
             idxs.append(idx)
             acts.append(active)
             keys.append(jax.random.fold_in(self.rng, r))
             ridxs.append(r)
+            if self.robust_fused:
+                rows, byz = self._robust_rows(sampled, width)
+                rows_r.append(rows)
+                byz_r.append(byz)
             part += len(sampled) / max(self.fed.num_clients, 1)
-        # build_schedule buckets slot counts per round (powers of two), so
-        # rounds in one block can disagree on width — pad to the block's
-        # max; padded slots carry active=0 and are masked in the round body
-        width = max(i.shape[1] for i in idxs)
-        idxs = [np.pad(np.asarray(i), ((0, 0), (0, width - i.shape[1])))
-                for i in idxs]
-        acts = [np.pad(np.asarray(a), ((0, 0), (0, width - a.shape[1])))
-                for a in acts]
         sched_sharding = NamedSharding(self.mesh, P(None, AXIS_CLIENT))
         idxs = jax.device_put(jnp.stack([jnp.asarray(i) for i in idxs],
                                         axis=0), sched_sharding)
@@ -651,11 +914,26 @@ class TPUSimulator:
                                         axis=0), sched_sharding)
         keys = jnp.stack(keys)
         ridxs = jnp.asarray(ridxs, jnp.int32)
-        (self.params, self.server_state, self.client_states,
-         metrics) = self._fused_fn(
-            self.params, self.server_state, self.train_data,
-            self.client_states, idxs, acts, keys, ridxs,
-            hyper.replace(round_idx=jnp.int32(start_round)))
+        hyper_0 = hyper.replace(round_idx=jnp.int32(start_round))
+        if self.robust_fused:
+            if not hasattr(self, "_robust_fused_fn"):
+                self._robust_fused_fn = self._build_robust_fused_fn()
+            (self.params, self.server_state, self.client_states,
+             metrics) = self._traced(
+                "robust_rounds_fused", n_rounds, self._robust_fused_fn,
+                self.params, self.server_state, self.train_data,
+                self.client_states, idxs, acts,
+                jnp.stack([jnp.asarray(r) for r in rows_r]),
+                jnp.stack([jnp.asarray(b) for b in byz_r]),
+                keys, ridxs, hyper_0)
+        else:
+            if not hasattr(self, "_fused_fn"):
+                self._fused_fn = self._build_fused_fn()
+            (self.params, self.server_state, self.client_states,
+             metrics) = self._traced(
+                "rounds_fused", n_rounds, self._fused_fn,
+                self.params, self.server_state, self.train_data,
+                self.client_states, idxs, acts, keys, ridxs, hyper_0)
         for _ in range(n_rounds):  # DP accounting stays per-round
             self.dp.record_round(part / n_rounds)
         host = jax.device_get(metrics)

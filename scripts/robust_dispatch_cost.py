@@ -15,6 +15,11 @@ three legs:
 Defended overhead = defended - per_round (defense compute + collect
 path); forfeited fusion = per_round - fused (the dispatch amortization).
 Results are recorded in BASELINE.md §"Robust-mode dispatch cost".
+
+ISSUE 2 superseded the "cannot wrap it" premise for sharded-capable
+defenses (``robust_fused``): the defended legs here pin
+``robust_fused: host`` to keep measuring the legacy pipeline, and a
+fourth leg (``defended_fused_round_s``) measures the fused default.
 """
 
 from __future__ import annotations
@@ -40,7 +45,7 @@ def measure(n_clients=16, rounds_per_leg=8):
     from fedml_tpu.optimizers.registry import create_optimizer
     from fedml_tpu.simulation.tpu.engine import TPUSimulator
 
-    def args_for(defended: bool):
+    def args_for(defended: bool, robust_fused: str = "host"):
         kw = dict(
             dataset="cifar10", model="resnet56", precision="bfloat16",
             client_num_in_total=n_clients, client_num_per_round=n_clients,
@@ -49,15 +54,19 @@ def measure(n_clients=16, rounds_per_leg=8):
             allow_synthetic=True, synthetic_size=4000,
             max_total_samples=4000)
         if defended:
+            # pin the HOST pipeline: this script quantifies what the
+            # pre-ISSUE-2 per-round robust path costs; the fused robust
+            # leg below measures the default (robust_fused: auto) instead
             kw.update(enable_defense=True, defense_type="multi_krum",
-                      byzantine_client_num=2, krum_param_m=4)
+                      byzantine_client_num=2, krum_param_m=4,
+                      robust_fused=robust_fused)
         return Arguments(**kw)
 
     def force(sim):
         return float(jax.tree_util.tree_leaves(sim.params)[0].sum())
 
-    def build(defended: bool):
-        a = args_for(defended)
+    def build(defended: bool, robust_fused: str = "host"):
+        a = args_for(defended, robust_fused)
         fed, output_dim = load(a)
         bundle = create(a, output_dim)
         spec = ClassificationTrainer(bundle.apply)
@@ -96,10 +105,24 @@ def measure(n_clients=16, rounds_per_leg=8):
     force(sim)
     out["defended_round_s"] = (time.perf_counter() - t0) / rounds_per_leg
 
+    # fused robust (ISSUE 2 default: whole defended round as one program,
+    # scanned 8 rounds per dispatch)
+    _, sim = build(True, robust_fused="auto")
+    assert sim.robust_fused, "multi_krum should take the fused path"
+    sim.run_rounds_fused(0, rounds_per_leg, hyper)
+    force(sim)
+    t0 = time.perf_counter()
+    sim.run_rounds_fused(rounds_per_leg, rounds_per_leg, hyper)
+    force(sim)
+    out["defended_fused_round_s"] = ((time.perf_counter() - t0)
+                                     / rounds_per_leg)
+
     out["forfeited_fusion_s"] = out["per_round_s"] - out["fused_round_s"]
     out["defense_overhead_s"] = (out["defended_round_s"]
                                  - out["per_round_s"])
     out["defended_vs_fused"] = out["defended_round_s"] / out["fused_round_s"]
+    out["defended_fused_vs_host"] = (out["defended_round_s"]
+                                     / out["defended_fused_round_s"])
     return out
 
 

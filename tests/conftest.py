@@ -79,3 +79,29 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def xla_compile_counter():
+    """Counts XLA backend compiles via the process-wide jax.monitoring
+    listener at the mlops seam. Use ``reset()`` after warmup, then assert
+    ``delta() == 0`` across steady-state work — a nonzero delta is a
+    shape-instability regression that would otherwise recompile silently
+    every round."""
+    from fedml_tpu.core import mlops
+
+    mlops.install_compile_counter()
+
+    class _Counter:
+        def __init__(self):
+            self._start = mlops.compile_count()
+
+        def reset(self):
+            self._start = mlops.compile_count()
+
+        def delta(self):
+            return mlops.compile_count() - self._start
+
+    return _Counter()

@@ -641,3 +641,106 @@ class TestOTAUpgrade:
                                       timeout_s=20) == "FAILED"
         assert not (registry / "runs" / "agent_7" / "escape.py").exists()
         assert not (registry / "escape.py").exists()
+
+
+class TestStatusMAC:
+    """Job-status frames carry a device-credential HMAC (like presence
+    proofs): a broker-authenticated peer WITHOUT the bind token must not
+    be able to flip a bound device's live job to FAILED/FINISHED on a
+    registry-wired master (round-5 advisor)."""
+
+    def _wired(self, registry, db):
+        from fedml_tpu.agents import MasterAgent, MessageCenter
+        from fedml_tpu.agents.accounts import AccountRegistry
+        reg = AccountRegistry(str(registry / db))
+        did, token = reg.register_device("k", device_id="31")
+        broker = PubSubBroker()
+        master = MasterAgent("127.0.0.1", broker.port, registry=reg)
+        master.start()
+        spy = MessageCenter("127.0.0.1", broker.port)
+        spy.start()
+        return reg, did, token, broker, master, spy
+
+    @staticmethod
+    def _signed_status(token, did, rid, status):
+        import uuid as _uuid
+        from fedml_tpu.agents.accounts import status_proof
+        ts = time.time()
+        nonce = _uuid.uuid4().hex
+        return {"device_id": int(did), "request_id": rid,
+                "status": status, "ts": ts, "nonce": nonce,
+                "proof": status_proof(token, did, rid, status, ts, nonce)}
+
+    def test_forged_status_cannot_flip_bound_devices_job(self, registry):
+        from fedml_tpu.agents import JOB_RUNNING
+        reg, did, token, broker, master, spy = self._wired(registry,
+                                                           "st1.db")
+        try:
+            # legitimate, proof-carrying RUNNING status lands
+            spy.publish("fl_client/mlops/status",
+                        self._signed_status(token, did, "job-1",
+                                            JOB_RUNNING))
+            assert master.wait_for_status("job-1", {JOB_RUNNING},
+                                          timeout_s=10) == JOB_RUNNING
+            # forged frames (no proof / wrong proof) must not mutate it
+            spy.publish("fl_client/mlops/status", {
+                "device_id": 31, "request_id": "job-1",
+                "status": "FAILED"})
+            forged = self._signed_status(token, did, "job-1", "FINISHED")
+            forged["proof"] = "0" * 64
+            spy.publish("fl_client/mlops/status", forged)
+            time.sleep(0.8)
+            assert master.job_status("job-1") == JOB_RUNNING
+            assert master.devices[31]["status"] == "RUNNING"
+        finally:
+            spy.stop()
+            master.stop()
+            broker.stop()
+
+    def test_replayed_status_nonce_rejected(self, registry):
+        from fedml_tpu.agents import JOB_FINISHED, JOB_RUNNING
+        reg, did, token, broker, master, spy = self._wired(registry,
+                                                           "st2.db")
+        try:
+            running = self._signed_status(token, did, "job-2", JOB_RUNNING)
+            spy.publish("fl_client/mlops/status", dict(running))
+            assert master.wait_for_status("job-2", {JOB_RUNNING},
+                                          timeout_s=10) == JOB_RUNNING
+            spy.publish("fl_client/mlops/status",
+                        self._signed_status(token, did, "job-2",
+                                            JOB_FINISHED))
+            assert master.wait_for_status("job-2", {JOB_FINISHED},
+                                          timeout_s=10) == JOB_FINISHED
+            # a harvested RUNNING frame replayed later must not resurrect
+            spy.publish("fl_client/mlops/status", dict(running))
+            time.sleep(0.8)
+            assert master.job_status("job-2") == JOB_FINISHED
+        finally:
+            spy.stop()
+            master.stop()
+            broker.stop()
+
+    def test_slave_attaches_status_proofs_end_to_end(self, registry):
+        """A token-carrying slave's own statuses pass the MAC gate: the
+        full dispatch->FAILED flow works through a registry-wired
+        master (the job yaml is missing, so the slave reports FAILED —
+        with a proof the master accepts)."""
+        from fedml_tpu.agents import SlaveAgent, launch_job_remote
+        reg, did, token, broker, master, spy = self._wired(registry,
+                                                           "st3.db")
+        slave = SlaveAgent(device_id=31, broker_host="127.0.0.1",
+                           broker_port=broker.port, poll_s=0.1,
+                           device_token=token)
+        slave.start()
+        try:
+            assert master.wait_for_device(31, DEVICE_IDLE,
+                                          timeout_s=10) == DEVICE_IDLE
+            info = launch_job_remote(str(registry / "missing.yaml"),
+                                     device_id=31, master=master,
+                                     timeout_s=30)
+            assert info["status"] == "FAILED"
+        finally:
+            slave.stop()
+            spy.stop()
+            master.stop()
+            broker.stop()
