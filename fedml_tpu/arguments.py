@@ -93,6 +93,35 @@ _SCHEMA: Dict[str, Any] = {
     "comm_compression_ratio": 0.1,       # sparsifier keep-ratio in (0, 1]
     "comm_quantize_levels": 127,         # QSGD levels (int8 wire, <= 127)
     "comm_compression_broadcast": "full",  # server->client: full|bf16|compress
+    # chaos_args — deterministic fault injection (core/chaos). ALL off by
+    # default: a default run injects nothing, the simulator programs and
+    # the cross-silo wire stay byte/bit-identical.
+    "chaos_seed": None,              # falls back to random_seed
+    "chaos_dropout_prob": 0.0,       # per-(round, client) dropout
+    "chaos_straggler_prob": 0.0,     # per-(round, client) straggler
+    "chaos_straggler_work": 0.5,     # fraction of local work a straggler runs
+    "chaos_link_loss_prob": 0.0,     # per-message loss at the send seam
+    "chaos_link_dup_prob": 0.0,      # per-message duplication
+    "chaos_link_delay_prob": 0.0,    # per-message delay probability
+    "chaos_link_delay_s": 0.0,       # delay applied when it fires
+    "chaos_crash_at_round": None,    # raise ChaosCrash after this round
+    # fault TOLERANCE (on by default — it is the correct behavior; the
+    # off-switch exists so the bench can demonstrate what dropout does to
+    # an intolerant aggregator): dropped clients are renormalized out of
+    # the weighted average instead of diluting it with zero updates
+    "chaos_tolerance": True,
+    # sample ceil(client_num_per_round * (1 + frac)) clients so that after
+    # expected dropout the surviving cohort still hits the target size
+    "chaos_over_sample": 0.0,
+    # cross-silo: a timed-out round aggregates only if at least
+    # ceil(frac * expected) silos reported; below quorum the server keeps
+    # waiting (another timeout interval) instead of averaging a sliver
+    "round_quorum_frac": 0.0,
+    # comm retry policy (exponential backoff + jitter at the transport
+    # send seam; 0 attempts = fail fast like the pre-chaos transports)
+    "comm_retry_max_attempts": 4,
+    "comm_retry_base_s": 0.2,
+    "comm_retry_max_s": 2.0,
     # tracking_args
     "enable_wandb": False,
     "log_file_dir": "~/.cache/fedml_tpu/logs",

@@ -64,7 +64,14 @@ def run_local_sgd(
     # [n_batches] — a batch is real iff it has at least one unmasked sample
     batch_real = jnp.any(cdata.mask > 0, axis=tuple(range(1, cdata.mask.ndim)))
     real_batches = jnp.sum(batch_real.astype(jnp.int32))
-    total_steps = hyper.epochs * real_batches
+    # chaos straggler slowdown as data: work_scale < 1 truncates the
+    # dynamic step count (ceil keeps at least one step for any scale > 0).
+    # At the default work_scale == 1.0 the product and ceil are exact, so
+    # the step count — and therefore the trajectory — is bit-identical to
+    # the unscaled loop.
+    total_steps = jnp.ceil(
+        (hyper.epochs * real_batches).astype(jnp.float32)
+        * hyper.work_scale).astype(jnp.int32)
     denom = jnp.maximum(real_batches, 1)
     data_rng, loop_rng = jax.random.split(rng)
     ctx = ctx or {}
@@ -99,13 +106,16 @@ def run_local_sgd(
     return params, opt_state, metrics
 
 
-def effective_steps(cdata: ClientData, epochs: int) -> jnp.ndarray:
+def effective_steps(cdata: ClientData, epochs: int,
+                    work_scale=1.0) -> jnp.ndarray:
     """Number of *real* (non-padding) local SGD steps a client runs: padded
     all-zero-mask batches are gated to no-ops in :func:`run_local_sgd`, so
-    K = epochs x (batches with at least one real sample). SCAFFOLD / FedNova
-    normalizations need this exact count."""
+    K = ceil(epochs x real batches x work_scale). SCAFFOLD / FedNova
+    normalizations need this exact count — a chaos straggler that ran half
+    its steps must be normalized by the steps it RAN, or its control
+    variate / a_i coefficient silently mis-scales."""
     real_batches = jnp.sum(jnp.any(cdata.mask > 0, axis=1).astype(jnp.float32))
-    return jnp.maximum(epochs * real_batches, 1.0)
+    return jnp.maximum(jnp.ceil(epochs * real_batches * work_scale), 1.0)
 
 
 def full_batch_grad(

@@ -59,7 +59,15 @@ class ClientOutput:
 
 @struct.dataclass
 class TrainHyper:
-    """Static-ish per-round hyperparameters threaded into local training."""
+    """Static-ish per-round hyperparameters threaded into local training.
+
+    ``work_scale`` is the chaos subsystem's straggler knob as *data*: the
+    fraction of this client's local steps actually run (1.0 = healthy,
+    0.0 = dropped). It is a traced leaf, so per-slot straggler schedules
+    flow through the jitted round programs without recompiling — the
+    local loop is already a dynamic-trip ``while_loop``."""
     learning_rate: jnp.ndarray
     epochs: int = struct.field(pytree_node=False, default=1)
     round_idx: jnp.ndarray = struct.field(default_factory=lambda: jnp.int32(0))
+    work_scale: jnp.ndarray = struct.field(
+        default_factory=lambda: jnp.float32(1.0))

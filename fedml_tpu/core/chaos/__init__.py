@@ -1,0 +1,15 @@
+"""Chaos subsystem: deterministic fault injection + fault-tolerance seams.
+
+``FaultPlan`` is the seeded schedule (dropout / stragglers / link faults /
+crash-at-round), ``ChaosCommManager`` the transport interceptor, and
+``FaultLedger`` the injected-vs-observed accounting mirrored to mlops.
+Everything is OFF by default — with the ``chaos_*`` knobs at their
+defaults the simulator programs and the cross-silo wire are unchanged.
+"""
+
+from .interceptor import ChaosCommManager
+from .plan import (ChaosCrash, FaultLedger, FaultPlan, LinkDecision,
+                   RoundFaults)
+
+__all__ = ["ChaosCommManager", "ChaosCrash", "FaultLedger", "FaultPlan",
+           "LinkDecision", "RoundFaults"]

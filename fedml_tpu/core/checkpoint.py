@@ -41,7 +41,19 @@ class RoundCheckpointer:
                     max_to_keep=max_to_keep, create=True))
 
     def maybe_save(self, round_idx: int, state: PyTree) -> bool:
-        """Save if the cadence hits. State leaves must be arrays."""
+        """Save if the cadence hits. State leaves must be arrays.
+
+        The save is ASYNC: only the host snapshot below is synchronous;
+        the disk write proceeds in orbax's background thread while the
+        round loop keeps training (the old per-save ``wait_until_finished``
+        stalled every checkpoint round for the full write). Waiting happens
+        in :meth:`flush`/:meth:`close` and before :meth:`latest` restores.
+
+        The eager ``device_get`` + ``np.asarray`` copy is load-bearing for
+        ``donate_buffers``: it snapshots the state to HOST MEMORY *before*
+        the next round program donates (and XLA overwrites) the very
+        buffers being saved — an async writer holding device references
+        instead would read donated garbage."""
         if not self.enabled:
             return False
         if (round_idx + 1) % self.every != 0:
@@ -49,15 +61,21 @@ class RoundCheckpointer:
         import orbax.checkpoint as ocp
         state = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
         self._mgr.save(round_idx, args=ocp.args.StandardSave(state))
-        self._mgr.wait_until_finished()
-        logger.info("checkpointed round %d", round_idx)
+        logger.info("checkpointing round %d (async)", round_idx)
         return True
+
+    def flush(self) -> None:
+        """Block until every scheduled save is durable on disk."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
 
     def latest(self, template: PyTree) -> Optional[Tuple[int, PyTree]]:
         """Restore the newest checkpoint (matching ``template``'s structure)
-        or None."""
+        or None. Any save still in flight on THIS manager is awaited first
+        so a restore never reads a half-committed step."""
         if not self.enabled:
             return None
+        self.flush()
         step = self._mgr.latest_step()
         if step is None:
             return None
@@ -70,4 +88,5 @@ class RoundCheckpointer:
 
     def close(self) -> None:
         if self._mgr is not None:
+            self._mgr.wait_until_finished()
             self._mgr.close()

@@ -1,0 +1,83 @@
+"""Exponential backoff + jitter — the ONE retry policy for every transport.
+
+Before the chaos subsystem there was zero retry anywhere under
+``communication/`` (a refused connect killed the send) and one hand-rolled
+sleep loop in ``cross_silo/decentralized.py``; this module unifies both.
+Full jitter (delay drawn uniformly in ``[0, base * factor**attempt]``,
+AWS-style) de-synchronizes retry storms when many silos hit the same dead
+server; the jitter stream is seeded so a chaos run's retry timing is as
+reproducible as its fault schedule.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def backoff_delays(base_s: float = 0.2, factor: float = 2.0,
+                   max_s: float = 2.0, jitter: bool = True,
+                   seed: Optional[int] = None) -> Iterator[float]:
+    """Infinite iterator of backoff delays: ``min(base * factor**k, max)``,
+    full-jittered (uniform in ``(0, cap]``) unless ``jitter=False``."""
+    rng = np.random.default_rng(seed)
+    k = 0
+    while True:
+        cap = min(base_s * (factor ** k), max_s)
+        yield float(rng.uniform(0.0, cap)) if jitter else cap
+        if base_s * (factor ** k) < max_s:
+            k += 1
+
+
+def retry_with_backoff(
+    fn: Callable[[], None],
+    max_attempts: int = 4,
+    base_s: float = 0.2,
+    factor: float = 2.0,
+    max_s: float = 2.0,
+    deadline_s: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    seed: Optional[int] = None,
+    describe: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Run ``fn`` with up to ``max_attempts`` retries after the first try
+    (``max_attempts=0`` = fail fast, the pre-chaos behavior). Stops early
+    when ``deadline_s`` (wall seconds from the first attempt) would be
+    exceeded. Re-raises the last failure."""
+    delays = backoff_delays(base_s, factor, max_s, seed=seed)
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            delay = next(delays)
+            expired = (deadline_s is not None
+                       and time.monotonic() - t0 + delay > deadline_s)
+            if attempt > max_attempts or expired:
+                raise
+            logger.debug("%s failed (%s: %s); retry %d/%d in %.2fs",
+                         describe, type(e).__name__, e, attempt,
+                         max_attempts, delay)
+            sleep(delay)
+
+
+def retry_policy_from_args(args) -> dict:
+    """The transport-level retry knobs (``comm_retry_*``) as kwargs for
+    :func:`retry_with_backoff`; a single reading so TCP/gRPC/decentralized
+    can't drift apart on defaults."""
+    return {
+        "max_attempts": int(getattr(args, "comm_retry_max_attempts", 4)
+                            if args is not None else 4),
+        "base_s": float(getattr(args, "comm_retry_base_s", 0.2)
+                        if args is not None else 0.2),
+        "max_s": float(getattr(args, "comm_retry_max_s", 2.0)
+                       if args is not None else 2.0),
+    }

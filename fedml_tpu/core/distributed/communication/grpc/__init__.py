@@ -31,11 +31,16 @@ MAX_MSG = 1024 * 1024 * 1024  # 1 GB, matching reference constants.py:55-57
 
 class GRPCCommManager(BaseCommunicationManager):
     def __init__(self, rank: int, ip_config: Optional[Dict[int, str]] = None,
-                 base_port: int = GRPC_BASE_PORT, host: str = "127.0.0.1"):
+                 base_port: int = GRPC_BASE_PORT, host: str = "127.0.0.1",
+                 retry: Optional[dict] = None):
         super().__init__()
         self.rank = int(rank)
         self.ip_config = ip_config or {}
         self.base_port = int(base_port)
+        # transport retry policy (exponential backoff + jitter); 0
+        # attempts restores the pre-chaos fail-fast behavior
+        self.retry = {"max_attempts": 4, "base_s": 0.2, "max_s": 2.0}
+        self.retry.update(retry or {})
         self._q: "queue.Queue[bytes]" = queue.Queue()
         self._running = False
         self._channels: Dict[int, grpc.Channel] = {}
@@ -72,7 +77,13 @@ class GRPCCommManager(BaseCommunicationManager):
                               response_deserializer=lambda b: b)
 
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.get_receiver_id())(msg.encode(), timeout=60.0)
+        blob = msg.encode()
+        stub = self._stub(msg.get_receiver_id())
+        from ..backoff import retry_with_backoff
+        retry_with_backoff(
+            lambda: stub(blob, timeout=60.0), retry_on=(grpc.RpcError,),
+            describe=f"grpc send {self.rank}->{msg.get_receiver_id()}",
+            **self.retry)
 
     def handle_receive_message(self) -> None:
         self._running = True

@@ -40,11 +40,17 @@ class TCPCommManager(BaseCommunicationManager):
     reuse is not the bottleneck; model payloads stream in 1 MB chunks)."""
 
     def __init__(self, rank: int, ip_config: Optional[Dict[int, str]] = None,
-                 base_port: int = TCP_BASE_PORT, host: str = "127.0.0.1"):
+                 base_port: int = TCP_BASE_PORT, host: str = "127.0.0.1",
+                 retry: Optional[dict] = None):
         super().__init__()
         self.rank = int(rank)
         self.ip_config = ip_config or {}
         self.base_port = int(base_port)
+        # transport retry policy (exponential backoff + jitter); pre-chaos
+        # behavior — fail on the first refused connect — is retry
+        # {"max_attempts": 0}
+        self.retry = {"max_attempts": 4, "base_s": 0.2, "max_s": 2.0}
+        self.retry.update(retry or {})
         self._q: "queue.Queue[bytes]" = queue.Queue()
         self._running = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -83,9 +89,17 @@ class TCPCommManager(BaseCommunicationManager):
     def send_message(self, msg: Message) -> None:
         blob = msg.encode()
         addr = self._peer_addr(msg.get_receiver_id())
-        with socket.create_connection(addr, timeout=30.0) as s:
-            s.sendall(struct.pack("!Q", len(blob)))
-            s.sendall(blob)
+
+        def _send_once() -> None:
+            with socket.create_connection(addr, timeout=30.0) as s:
+                s.sendall(struct.pack("!Q", len(blob)))
+                s.sendall(blob)
+
+        from ..backoff import retry_with_backoff
+        retry_with_backoff(
+            _send_once, retry_on=(OSError,),
+            describe=f"tcp send {self.rank}->{msg.get_receiver_id()}",
+            **self.retry)
 
     def handle_receive_message(self) -> None:
         self._running = True

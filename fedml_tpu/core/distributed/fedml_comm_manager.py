@@ -30,6 +30,16 @@ class FedMLCommManager(Observer):
         self.message_handler_dict: Dict[object, Callable] = {}
         if self.com_manager is None:
             self.com_manager = self._init_manager()
+            # chaos interceptor at the Message send seam: only when link
+            # faults are configured (default off → the transport object
+            # and the wire are exactly what they were), and only around
+            # managers WE built — an externally shared comm object may
+            # already be wrapped by its owner
+            from ..chaos import ChaosCommManager, FaultPlan
+            plan = FaultPlan.from_args(args)
+            if plan.injects_link_faults:
+                self.com_manager = ChaosCommManager(self.com_manager, plan,
+                                                    self.rank)
         self.com_manager.add_observer(self)
 
     # --- reference-compatible surface ---------------------------------------
@@ -78,17 +88,21 @@ class FedMLCommManager(Observer):
             from .communication.inproc import InProcCommManager
             return InProcCommManager(broker, self.rank)
         if b == "TCP":
+            from .communication.backoff import retry_policy_from_args
             from .communication.tcp import TCPCommManager
             return TCPCommManager(self.rank,
                                   getattr(self.args, "ip_config", None),
                                   int(getattr(self.args, "tcp_base_port", 0)
-                                      or 29690))
+                                      or 29690),
+                                  retry=retry_policy_from_args(self.args))
         if b == "GRPC":
+            from .communication.backoff import retry_policy_from_args
             from .communication.grpc import GRPCCommManager
             return GRPCCommManager(self.rank,
                                    getattr(self.args, "ip_config", None),
                                    int(getattr(self.args, "grpc_base_port", 0)
-                                       or 29790))
+                                       or 29790),
+                                   retry=retry_policy_from_args(self.args))
         if b in ("PUBSUB", "PUBSUB_STORAGE", "MQTT_S3_LOCAL"):
             from .communication.pubsub import PubSubStorageCommManager
             port = int(getattr(self.args, "pubsub_broker_port", 0) or 0)

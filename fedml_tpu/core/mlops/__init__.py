@@ -123,6 +123,26 @@ def log_comm_round(round_idx: int, wire_bytes: int,
                    "compression": compression, "by_type": by_type})
 
 
+def log_chaos(round_idx: Optional[int] = None,
+              injected: Optional[Dict[str, Any]] = None,
+              observed: Optional[Dict[str, Any]] = None,
+              link: Optional[Dict[str, Any]] = None) -> None:
+    """Fault-ledger record from the chaos subsystem: what the
+    :class:`~fedml_tpu.core.chaos.FaultPlan` injected this round vs what
+    the runtime observed at the aggregation seam (or one link fault event).
+    A tolerance bug shows up as the two disagreeing in the run log."""
+    rec: Dict[str, Any] = {}
+    if round_idx is not None:
+        rec["round_idx"] = int(round_idx)
+    if injected is not None:
+        rec["injected"] = injected
+    if observed is not None:
+        rec["observed"] = observed
+    if link is not None:
+        rec["link"] = link
+    _emit("chaos", rec)
+
+
 def log_dispatch(name: str, wall_s: float, rounds: int = 1,
                  compiles: int = 0) -> None:
     """One device dispatch at the engine seam: host-side wall time of the

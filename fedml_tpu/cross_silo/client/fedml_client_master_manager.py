@@ -11,11 +11,13 @@ machinery of the reference collapses into the trainer's mesh).
 from __future__ import annotations
 
 import logging
+import threading
 
 import jax
 import numpy as np
 
 from ...core import mlops
+from ...core.chaos import FaultPlan
 from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
                                                        Message,
                                                        bf16_wire_to_tree,
@@ -30,6 +32,10 @@ logger = logging.getLogger(__name__)
 
 
 class ClientMasterManager(FedMLCommManager):
+    # class-level fallback: a disabled plan, so FSM methods stay callable
+    # on partially-constructed instances (tests build via __new__)
+    chaos = FaultPlan()
+
     def __init__(self, args, trainer, comm=None, rank: int = 1,
                  size: int = 0, backend: str = "INPROC"):
         super().__init__(args, comm, rank, size, backend)
@@ -41,6 +47,11 @@ class ClientMasterManager(FedMLCommManager):
         # client's error-feedback residual carried across rounds so the
         # biased sparsifier still converges. None = dense path, unchanged.
         self.cc_spec = spec_from_args(args)
+        # chaos: seeded per-(round, rank) dropout/straggler schedule —
+        # a dropped silo silently skips its report (the server's
+        # timeout/quorum tolerance takes it from there); a straggler
+        # trains a reduced fraction of its local steps
+        self.chaos = FaultPlan.from_args(args)
         self._cc_residual = None
         self._global_vec = None   # f32 vector of the last received global
         self._cc_rng = jax.random.fold_in(
@@ -57,11 +68,32 @@ class ClientMasterManager(FedMLCommManager):
             MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
 
     def run(self) -> None:
-        # announce (reference: CONNECTION_READY -> ONLINE status)
+        # announce (reference: CONNECTION_READY -> ONLINE status). The
+        # handshake is re-announced with backoff until the server's first
+        # message arrives: a single lost ONLINE frame (flaky WAN, chaos
+        # link loss) must degrade to a late join, not a stalled session.
+        self._server_heard = threading.Event()
         self.send_client_status(self.server_rank,
                                 MyMessage.MSG_CLIENT_STATUS_ONLINE)
         mlops.log_training_status("ONLINE")
+
+        def reannounce():
+            delay = 2.0
+            while not self._server_heard.wait(timeout=delay):
+                logger.info("client rank %d: re-announcing ONLINE "
+                            "(no server message yet)", self.rank)
+                try:
+                    self.send_client_status(
+                        self.server_rank, MyMessage.MSG_CLIENT_STATUS_ONLINE)
+                except Exception as e:
+                    logger.debug("rank %d ONLINE re-announce failed: %s",
+                                 self.rank, e)
+                delay = min(delay * 2.0, 15.0)
+
+        t = threading.Thread(target=reannounce, daemon=True)
+        t.start()
         super().run()
+        self._server_heard.set()  # release the re-announce thread
 
     def send_client_status(self, receiver_id: int, status: str) -> None:
         msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank,
@@ -96,12 +128,34 @@ class ClientMasterManager(FedMLCommManager):
         return params
 
     def _train_and_report(self, msg: Message) -> None:
+        if hasattr(self, "_server_heard"):
+            self._server_heard.set()
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, 0))
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        # ALWAYS consume the broadcast, even when dropping out below: a
+        # compressed sync is a delta vs the last reconstruction — skipping
+        # it would leave _global_vec one delta behind and corrupt every
+        # later round's base (and round-0 init must seed _global_vec)
         params = self._receive_global(msg)
+        if self.chaos.is_dropped(self.round_idx, self.rank):
+            # injected dropout: stay reachable (and base-synced) for the
+            # next round but train/report nothing this round
+            logger.warning("chaos: silo %d drops out of round %d",
+                           self.rank, self.round_idx)
+            mlops.log_chaos(round_idx=self.round_idx,
+                            injected={"dropped": [self.rank]})
+            return
+        work_scale = self.chaos.work_scale(self.round_idx, self.rank)
         with mlops.event("train", round_idx=self.round_idx):
-            new_params, n_samples, metrics = self.trainer.train(
-                params, client_idx, self.round_idx)
+            if work_scale < 1.0:
+                new_params, n_samples, metrics = self.trainer.train(
+                    params, client_idx, self.round_idx,
+                    work_scale=work_scale)
+            else:
+                # healthy path: the pre-chaos trainer call signature, so
+                # user trainer subclasses without the kwarg keep working
+                new_params, n_samples, metrics = self.trainer.train(
+                    params, client_idx, self.round_idx)
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
                       self.server_rank)
         if self.cc_spec is not None and self.cc_spec.method is not None:
@@ -120,12 +174,22 @@ class ClientMasterManager(FedMLCommManager):
         else:
             out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
                            tree_to_wire(new_params))
+            if self.chaos.enabled:
+                # under chaos an upload can outlive its round (delayed or
+                # duplicated link copies, post-grace degraded aggregation
+                # racing a straggler) — tag it so the server can drop the
+                # stale copy instead of polluting the next round's pool.
+                # Chaos-only: the default wire stays byte-identical.
+                out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                               self.round_idx)
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n_samples))
         out.add_params(MyMessage.MSG_ARG_KEY_CLIENT_METRICS,
                        {k: float(v) for k, v in (metrics or {}).items()})
         self.send_message(out)
 
     def handle_message_finish(self, msg: Message) -> None:
+        if hasattr(self, "_server_heard"):
+            self._server_heard.set()
         logger.info("client rank %d: finish", self.rank)
         mlops.log_training_status("FINISHED")
         self.finish()

@@ -58,14 +58,18 @@ class SiloTrainer:
         return vector_to_tree_like(jnp.asarray(vec, jnp.float32),
                                    self.params_template)
 
-    def train(self, params, client_idx: int, round_idx: int
+    def train(self, params, client_idx: int, round_idx: int,
+              work_scale: float = 1.0
               ) -> Tuple[dict, float, Dict[str, float]]:
         cdata = jax.tree_util.tree_map(lambda a: a[client_idx],
                                        self.fed.train)
+        # work_scale < 1 is the chaos straggler knob: it truncates the
+        # dynamic local-step count (data, not shape — no recompile)
         hyper = TrainHyper(
             learning_rate=jnp.float32(self.args.learning_rate),
             epochs=int(self.args.epochs),
-            round_idx=jnp.int32(round_idx))
+            round_idx=jnp.int32(round_idx),
+            work_scale=jnp.float32(work_scale))
         key = jax.random.fold_in(jax.random.fold_in(self.rng, round_idx),
                                  client_idx)
         new_params, metrics = self._train_jit(params, cdata, key, hyper)

@@ -153,22 +153,17 @@ class GossipNodeManager(FedMLCommManager):
     def _send_with_retry(self, msg: Message, timeout_s: float = 60.0) -> None:
         """Peer processes come up at their own pace and there is no server
         to sequence the handshake — round-0 sends retry until the
-        neighbor's listener is reachable."""
-        import time as _time
-        deadline = _time.monotonic() + timeout_s
-        delay = 0.2
-        while True:
-            try:
-                self.send_message(msg)
-                return
-            except Exception as e:
-                if _time.monotonic() >= deadline:
-                    raise
-                logger.debug("gossip node %d: send to %s not yet "
-                             "deliverable (%s); retrying", self.rank,
-                             msg.get_receiver_id(), e)
-                _time.sleep(delay)
-                delay = min(delay * 2, 2.0)
+        neighbor's listener is reachable. Rides the shared transport
+        backoff helper (deadline-bound, jittered) instead of the old
+        hand-rolled sleep loop."""
+        from ..core.distributed.communication.backoff import \
+            retry_with_backoff
+        retry_with_backoff(
+            lambda: self.send_message(msg),
+            max_attempts=1_000_000,  # deadline-bound, not attempt-bound
+            base_s=0.2, max_s=2.0, deadline_s=timeout_s,
+            describe=f"gossip node {self.rank} send to "
+                     f"{msg.get_receiver_id()}")
 
     def _on_params(self, msg: Message) -> None:
         r = int(msg.get(GossipMsg.K_ROUND))

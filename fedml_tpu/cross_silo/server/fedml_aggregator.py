@@ -29,6 +29,20 @@ from ...core.security import FedMLDefender, stack_to_matrix
 logger = logging.getLogger(__name__)
 
 
+def clamped_wait(remaining: Optional[float], cap: float = 1.0,
+                 floor: float = 0.05) -> float:
+    """Bound a condition-variable wait derived from a deadline.
+
+    The old inline expression ``min(remaining or 1.0, 1.0)`` was a trap:
+    ``remaining == 0.0`` is falsy and became a full extra second past the
+    deadline, and a negative underflow passed a negative timeout straight
+    to ``Condition.wait``. Clamp to ``[floor, cap]`` — the floor also
+    keeps a passed-deadline-below-quorum loop from busy-spinning."""
+    if remaining is None:
+        return cap
+    return min(max(float(remaining), floor), cap)
+
+
 class FedMLAggregator:
     def __init__(self, args, global_params, eval_fn=None):
         self.args = args
@@ -38,6 +52,13 @@ class FedMLAggregator:
         self.defender = FedMLDefender(args)
         self.dp = FedMLDifferentialPrivacy(args)
         self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
+        # fault tolerance: a timed-out round aggregates only when at least
+        # ``quorum`` silos reported (ceil(round_quorum_frac * expected),
+        # min 1) — averaging a one-silo sliver under heavy chaos is worse
+        # than waiting another timeout interval
+        frac = float(getattr(args, "round_quorum_frac", 0.0) or 0.0)
+        self.quorum = max(1, int(np.ceil(frac * self.client_num))) \
+            if frac > 0 else 1
         self._lock = threading.Condition()
         self._reset_round()
 
@@ -87,19 +108,29 @@ class FedMLAggregator:
 
     def wait_all_or_timeout(self) -> bool:
         """Block until every expected silo reported, or the round timeout
-        elapsed with at least one report. Returns True if aggregation can
-        proceed."""
+        elapsed with at least ``quorum`` reports. Returns True if
+        aggregation can proceed; False when the (doubled, as a hard cap)
+        deadline passes below quorum. Waits are clamped
+        (:func:`clamped_wait`) so deadline underflow can neither overshoot
+        the deadline by a spurious second nor busy-spin / pass a negative
+        timeout to ``Condition.wait``."""
         with self._lock:
             while True:
-                if len(self.model_dict) >= self.client_num:
+                n = len(self.model_dict)
+                if n >= self.client_num:
                     return True
                 remaining = None
                 if self.round_timeout_s > 0:
-                    remaining = self.round_timeout_s - (time.time()
-                                                       - self._round_start)
+                    elapsed = time.time() - self._round_start
+                    remaining = self.round_timeout_s - elapsed
                     if remaining <= 0:
-                        return len(self.model_dict) > 0
-                self._lock.wait(timeout=min(remaining or 1.0, 1.0))
+                        if n >= self.quorum:
+                            return True
+                        # below quorum: grant a grace interval (one more
+                        # timeout) before giving up on the round
+                        if elapsed >= 2.0 * self.round_timeout_s:
+                            return False
+                self._lock.wait(timeout=clamped_wait(remaining))
 
     def aggregate(self, round_key=None):
         """Weighted average of received silo models (hook chain: defense ->

@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from ...core import mlops
+from ...core.chaos import FaultLedger, FaultPlan
 from ...core.collectives import tree_flatten_to_vector
 from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
                                                        WIRE_STATS, Message,
@@ -34,6 +35,12 @@ logger = logging.getLogger(__name__)
 class FedMLServerManager(FedMLCommManager):
     """Rank 0. Client ranks are 1..N."""
 
+    # class-level fallbacks: a disabled plan + quorum 1, so FSM methods
+    # stay callable on partially-constructed instances (tests via __new__)
+    chaos = FaultPlan()
+    quorum = 1
+    _timeout_graced = False
+
     def __init__(self, args, aggregator, comm=None, rank: int = 0,
                  size: int = 0, backend: str = "INPROC"):
         super().__init__(args, comm, rank, size, backend)
@@ -51,6 +58,18 @@ class FedMLServerManager(FedMLCommManager):
         self.round_timeout_s = float(getattr(args, "round_timeout_s", 0) or 0)
         self._round_lock = threading.Lock()
         self._round_timer: Optional[threading.Timer] = None
+        # chaos: the server holds the same seeded plan as the clients (it
+        # is stateless), so the fault ledger can reconcile what was
+        # INJECTED (scheduled dropouts) against what it OBSERVED (silos
+        # that actually reported before the round closed)
+        self.chaos = FaultPlan.from_args(args)
+        self.chaos_ledger = FaultLedger()
+        # quorum for the timeout path: below it, grant ONE grace interval
+        # before degrading (single source of truth: FedMLAggregator.quorum
+        # — the blocking wait_all_or_timeout API applies the same policy
+        # for callers outside this event-driven FSM)
+        self.quorum = self.aggregator.quorum
+        self._timeout_graced = False
         # wire-efficient updates: clients upload compressed deltas that
         # handle_message_receive_model_from_client decompresses; the
         # sync broadcast optionally ships bf16 or (with its own server-side
@@ -120,6 +139,11 @@ class FedMLServerManager(FedMLCommManager):
                            int(client_indexes[i % len(client_indexes)]))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             self.send_message(msg)
+        if self.chaos.enabled:
+            # under chaos the whole round's uploads can vanish — the
+            # timeout must run from the broadcast, not from an upload
+            # that may never come
+            self._arm_round_timer()
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
@@ -151,20 +175,43 @@ class FedMLServerManager(FedMLCommManager):
         else:
             wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
             params = wire_to_tree(wire, self.aggregator.global_params)
-            self.aggregator.add_local_trained_result(sender, params, n)
+            up_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+            with self._round_lock:
+                # the round tag rides dense uploads only under link chaos
+                # (delayed/duplicated copies can outlive their round);
+                # check-and-add shares one lock acquisition like the
+                # compressed path, so a racing timeout aggregation cannot
+                # advance the round between them
+                stale = (up_round is not None
+                         and int(up_round) != self.round_idx)
+                if not stale:
+                    self.aggregator.add_local_trained_result(sender, params,
+                                                             n)
+            if stale:
+                logger.warning(
+                    "server: dropping stale upload from silo %s "
+                    "(round %s, now %d)", sender, up_round, self.round_idx)
+                return
         if not self.aggregator.check_whether_all_receive():
             # elastic rounds (capability beyond the reference, SURVEY §5.3):
             # a dead silo must not stall the barrier forever — arm a
             # timeout that aggregates whatever arrived
-            if self.round_timeout_s > 0 and self._round_timer is None:
-                this_round = self.round_idx
-                self._round_timer = threading.Timer(
-                    self.round_timeout_s,
-                    lambda: self._on_round_timeout(this_round))
-                self._round_timer.daemon = True
-                self._round_timer.start()
+            self._arm_round_timer()
             return
         self._complete_round()
+
+    def _arm_round_timer(self) -> None:
+        """Idempotent per round: arm the elastic-round timeout (legacy
+        seam: the first upload; chaos seam: the broadcast itself, because
+        under injected dropout/link loss a round can produce ZERO uploads
+        and a timer armed only by uploads would never fire)."""
+        if self.round_timeout_s > 0 and self._round_timer is None:
+            this_round = self.round_idx
+            self._round_timer = threading.Timer(
+                self.round_timeout_s,
+                lambda: self._on_round_timeout(this_round))
+            self._round_timer.daemon = True
+            self._round_timer.start()
 
     def _on_round_timeout(self, round_when_armed: int) -> None:
         # round-validity is re-checked inside _complete_round under the SAME
@@ -176,30 +223,95 @@ class FedMLServerManager(FedMLCommManager):
 
     def _complete_round(self, expected_round: Optional[int] = None,
                         from_timeout: bool = False) -> None:
+        skipped_round: Optional[int] = None
         with self._round_lock:
             if expected_round is not None and self.round_idx != expected_round:
                 return  # round already completed normally
-            if not self.aggregator.model_dict:
-                return  # already aggregated by a racing path
             if self._round_timer is not None:
                 self._round_timer.cancel()
                 self._round_timer = None
+            reported = len(self.aggregator.model_dict)
             if from_timeout:
-                logger.warning(
-                    "server round %d: timeout with %d/%d models — "
-                    "aggregating the silos that reported", self.round_idx,
-                    len(self.aggregator.model_dict),
-                    self.aggregator.client_num)
-            import jax.random as jrandom
-            round_key = jrandom.fold_in(self._root_key, self.round_idx)
-            self.aggregator.aggregate(round_key)
-            # close the round under the SAME lock acquisition that
-            # aggregates: a straggler arriving during the (slow) server
-            # eval below must already see the new round_idx, or its
-            # compressed delta would pass the stale check and be
-            # reconstructed against the advanced base
-            completed_round = self.round_idx
-            self.round_idx += 1
+                if reported < self.quorum and not self._timeout_graced:
+                    # tolerance: below quorum (or zero reports), grant ONE
+                    # grace interval — stragglers and compile-skewed
+                    # first rounds beat averaging a sliver of the cohort.
+                    # One interval only: under injected dropout a missing
+                    # silo stays missing for THIS round forever, so
+                    # unbounded re-arming would stall the session.
+                    self._timeout_graced = True
+                    logger.warning(
+                        "server round %d: timeout with %d/%d models — "
+                        "below quorum %d, granting one grace interval",
+                        self.round_idx, reported,
+                        self.aggregator.client_num, self.quorum)
+                    this_round = self.round_idx
+                    self._round_timer = threading.Timer(
+                        self.round_timeout_s,
+                        lambda: self._on_round_timeout(this_round))
+                    self._round_timer.daemon = True
+                    self._round_timer.start()
+                    return
+                if reported == 0:
+                    if not self.chaos.enabled:
+                        # legacy seam: without chaos the timer is armed by
+                        # the first upload, so a later upload will re-arm
+                        # — keep waiting rather than advancing past a
+                        # round nobody saw
+                        return
+                    # chaos: the whole round's uploads vanished (every
+                    # silo dropped / every upload lost) — skip the round:
+                    # the global model is unchanged, re-broadcasting the
+                    # SAME round would deterministically re-drop the same
+                    # silos, so advance and let the next round's plan roll
+                    skipped_round = self.round_idx
+                    self.chaos_ledger.record_round(
+                        skipped_round,
+                        injected={"dropped": sorted(
+                            self.client_online_status)},
+                        observed={"expected": self.aggregator.client_num,
+                                  "reported": 0, "timeout": True,
+                                  "skipped": True})
+                    self._timeout_graced = False
+                    self.round_idx += 1
+                else:
+                    logger.warning(
+                        "server round %d: timeout with %d/%d models — "
+                        "aggregating the silos that reported",
+                        self.round_idx, reported,
+                        self.aggregator.client_num)
+            if skipped_round is None:
+                if not self.aggregator.model_dict:
+                    return  # already aggregated by a racing path
+                if self.chaos.enabled:
+                    ranks = sorted(self.client_online_status)
+                    faults = self.chaos.round_faults(self.round_idx, ranks)
+                    self.chaos_ledger.record_round(
+                        self.round_idx,
+                        injected={"dropped": list(faults.dropped),
+                                  "stragglers": dict(faults.work_scale)},
+                        observed={"expected": self.aggregator.client_num,
+                                  "reported": reported,
+                                  "timeout": bool(from_timeout)})
+                import jax.random as jrandom
+                round_key = jrandom.fold_in(self._root_key, self.round_idx)
+                self.aggregator.aggregate(round_key)
+                # close the round under the SAME lock acquisition that
+                # aggregates: a straggler arriving during the (slow) server
+                # eval below must already see the new round_idx, or its
+                # compressed delta would pass the stale check and be
+                # reconstructed against the advanced base
+                completed_round = self.round_idx
+                self.round_idx += 1
+                self._timeout_graced = False
+        if skipped_round is not None:
+            logger.warning("server round %d: zero uploads after grace — "
+                           "skipping the round", skipped_round)
+            if self.round_idx >= self.round_num:
+                self.finish_session()
+            else:
+                self.sync_model_to_clients()
+            return
         stats = self.aggregator.test_on_server()
         rec = {"round": completed_round}
         if stats:
@@ -277,6 +389,8 @@ class FedMLServerManager(FedMLCommManager):
                            int(client_indexes[i % len(client_indexes)]))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             self.send_message(msg)
+        if self.chaos.enabled:
+            self._arm_round_timer()  # see send_init_msg
 
     def finish_session(self) -> None:
         for rank in sorted(self.client_online_status):

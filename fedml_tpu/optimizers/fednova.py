@@ -44,7 +44,8 @@ class FedNova(FedOptimizer):
         params, _, metrics = run_local_sgd(
             self.spec, inner_opt, global_params, cdata, rng, hyper)
         delta = tree_sub(params, global_params)
-        tau = effective_steps(cdata, hyper.epochs)
+        tau = effective_steps(cdata, hyper.epochs,
+                              getattr(hyper, "work_scale", 1.0))
         a_i = self._a_i(tau)
         normalized = jax.tree_util.tree_map(
             lambda d: d / a_i.astype(d.dtype), delta)

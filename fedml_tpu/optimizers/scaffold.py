@@ -64,7 +64,8 @@ class SCAFFOLD(FedOptimizer):
             self.spec, inner_opt, global_params, cdata, rng, hyper,
             grad_transform=self.grad_transform, ctx=ctx)
         update = tree_sub(params, global_params)
-        k = effective_steps(cdata, hyper.epochs)
+        k = effective_steps(cdata, hyper.epochs,
+                            getattr(hyper, "work_scale", 1.0))
         inv_klr = 1.0 / (k * hyper.learning_rate)
         c, c_i = server_state["c"], client_state["c_i"]
         # option II: c_i+ = c_i - c - update/(K*lr)

@@ -200,6 +200,8 @@ class SPSimulator:
             mlops.log_round_info(rounds, round_idx)
             mlops.log({k: v for k, v in rec.items() if k != "round"},
                       step=round_idx)
+        # saves are async now; make them durable before the run returns
+        self.ckpt.flush()
         wall = time.time() - t0
         last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
                          None)

@@ -31,6 +31,7 @@ from ...core.collectives import (
     psum_tree, tree_scale, tree_zeros_like, vector_to_tree_like)
 from ...core.dp import FedMLDifferentialPrivacy
 from ...core import mlops
+from ...core.chaos import ChaosCrash, FaultLedger, FaultPlan
 from ...core.checkpoint import RoundCheckpointer
 from ...core.contribution import ContributionAssessorManager
 from ...core.mesh import build_mesh
@@ -117,6 +118,21 @@ class TPUSimulator:
         self.dispatch_stats: Dict[str, Any] = {"dispatches": 0,
                                                "compiles": 0}
 
+        # chaos: seeded fault injection (off by default). Availability
+        # faults ride the round programs as DATA (per-slot work fractions
+        # next to the active mask) so injecting them never recompiles and
+        # the schedule width stays canonical; `chaos_tolerance` picks the
+        # aggregation semantics (renormalize over survivors vs dilute).
+        self.chaos = FaultPlan.from_args(args)
+        self.chaos_ledger = FaultLedger()
+        self.chaos_tolerance = bool(getattr(args, "chaos_tolerance", True))
+        over = float(getattr(args, "chaos_over_sample", 0.0) or 0.0)
+        base_n = int(args.client_num_per_round)
+        # over-sampling: draw extra clients so the post-dropout cohort
+        # still hits the configured size in expectation
+        self._sample_n = min(int(fed_dataset.num_clients),
+                             int(np.ceil(base_n * (1.0 + max(over, 0.0)))))
+
         self.attacker = FedMLAttacker(args)
         self.defender = FedMLDefender(args)
         self.dp = FedMLDifferentialPrivacy(args)
@@ -202,33 +218,49 @@ class TPUSimulator:
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
+        tolerance = self.chaos_tolerance
 
         def core(params, server_state, local_data, local_states,
-                 sched_idx, sched_active, round_key, hyper):
+                 sched_idx, sched_active, sched_work, round_key, hyper):
             dev = jax.lax.axis_index(AXIS_CLIENT)
             zero_update = tree_zeros_like(params)
             zero_extras = opt.server_extras_zero(params)
             zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
                             "count": jnp.float32(0)}
 
-            def run_slot(states, li, active):
+            def run_slot(states, li, active, ws):
                 """Train one schedule slot. CDP soundness note: the
                 per-client sensitivity bound (clip) must hold before
-                aggregation even though noise is added centrally."""
+                aggregation even though noise is added centrally.
+
+                Chaos semantics: ``ws`` (per-slot work fraction, data not
+                shape) truncates the client's dynamic local-step count; a
+                dropped client (ws == 0) runs zero steps and reports
+                nothing — ``report`` masks its update, metrics and state
+                write. At the default ws == 1.0 every product below
+                multiplies by exactly 1.0, so the round is bit-identical
+                to the chaos-free program."""
                 cdata = jax.tree_util.tree_map(lambda a: a[li], local_data)
                 cstate = jax.tree_util.tree_map(lambda a: a[li], states)
                 gcid = dev * cpd + li
                 key = jax.random.fold_in(round_key, gcid)
                 out = opt.local_train(params, server_state, cstate, cdata,
-                                      key, hyper)
+                                      key, hyper.replace(work_scale=ws))
                 upd = out.update
                 if dp.is_local_dp_enabled():
                     upd = dp.add_local_noise(
                         upd, jax.random.fold_in(key, DP_LDP_FOLD))
                 elif dp.is_global_dp_enabled():
                     upd = dp.clip_update(upd)
-                w = out.weight * active
-                return upd, out.extras, w, out.metrics, out.client_state
+                report = active * (ws > 0).astype(active.dtype)
+                w = out.weight * report
+                # tolerance ON: dropped clients leave the denominator too
+                # (renormalize over survivors). OFF: their scheduled
+                # weight still counts, diluting the aggregate with zeros
+                # — the failure mode the bench demonstrates.
+                w_den = w if tolerance else out.weight * active
+                return (upd, out.extras, w, w_den, report, out.metrics,
+                        out.client_state)
 
             def finish(states, acc_u, acc_ex, acc_w, acc_m):
                 """The FedAvg collective (pre-scaled SUM-reduce over
@@ -256,19 +288,19 @@ class TPUSimulator:
                 states, acc_u, acc_ex, acc_w, acc_m = carry
                 li = sched_idx[s]
                 active = sched_active[s]
-                upd, extras, w, mets, new_cstate = run_slot(states, li,
-                                                            active)
+                (upd, extras, w, w_den, report, mets,
+                 new_cstate) = run_slot(states, li, active, sched_work[s])
                 acc_u = jax.tree_util.tree_map(
                     lambda acc, u: acc + u * w.astype(u.dtype), acc_u, upd)
                 acc_ex = jax.tree_util.tree_map(
                     lambda acc, e: acc + e * w.astype(e.dtype), acc_ex,
                     extras)
-                acc_w = acc_w + w
+                acc_w = acc_w + w_den
                 acc_m = jax.tree_util.tree_map(
-                    lambda acc, m: acc + m * active, acc_m, mets)
+                    lambda acc, m: acc + m * report, acc_m, mets)
                 states = jax.tree_util.tree_map(
                     lambda a, n: a.at[li].set(
-                        jnp.where(active > 0, n, a[li])), states,
+                        jnp.where(report > 0, n, a[li])), states,
                     new_cstate)
                 return (states, acc_u, acc_ex, acc_w, acc_m), None
 
@@ -304,14 +336,16 @@ class TPUSimulator:
         core = self._make_round_core()
 
         def round_body(params, server_state, local_data, local_states,
-                       sched_idx, sched_active, round_key, hyper):
+                       sched_idx, sched_active, sched_work, round_key,
+                       hyper):
             """Runs per shard. shard_map hands blocks with a leading axis of
             size 1 for P(client)-sharded inputs — squeeze it, and restore it
             on the sharded output."""
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             new_params, new_sstate, states, metrics = core(
                 params, server_state, sq(local_data), sq(local_states),
-                sched_idx[0], sched_active[0], round_key, hyper)
+                sched_idx[0], sched_active[0], sched_work[0], round_key,
+                hyper)
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             return new_params, new_sstate, states, metrics
 
@@ -319,7 +353,8 @@ class TPUSimulator:
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
-                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P()),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(), P()),
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
@@ -336,26 +371,28 @@ class TPUSimulator:
         core = self._make_round_core()
 
         def rounds_body(params, server_state, local_data, local_states,
-                        sched_idxs, sched_actives, round_keys, round_idxs,
-                        hyper):
+                        sched_idxs, sched_actives, sched_works, round_keys,
+                        round_idxs, hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             local_data = sq(local_data)
             local_states = sq(local_states)
             sched_idxs = sched_idxs[:, 0]      # [R, 1, S] block -> [R, S]
             sched_actives = sched_actives[:, 0]
+            sched_works = sched_works[:, 0]
 
             def one_round(carry, xs):
                 params, server_state, states = carry
-                idx_r, act_r, key_r, ridx_r = xs
+                idx_r, act_r, work_r, key_r, ridx_r = xs
                 hyper_r = hyper.replace(round_idx=ridx_r)
                 new_p, new_s, states, metrics = core(
                     params, server_state, local_data, states,
-                    idx_r, act_r, key_r, hyper_r)
+                    idx_r, act_r, work_r, key_r, hyper_r)
                 return (new_p, new_s, states), metrics
 
             (params, server_state, states), metrics = jax.lax.scan(
                 one_round, (params, server_state, local_states),
-                (sched_idxs, sched_actives, round_keys, round_idxs))
+                (sched_idxs, sched_actives, sched_works, round_keys,
+                 round_idxs))
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             return params, server_state, states, metrics  # metrics: [R]
 
@@ -363,8 +400,8 @@ class TPUSimulator:
             rounds_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
-                      P(None, AXIS_CLIENT), P(None, AXIS_CLIENT), P(),
-                      P(), P()),
+                      P(None, AXIS_CLIENT), P(None, AXIS_CLIENT),
+                      P(None, AXIS_CLIENT), P(), P(), P()),
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
@@ -380,9 +417,10 @@ class TPUSimulator:
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
+        tolerance = self.chaos_tolerance
 
         def core(params, server_state, local_data, local_states,
-                 sched_idx, sched_active, round_key, hyper):
+                 sched_idx, sched_active, sched_work, round_key, hyper):
             dev = jax.lax.axis_index(AXIS_CLIENT)
             zero_extras = opt.server_extras_zero(params)
             zero_metrics = {"loss_sum": jnp.float32(0), "correct": jnp.float32(0),
@@ -392,12 +430,13 @@ class TPUSimulator:
                 states, acc_ex, acc_w, acc_m = carry
                 li = sched_idx[s]
                 active = sched_active[s]
+                ws = sched_work[s]
                 cdata = jax.tree_util.tree_map(lambda a: a[li], local_data)
                 cstate = jax.tree_util.tree_map(lambda a: a[li], states)
                 gcid = dev * cpd + li
                 key = jax.random.fold_in(round_key, gcid)
                 out = opt.local_train(params, server_state, cstate, cdata,
-                                      key, hyper)
+                                      key, hyper.replace(work_scale=ws))
                 upd = out.update
                 if dp.is_local_dp_enabled():
                     upd = dp.add_local_noise(
@@ -406,15 +445,20 @@ class TPUSimulator:
                     # CDP soundness: the per-client sensitivity bound must
                     # hold before aggregation even though noise is central
                     upd = dp.clip_update(upd)
-                w = out.weight * active
+                # chaos: a dropped slot (ws == 0) contributes a zero-weight
+                # row — the defense/aggregation downstream sees w == 0.
+                # Default ws == 1.0 multiplies by exactly 1.0: bit-identical.
+                report = active * (ws > 0).astype(active.dtype)
+                w = out.weight * report
+                w_den = w if tolerance else out.weight * active
                 acc_ex = jax.tree_util.tree_map(
                     lambda acc, e: acc + e * w.astype(e.dtype), acc_ex, out.extras)
-                acc_w = acc_w + w
+                acc_w = acc_w + w_den
                 acc_m = jax.tree_util.tree_map(
-                    lambda acc, m: acc + m * active, acc_m, out.metrics)
+                    lambda acc, m: acc + m * report, acc_m, out.metrics)
                 states = jax.tree_util.tree_map(
                     lambda a, n: a.at[li].set(
-                        jnp.where(active > 0, n, a[li])), states, out.client_state)
+                        jnp.where(report > 0, n, a[li])), states, out.client_state)
                 return (states, acc_ex, acc_w, acc_m), (upd, w)
 
             init = (local_states, zero_extras, jnp.float32(0), zero_metrics)
@@ -436,11 +480,13 @@ class TPUSimulator:
         core = self._make_collect_core()
 
         def round_body(params, server_state, local_data, local_states,
-                       sched_idx, sched_active, round_key, hyper):
+                       sched_idx, sched_active, sched_work, round_key,
+                       hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             upd_stack, w_stack, states, acc_ex, acc_w, acc_m = core(
                 params, server_state, sq(local_data), sq(local_states),
-                sched_idx[0], sched_active[0], round_key, hyper)
+                sched_idx[0], sched_active[0], sched_work[0], round_key,
+                hyper)
             total_w = jax.lax.psum(acc_w, AXIS_CLIENT)
             denom = jnp.maximum(total_w, 1e-12)
             agg_extras = jax.tree_util.tree_map(
@@ -454,7 +500,8 @@ class TPUSimulator:
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
-                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P()),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(), P()),
             out_specs=(P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
@@ -483,10 +530,11 @@ class TPUSimulator:
         attack_scale = float(getattr(self.attacker, "attack_scale", 1.0))
 
         def core(params, server_state, local_data, local_states,
-                 sched_idx, sched_active, rows, byz_mask, round_key, hyper):
+                 sched_idx, sched_active, sched_work, rows, byz_mask,
+                 round_key, hyper):
             upd_stack, w_stack, states, acc_ex, acc_w, acc_m = collect(
                 params, server_state, local_data, local_states,
-                sched_idx, sched_active, round_key, hyper)
+                sched_idx, sched_active, sched_work, round_key, hyper)
             # [S, ...] stack -> [S, D] f32 local matrix: same leaf order
             # and dtype cast as stack_to_matrix on the host path
             leaves = jax.tree_util.tree_leaves(upd_stack)
@@ -535,13 +583,13 @@ class TPUSimulator:
         core = self._make_robust_core()
 
         def round_body(params, server_state, local_data, local_states,
-                       sched_idx, sched_active, rows, byz_mask, round_key,
-                       hyper):
+                       sched_idx, sched_active, sched_work, rows, byz_mask,
+                       round_key, hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             new_params, new_sstate, states, metrics = core(
                 params, server_state, sq(local_data), sq(local_states),
-                sched_idx[0], sched_active[0], rows, byz_mask, round_key,
-                hyper)
+                sched_idx[0], sched_active[0], sched_work[0], rows,
+                byz_mask, round_key, hyper)
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             return new_params, new_sstate, states, metrics
 
@@ -549,7 +597,8 @@ class TPUSimulator:
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
-                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(), P(), P()),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(), P(), P(), P()),
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
@@ -563,27 +612,28 @@ class TPUSimulator:
         core = self._make_robust_core()
 
         def rounds_body(params, server_state, local_data, local_states,
-                        sched_idxs, sched_actives, rows_r, byz_r,
-                        round_keys, round_idxs, hyper):
+                        sched_idxs, sched_actives, sched_works, rows_r,
+                        byz_r, round_keys, round_idxs, hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             local_data = sq(local_data)
             local_states = sq(local_states)
             sched_idxs = sched_idxs[:, 0]      # [R, 1, S] block -> [R, S]
             sched_actives = sched_actives[:, 0]
+            sched_works = sched_works[:, 0]
 
             def one_round(carry, xs):
                 params, server_state, states = carry
-                idx_r, act_r, rows_i, byz_i, key_r, ridx_r = xs
+                idx_r, act_r, work_r, rows_i, byz_i, key_r, ridx_r = xs
                 hyper_r = hyper.replace(round_idx=ridx_r)
                 new_p, new_s, states, metrics = core(
                     params, server_state, local_data, states,
-                    idx_r, act_r, rows_i, byz_i, key_r, hyper_r)
+                    idx_r, act_r, work_r, rows_i, byz_i, key_r, hyper_r)
                 return (new_p, new_s, states), metrics
 
             (params, server_state, states), metrics = jax.lax.scan(
                 one_round, (params, server_state, local_states),
-                (sched_idxs, sched_actives, rows_r, byz_r, round_keys,
-                 round_idxs))
+                (sched_idxs, sched_actives, sched_works, rows_r, byz_r,
+                 round_keys, round_idxs))
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             return params, server_state, states, metrics  # metrics: [R]
 
@@ -591,8 +641,8 @@ class TPUSimulator:
             rounds_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
-                      P(None, AXIS_CLIENT), P(None, AXIS_CLIENT), P(),
-                      P(), P(), P(), P()),
+                      P(None, AXIS_CLIENT), P(None, AXIS_CLIENT),
+                      P(None, AXIS_CLIENT), P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P(AXIS_CLIENT), P()),
             check_vma=False,
         )
@@ -818,10 +868,12 @@ class TPUSimulator:
 
     def run_round(self, round_idx: int, hyper: TrainHyper) -> Dict[str, float]:
         pad_to = self._canonical_width() if self.robust_fused else None
-        sampled, (idx, active) = self._schedule_for(round_idx,
-                                                    pad_to=pad_to)
+        sampled, (idx, active, work), faults = self._schedule_for(
+            round_idx, pad_to=pad_to)
+        self._ledger_round(round_idx, sampled, active, work, faults)
         idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
         active = jax.device_put(jnp.asarray(active), self.client_sharding)
+        work = jax.device_put(jnp.asarray(work), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, round_idx)
         hyper_r = hyper.replace(round_idx=jnp.int32(round_idx))
         if self.robust_fused:
@@ -830,7 +882,7 @@ class TPUSimulator:
              metrics) = self._traced(
                 "robust_round_fused", 1, self._round_fn,
                 self.params, self.server_state, self.train_data,
-                self.client_states, idx, active, jnp.asarray(rows),
+                self.client_states, idx, active, work, jnp.asarray(rows),
                 jnp.asarray(byz), round_key, hyper_r)
             self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
             return metrics
@@ -839,7 +891,7 @@ class TPUSimulator:
              metrics) = self._traced(
                 "robust_collect", 1, self._round_fn,
                 self.params, self.server_state, self.train_data,
-                self.client_states, idx, active, round_key, hyper_r)
+                self.client_states, idx, active, work, round_key, hyper_r)
             agg_update = self._robust_aggregate(
                 upd_stack, w_stack, sampled, int(idx.shape[1]),
                 round_key, round_idx)
@@ -853,7 +905,7 @@ class TPUSimulator:
          metrics) = self._traced(
             "round", 1, self._round_fn,
             self.params, self.server_state, self.train_data,
-            self.client_states, idx, active, round_key, hyper_r)
+            self.client_states, idx, active, work, round_key, hyper_r)
         self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
         return metrics
 
@@ -862,20 +914,53 @@ class TPUSimulator:
         buckets against. Padding every round to THIS width (instead of a
         per-block max) keeps the fused programs at exactly one compile per
         run — padded slots carry active=0 and are masked in the round
-        body, so results are unchanged."""
-        return min(self.cpd, int(self.args.client_num_per_round))
+        body, so results are unchanged. ``_sample_n`` already folds the
+        chaos over-sampling factor in, so an over-sampled run is as
+        compile-stable as a plain one."""
+        return min(self.cpd, self._sample_n)
 
     def _schedule_for(self, round_idx: int, pad_to: Optional[int] = None):
         sampled = client_sampling(round_idx, self.fed.num_clients,
-                                  int(self.args.client_num_per_round))
-        max_slots = min(self.cpd, int(self.args.client_num_per_round))
+                                  self._sample_n)
+        max_slots = min(self.cpd, self._sample_n)
         idx, active = build_schedule(sampled, self.n_devices, self.cpd,
                                      max_slots=max_slots)
+        # chaos availability as DATA: per-slot work fractions next to the
+        # active mask (0 = dropped, (0,1) = straggler, 1 = healthy). The
+        # slot placement loop mirrors build_schedule's, so work[d, s]
+        # lands on exactly the client idx[d, s] trains.
+        work = np.ones_like(active)
+        faults = None
+        if self.chaos.injects_availability:
+            faults = self.chaos.round_faults(round_idx, sampled)
+            counts = [0] * self.n_devices
+            for cid in sampled:
+                d = cid // self.cpd
+                work[d, counts[d]] = faults.scale_for(cid)
+                counts[d] += 1
         if pad_to is not None and idx.shape[1] < pad_to:
             extra = pad_to - idx.shape[1]
             idx = np.pad(idx, ((0, 0), (0, extra)))
             active = np.pad(active, ((0, 0), (0, extra)))
-        return sampled, (idx, active)
+            work = np.pad(work, ((0, 0), (0, extra)))
+        return sampled, (idx, active, work), faults
+
+    def _ledger_round(self, round_idx: int, sampled, active, work,
+                      faults) -> None:
+        """Injected-vs-observed fault accounting at the aggregation seam:
+        ``observed`` is what the round program was actually fed (the
+        participating slot count after masking)."""
+        if faults is None:
+            return
+        participating = int(np.sum((np.asarray(active) > 0)
+                                   & (np.asarray(work) > 0)))
+        self.chaos_ledger.record_round(
+            round_idx,
+            injected={"dropped": list(faults.dropped),
+                      "stragglers": dict(faults.work_scale)},
+            observed={"sampled": len(sampled),
+                      "participating": participating,
+                      "tolerance": self.chaos_tolerance})
 
     def run_rounds_fused(self, start_round: int, n_rounds: int,
                          hyper: TrainHyper) -> List[Dict[str, float]]:
@@ -888,7 +973,8 @@ class TPUSimulator:
         if n_rounds == 1 or (self.robust_mode and not self.robust_fused):
             return [self.run_round(start_round + i, hyper)
                     for i in range(n_rounds)]
-        idxs, acts, keys, ridxs, rows_r, byz_r = [], [], [], [], [], []
+        idxs, acts, works, keys, ridxs, rows_r, byz_r = ([], [], [], [], [],
+                                                         [], [])
         # every round pads to the simulator-canonical width (padded slots
         # carry active=0 and are masked in the round body): build_schedule
         # buckets slot counts per round (powers of two), and a per-block
@@ -897,9 +983,12 @@ class TPUSimulator:
         width = self._canonical_width()
         part = 0.0
         for r in range(start_round, start_round + n_rounds):
-            sampled, (idx, active) = self._schedule_for(r, pad_to=width)
+            sampled, (idx, active, work), faults = self._schedule_for(
+                r, pad_to=width)
+            self._ledger_round(r, sampled, active, work, faults)
             idxs.append(idx)
             acts.append(active)
+            works.append(work)
             keys.append(jax.random.fold_in(self.rng, r))
             ridxs.append(r)
             if self.robust_fused:
@@ -912,6 +1001,8 @@ class TPUSimulator:
                                         axis=0), sched_sharding)
         acts = jax.device_put(jnp.stack([jnp.asarray(a) for a in acts],
                                         axis=0), sched_sharding)
+        works = jax.device_put(jnp.stack([jnp.asarray(w) for w in works],
+                                         axis=0), sched_sharding)
         keys = jnp.stack(keys)
         ridxs = jnp.asarray(ridxs, jnp.int32)
         hyper_0 = hyper.replace(round_idx=jnp.int32(start_round))
@@ -922,7 +1013,7 @@ class TPUSimulator:
              metrics) = self._traced(
                 "robust_rounds_fused", n_rounds, self._robust_fused_fn,
                 self.params, self.server_state, self.train_data,
-                self.client_states, idxs, acts,
+                self.client_states, idxs, acts, works,
                 jnp.stack([jnp.asarray(r) for r in rows_r]),
                 jnp.stack([jnp.asarray(b) for b in byz_r]),
                 keys, ridxs, hyper_0)
@@ -933,7 +1024,8 @@ class TPUSimulator:
              metrics) = self._traced(
                 "rounds_fused", n_rounds, self._fused_fn,
                 self.params, self.server_state, self.train_data,
-                self.client_states, idxs, acts, keys, ridxs, hyper_0)
+                self.client_states, idxs, acts, works, keys, ridxs,
+                hyper_0)
         for _ in range(n_rounds):  # DP accounting stays per-round
             self.dp.record_round(part / n_rounds)
         host = jax.device_get(metrics)
@@ -1003,7 +1095,19 @@ class TPUSimulator:
                 mlops.log_round_info(rounds, r)
                 mlops.log({k: v for k, v in rec.items() if k != "round"},
                           step=r)
+                if self.chaos.crash_due(r):
+                    # injected crash-at-round event: surface AFTER the
+                    # round's record + checkpoint so a resume restores a
+                    # consistent trajectory. Flush the async checkpoint
+                    # writer first — a torn save would turn a
+                    # deterministic e2e into a flaky one.
+                    self.ckpt.flush()
+                    raise ChaosCrash(r)
             round_idx = stop + 1
+        # async checkpoint saves must be durable before the run returns —
+        # the next run's RoundCheckpointer is a different manager and
+        # cannot wait on this one's pending writes
+        self.ckpt.flush()
         wall = time.time() - t0
         last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
                          None)

@@ -95,6 +95,151 @@ def test_cross_silo_matches_sp_golden():
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.chaos
+def test_chaos_dropout_session_completes():
+    """Seeded 25% silo dropout + stragglers over the in-proc WAN FSM: the
+    round timeout + quorum tolerance must carry the session through every
+    round, and the server's fault ledger must reconcile injected dropouts
+    with the silos it observed reporting."""
+    import threading
+    from fedml_tpu.core.chaos import FaultPlan
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_silo.horizontal.runner import (build_client,
+                                                        build_server)
+
+    # round_timeout_s must exceed the per-client jit-compile skew (see
+    # test_round_timeout_with_dead_silo)
+    args = make_args(comm_round=3, round_timeout_s=20.0,
+                     chaos_dropout_prob=0.25, chaos_straggler_prob=0.2,
+                     chaos_seed=23)
+    plan = FaultPlan.from_args(args)
+    ranks = [1, 2, 3, 4]
+    # the seed must actually schedule at least one dropout in-session
+    assert any(plan.is_dropped(r, rank) for r in range(3) for rank in ranks)
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    server = build_server(args, fed, bundle, backend="INPROC")
+    clients = [build_client(args, fed, bundle, rank=r, backend="INPROC")
+               for r in ranks]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=240.0)
+    assert done.get("ok"), "chaos session stalled"
+    assert len(server.result["history"]) == 3
+    recs = server.chaos_ledger.rounds()
+    assert len(recs) == 3
+    for rec in recs:
+        observed, injected = rec["observed"], rec["injected"]
+        assert 1 <= observed["reported"] <= observed["expected"]
+        if injected["dropped"]:
+            # every injected dropout is a silo the server did NOT hear from
+            assert observed["reported"] < observed["expected"]
+
+
+@pytest.mark.chaos
+def test_chaos_round_with_zero_uploads_is_skipped_not_stalled():
+    """Seed 1 drops BOTH silos in round 1: no upload ever arrives for that
+    round, so the broadcast-armed timeout (+ one grace interval) must fire
+    and the server must SKIP the round — advancing with the global model
+    unchanged — instead of stalling forever on an upload-armed timer that
+    never starts."""
+    import threading
+    from fedml_tpu.core.chaos import FaultPlan
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_silo.horizontal.runner import (build_client,
+                                                        build_server)
+
+    args = make_args(client_num_in_total=2, client_num_per_round=2,
+                     comm_round=3, round_timeout_s=12.0,
+                     chaos_dropout_prob=0.5, chaos_seed=1)
+    plan = FaultPlan.from_args(args)
+    assert all(plan.is_dropped(1, rk) for rk in (1, 2))  # the dead round
+    assert not any(plan.is_dropped(0, rk) for rk in (1, 2))
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    server = build_server(args, fed, bundle, backend="INPROC")
+    clients = [build_client(args, fed, bundle, rank=r, backend="INPROC")
+               for r in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=240.0)
+    assert done.get("ok"), "server stalled on the zero-upload round"
+    skipped = [r for r in server.chaos_ledger.rounds()
+               if r["observed"].get("skipped")]
+    assert skipped and skipped[0]["round_idx"] == 1
+    # rounds 0 and 2 aggregated normally; round 1 was skipped
+    assert [h["round"] for h in server.result["history"]] == [0, 2]
+
+
+@pytest.mark.chaos
+def test_chaos_link_faults_session_completes():
+    """Seeded link loss + duplication + delay at the Message send seam:
+    the ONLINE re-announce handshake, round timeout, duplicate-upload
+    idempotency, and stale-round tagging must together carry the session
+    through every round."""
+    import threading
+    from fedml_tpu.core.chaos import ChaosCommManager
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_silo.horizontal.runner import (build_client,
+                                                        build_server)
+
+    args = make_args(comm_round=3, round_timeout_s=20.0,
+                     chaos_link_loss_prob=0.08, chaos_link_dup_prob=0.1,
+                     chaos_link_delay_prob=0.1, chaos_link_delay_s=0.2,
+                     chaos_seed=31)
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    server = build_server(args, fed, bundle, backend="INPROC")
+    assert isinstance(server.com_manager, ChaosCommManager)
+    clients = [build_client(args, fed, bundle, rank=r, backend="INPROC")
+               for r in (1, 2, 3, 4)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=240.0)
+    assert done.get("ok"), "link-fault session stalled"
+    assert len(server.result["history"]) == 3
+    assert server.result["final_test_acc"] > 0.5
+    # the interceptor actually fired somewhere in the session
+    fault_events = list(server.com_manager.ledger.links())
+    for c in clients:
+        if isinstance(c.com_manager, ChaosCommManager):
+            fault_events.extend(c.com_manager.ledger.links())
+    assert fault_events
+
+
 def test_cross_silo_session_over_real_grpc():
     """Full FL session over the real gRPC transport (not in-proc): server +
     2 silo clients, each with its own gRPC server on loopback — the wire
