@@ -509,17 +509,18 @@ def bench_engine_mfu_resnet18():
     }), flush=True)
 
 
-def bench_robust_krum(rounds_per_leg=16, block=8):
-    """Defended-round throughput (ISSUE 2): FedAvg under a byzantine-flip
-    model attack with a multi-krum defense, run twice over the SAME
-    defense config — ``robust_fused: host`` (train dispatch -> host-ordered
-    update matrix -> defense dispatch -> server-update dispatch, the
-    pre-fusion pipeline) vs ``robust_fused: auto`` (the whole robust round
-    as ONE jitted SPMD program, fused ``block`` rounds per dispatch).
-    The two paths must agree client-for-client — identical defense
-    verdicts imply identical final params, which is what
-    ``params_max_abs_diff`` audits; a speedup that changes verdicts would
-    be a bug, not a win."""
+def bench_robust_defended(metric, unit_note, config_kw, rounds_per_leg=16,
+                          block=8, host_kw=None):
+    """Defended-round throughput (ISSUEs 2/4): run the SAME robust config
+    twice — ``robust_fused: host`` (train dispatch -> host-ordered update
+    matrix -> defense dispatch -> server-update dispatch, the pre-fusion
+    pipeline; ``host_kw`` can force it further back, e.g.
+    ``sharded_defense: false`` for the contribution leg's pre-ISSUE-4
+    behavior) vs ``robust_fused: auto`` (the whole robust round as ONE
+    jitted SPMD program, fused ``block`` rounds per dispatch). The two
+    paths must agree client-for-client — identical defense verdicts imply
+    identical final params, which is what ``params_max_abs_diff`` audits;
+    a speedup that changes verdicts would be a bug, not a win."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -532,17 +533,13 @@ def bench_robust_krum(rounds_per_leg=16, block=8):
     from fedml_tpu.optimizers.registry import create_optimizer
     from fedml_tpu.simulation.tpu.engine import TPUSimulator
 
-    def build(mode):
+    def build(mode, extra):
         args = Arguments(
             dataset="synthetic_mnist", model="lr",
             client_num_in_total=16, client_num_per_round=16,
             comm_round=rounds_per_leg, epochs=1, batch_size=32,
             learning_rate=0.1, frequency_of_the_test=10_000,
-            random_seed=0, enable_attack=True,
-            attack_type="byzantine_flip", byzantine_client_num=3,
-            attack_scale=5.0, enable_defense=True,
-            defense_type="multi_krum", krum_param_m=5,
-            robust_fused=mode)
+            random_seed=0, robust_fused=mode, **config_kw, **extra)
         fed, output_dim = load(args)
         bundle = create(args, output_dim)
         spec = ClassificationTrainer(bundle.apply)
@@ -552,8 +549,8 @@ def bench_robust_krum(rounds_per_leg=16, block=8):
                            epochs=1)
         return sim, hyper
 
-    def timed_leg(mode):
-        sim, hyper = build(mode)
+    def timed_leg(mode, extra):
+        sim, hyper = build(mode, extra)
         r = [0]
 
         def leg_block():
@@ -570,8 +567,8 @@ def bench_robust_krum(rounds_per_leg=16, block=8):
             trials.append((time.perf_counter() - t0) / block)
         return min(trials), trials, sim
 
-    fused_s, fused_trials, sim_f = timed_leg("auto")
-    host_s, host_trials, sim_h = timed_leg("host")
+    fused_s, fused_trials, sim_f = timed_leg("auto", {})
+    host_s, host_trials, sim_h = timed_leg("host", host_kw or {})
     assert sim_f.robust_fused and not sim_h.robust_fused
     # verdict audit: both engines ran the identical round sequence above —
     # identical params <=> identical defense verdicts client-for-client
@@ -581,10 +578,10 @@ def bench_robust_krum(rounds_per_leg=16, block=8):
                                jax.tree_util.tree_leaves(sim_h.params)))
     speedup = host_s / fused_s if fused_s else None
     print(json.dumps({
-        "metric": "fedavg_robust_krum_rounds_per_hour",
+        "metric": metric,
         "value": round(3600.0 / fused_s, 1),
-        "unit": f"defended rounds/hour (16 clients, byzantine-flip x3 + "
-                f"multi-krum m=5, fused {block}-round dispatch)",
+        "unit": f"defended rounds/hour ({unit_note}, fused {block}-round "
+                f"dispatch)",
         "vs_baseline": round(speedup, 3) if speedup else None,
         "host_path_rounds_per_hour": round(3600.0 / host_s, 1),
         "step_time_s": round(fused_s, 4),
@@ -595,6 +592,48 @@ def bench_robust_krum(rounds_per_leg=16, block=8):
         "verdicts_identical": bool(diff < 1e-5),
         "n_devices": sim_f.n_devices,
     }), flush=True)
+
+
+def bench_robust_krum(rounds_per_leg=16, block=8):
+    """ISSUE 2 leg: byzantine-flip x3 + multi-krum m=5."""
+    bench_robust_defended(
+        "fedavg_robust_krum_rounds_per_hour",
+        "16 clients, byzantine-flip x3 + multi-krum m=5",
+        dict(enable_attack=True, attack_type="byzantine_flip",
+             byzantine_client_num=3, attack_scale=5.0, enable_defense=True,
+             defense_type="multi_krum", krum_param_m=5),
+        rounds_per_leg=rounds_per_leg, block=block)
+
+
+def bench_robust_rfa(rounds_per_leg=16, block=8):
+    """ISSUE 4 leg: RFA (smoothed Weiszfeld geometric median) — the
+    strongest defense we ship, host-only before this issue. The fused
+    program runs the whole Weiszfeld loop on feature shards (psum'd
+    distance fragments per iteration), so the ~3x dispatch tax is gone."""
+    bench_robust_defended(
+        "fedavg_robust_rfa_rounds_per_hour",
+        "16 clients, byzantine-flip x3 + RFA geometric median",
+        dict(enable_attack=True, attack_type="byzantine_flip",
+             byzantine_client_num=3, attack_scale=5.0, enable_defense=True,
+             defense_type="rfa"),
+        rounds_per_leg=rounds_per_leg, block=block)
+
+
+def bench_contribution_fused(rounds_per_leg=16, block=8):
+    """ISSUE 4 leg: contribution assessment (LOO) + multi-krum. Before
+    this issue ``contribution.enabled`` forced the full host fallback
+    (collect dispatch + host defense + host Shapley/LOO); now the round
+    stays ONE fused dispatch and the K+1 coalition evaluations run on the
+    sharded matrix. The host leg pins the pre-ISSUE-4 behavior
+    (``sharded_defense: false`` so the defense AND assessor are
+    host-side)."""
+    bench_robust_defended(
+        "fedavg_contribution_loo_rounds_per_hour",
+        "16 clients, multi-krum m=5 + LOO contribution",
+        dict(enable_defense=True, defense_type="multi_krum",
+             krum_param_m=5, contribution_method="loo"),
+        rounds_per_leg=rounds_per_leg, block=block,
+        host_kw=dict(sharded_defense="false"))
 
 
 def bench_hierarchical_femnist(global_rounds=2):
@@ -873,6 +912,9 @@ def run():
     for name, fn in (
             ("fedavg_resnet18_engine_mfu", bench_engine_mfu_resnet18),
             ("fedavg_robust_krum_rounds_per_hour", bench_robust_krum),
+            ("fedavg_robust_rfa_rounds_per_hour", bench_robust_rfa),
+            ("fedavg_contribution_loo_rounds_per_hour",
+             bench_contribution_fused),
             ("hierarchical_femnist_mobilenet_rounds_per_hour",
              bench_hierarchical_femnist),
             ("fedavg_digits_time_to_90pct_s", bench_time_to_acc),

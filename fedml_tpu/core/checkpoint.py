@@ -3,10 +3,16 @@
 The reference has NO FL-round checkpointing (SURVEY §5.4: the ``comm_round``
 loop keeps state in memory only, ``sp/fedavg/fedavg_api.py:72``; only the
 LLM path saves HF checkpoints). Here it is default-capable and cheap: the
-full FL state is (params, server_state, client_states, host RNG key, round),
-a few MB for classic models — saved every ``checkpoint_every_rounds`` and
-restored on construction, which also gives the elastic-recovery story the
-reference lacks (round-level restart after failure).
+full FL state is (params, server_state, client_states, host RNG key, DP
+accountant, and — when a stateful defense runs the default sharded path —
+the feature-sharded cross-round defense state, e.g. the foolsgold
+similarity history, so crash-resume replays identical defense verdicts;
+with ``sharded_defense: false`` the host kernels' state is NOT
+checkpointed and the engine warns that resume restarts it cold), a few MB
+for
+classic models — saved every ``checkpoint_every_rounds`` and restored on
+construction, which also gives the elastic-recovery story the reference
+lacks (round-level restart after failure).
 """
 
 from __future__ import annotations

@@ -5,6 +5,8 @@ leave-one-out, and the manager consulted from the server aggregation hook
 hook ``server_aggregator.py:105``)."""
 
 from .contribution_assessor import (ContributionAssessorManager,
-                                    gtg_shapley, leave_one_out)
+                                    gtg_shapley, gtg_shapley_values,
+                                    leave_one_out, leave_one_out_values)
 
-__all__ = ["ContributionAssessorManager", "gtg_shapley", "leave_one_out"]
+__all__ = ["ContributionAssessorManager", "gtg_shapley",
+           "gtg_shapley_values", "leave_one_out", "leave_one_out_values"]

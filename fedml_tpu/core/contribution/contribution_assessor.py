@@ -9,6 +9,13 @@ S's updates) is evaluated with ONE jitted function taking a client
 *inclusion mask*, so every coalition evaluation reuses the same compiled
 program; the Monte-Carlo permutation loop stays on the host (tiny) while all
 FLOPs (aggregate + eval forward pass) stay on device.
+
+The LOO/GTG drivers therefore only ever see ``v(mask) -> float``
+(:func:`leave_one_out_values` / :func:`gtg_shapley_values`) — which is what
+lets the TPU engine swap in its SHARDED subset-evaluation kernel (masked
+aggregation over the feature-sharded update matrix + eval over a sharded
+held-out set, see ``TPUSimulator._assess_contribution_fused``) without this
+module knowing about meshes: only the final [K] scores cross to the host.
 """
 
 from __future__ import annotations
@@ -44,42 +51,49 @@ def _make_subset_value_fn(eval_fn: Callable[[PyTree], jnp.ndarray]):
     return jax.jit(value)
 
 
+def leave_one_out_values(value_of_mask: Callable[[jnp.ndarray], float],
+                         k: int) -> np.ndarray:
+    """LOO contribution over an opaque coalition-value callable
+    ``value_of_mask([K] 0/1 mask) -> float``: v(N) - v(N \\ {i}) per
+    client. The callable owns all device work (and any sharding)."""
+    full = float(value_of_mask(jnp.ones(k)))
+    out = np.zeros(k)
+    for i in range(k):
+        out[i] = full - float(value_of_mask(jnp.ones(k).at[i].set(0.0)))
+    return out
+
+
 def leave_one_out(
     params: PyTree,
     stacked_updates: PyTree,
     weights: jnp.ndarray,
     eval_fn: Callable[[PyTree], jnp.ndarray],
 ) -> np.ndarray:
-    """LOO contribution: v(N) - v(N \\ {i}) per client."""
+    """LOO over stacked update pytrees (builds the jitted subset-value fn
+    and defers to :func:`leave_one_out_values`)."""
     k = int(weights.shape[0])
     vfn = _make_subset_value_fn(eval_fn)
-    full = float(vfn(params, stacked_updates, weights, jnp.ones(k)))
-    out = np.zeros(k)
-    for i in range(k):
-        mask = jnp.ones(k).at[i].set(0.0)
-        out[i] = full - float(vfn(params, stacked_updates, weights, mask))
-    return out
+    return leave_one_out_values(
+        lambda mask: vfn(params, stacked_updates, weights, mask), k)
 
 
-def gtg_shapley(
-    params: PyTree,
-    stacked_updates: PyTree,
-    weights: jnp.ndarray,
-    eval_fn: Callable[[PyTree], jnp.ndarray],
+def gtg_shapley_values(
+    value_of_mask: Callable[[jnp.ndarray], float],
+    k: int,
     max_perms: int = 20,
     truncation_eps: float = 1e-4,
     convergence_eps: float = 0.01,
     seed: int = 0,
 ) -> np.ndarray:
     """Guided-truncated-gradient Shapley (reference
-    ``gtg_shapley_value.py``): Monte-Carlo over permutations with
-    within-permutation truncation (stop scanning once the remaining marginal
-    gain is below ``truncation_eps``) and between-permutation convergence
-    (stop when the running Shapley estimate moves < ``convergence_eps``)."""
-    k = int(weights.shape[0])
-    vfn = _make_subset_value_fn(eval_fn)
-    v_empty = float(vfn(params, stacked_updates, weights, jnp.zeros(k)))
-    v_full = float(vfn(params, stacked_updates, weights, jnp.ones(k)))
+    ``gtg_shapley_value.py``) over an opaque coalition-value callable:
+    Monte-Carlo over permutations with within-permutation truncation (stop
+    scanning once the remaining marginal gain is below ``truncation_eps``)
+    and between-permutation convergence (stop when the running Shapley
+    estimate moves < ``convergence_eps``)."""
+    vfn = lambda mask: float(value_of_mask(mask))
+    v_empty = vfn(jnp.zeros(k))
+    v_full = vfn(jnp.ones(k))
     rng = np.random.RandomState(seed)
     phi = np.zeros(k)
     count = 0
@@ -94,8 +108,7 @@ def gtg_shapley(
                 # truncation: remaining clients get zero marginal this pass
                 break
             mask[i] = 1.0
-            v_cur = float(vfn(params, stacked_updates, weights,
-                              jnp.asarray(mask)))
+            v_cur = vfn(jnp.asarray(mask))
             phi[i] += v_cur - v_prev
             v_prev = v_cur
         count += 1
@@ -104,6 +117,26 @@ def gtg_shapley(
             break
         prev = est
     return phi / max(count, 1)
+
+
+def gtg_shapley(
+    params: PyTree,
+    stacked_updates: PyTree,
+    weights: jnp.ndarray,
+    eval_fn: Callable[[PyTree], jnp.ndarray],
+    max_perms: int = 20,
+    truncation_eps: float = 1e-4,
+    convergence_eps: float = 0.01,
+    seed: int = 0,
+) -> np.ndarray:
+    """GTG-Shapley over stacked update pytrees (builds the jitted
+    subset-value fn and defers to :func:`gtg_shapley_values`)."""
+    k = int(weights.shape[0])
+    vfn = _make_subset_value_fn(eval_fn)
+    return gtg_shapley_values(
+        lambda mask: vfn(params, stacked_updates, weights, mask), k,
+        max_perms=max_perms, truncation_eps=truncation_eps,
+        convergence_eps=convergence_eps, seed=seed)
 
 
 class ContributionAssessorManager:
@@ -117,6 +150,27 @@ class ContributionAssessorManager:
                                        "gtg_shapley", "shapley")
         self.history: List[Dict[str, Any]] = []
 
+    def assess_values(
+        self,
+        value_of_mask: Callable[[jnp.ndarray], float],
+        k: int,
+        client_ids: Optional[Sequence[int]] = None,
+        round_idx: int = 0,
+    ) -> Optional[np.ndarray]:
+        """Assess over an opaque coalition-value callable — the entry point
+        the fused TPU path uses (its ``value_of_mask`` evaluates on the
+        feature-sharded update matrix; only scalars reach the host)."""
+        if not self.enabled:
+            return None
+        if self.method in ("loo", "leave_one_out"):
+            vals = leave_one_out_values(value_of_mask, k)
+        else:
+            vals = gtg_shapley_values(value_of_mask, k,
+                                      max_perms=int(getattr(
+                                          self.args, "shapley_max_perms",
+                                          20) or 20))
+        return self._record(vals, client_ids, round_idx)
+
     def assess(
         self,
         params: PyTree,
@@ -128,12 +182,14 @@ class ContributionAssessorManager:
     ) -> Optional[np.ndarray]:
         if not self.enabled:
             return None
-        if self.method in ("loo", "leave_one_out"):
-            vals = leave_one_out(params, stacked_updates, weights, eval_fn)
-        else:
-            vals = gtg_shapley(params, stacked_updates, weights, eval_fn,
-                               max_perms=int(getattr(
-                                   self.args, "shapley_max_perms", 20) or 20))
+        vfn = _make_subset_value_fn(eval_fn)
+        return self.assess_values(
+            lambda mask: vfn(params, stacked_updates, weights, mask),
+            int(weights.shape[0]), client_ids=client_ids,
+            round_idx=round_idx)
+
+    def _record(self, vals: np.ndarray, client_ids, round_idx: int
+                ) -> np.ndarray:
         self.history.append({
             "round": round_idx,
             "client_ids": list(client_ids) if client_ids is not None

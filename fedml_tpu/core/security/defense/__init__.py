@@ -61,6 +61,7 @@ class FedMLDefender:
         self.cclip_tau = get_float(args, "tau", 10.0)
         self.dp_stddev = get_float(args, "stddev", 0.002)
         self.alpha = get_float(args, "alpha", 1.0)
+        self.rfa_iters = get_int(args, "rfa_iters", 8)
         # host-side cross-round state
         self._fg_history: Optional[np.ndarray] = None
         self._cclip_momentum = None
@@ -122,7 +123,8 @@ class FedMLDefender:
         if d == "trimmed_mean":
             return robust_agg.trimmed_mean(mat, weights, self.trim_fraction)
         if d in ("rfa", "geometric_median"):
-            return robust_agg.geometric_median(mat, weights)
+            return robust_agg.geometric_median(mat, weights,
+                                               iters=self.rfa_iters)
         if d == "norm_clip":
             return robust_agg.norm_clip(mat, weights, self.norm_bound)
         if d == "cclip":

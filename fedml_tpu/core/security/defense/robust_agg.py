@@ -40,16 +40,24 @@ def pairwise_sq_dists(updates: Arr) -> Arr:
                        - 2.0 * updates @ updates.T, 0.0)
 
 
+def krum_scores_from_dists(dists: Arr, byzantine_count: int) -> Arr:
+    """Krum scoring on an already-computed [K, K] squared-distance matrix —
+    the ONE implementation shared with the sharded kernels (which psum the
+    matrix from per-shard partials); any drift would silently break
+    host/fused verdict parity for krum, multi-krum, and bulyan."""
+    k = dists.shape[0]
+    closest = max(k - byzantine_count - 2, 1)
+    d = dists + jnp.eye(k) * 1e30  # exclude self
+    sorted_d = jnp.sort(d, axis=1)
+    return jnp.sum(sorted_d[:, :closest], axis=1)
+
+
 def krum_scores(updates: Arr, byzantine_count: int) -> Arr:
     """Krum score per client: sum of its K - f - 2 smallest squared distances
     to other clients (Blanchard et al.; reference
     ``defense/krum_defense.py``)."""
-    k = updates.shape[0]
-    closest = max(k - byzantine_count - 2, 1)
-    d = pairwise_sq_dists(updates)
-    d = d + jnp.eye(k) * 1e30  # exclude self
-    sorted_d = jnp.sort(d, axis=1)
-    return jnp.sum(sorted_d[:, :closest], axis=1)
+    return krum_scores_from_dists(pairwise_sq_dists(updates),
+                                  byzantine_count)
 
 
 def krum(updates: Arr, weights: Arr, byzantine_count: int = 0,

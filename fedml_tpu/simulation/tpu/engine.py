@@ -14,6 +14,7 @@ call. No host round-trips, no pickled state-dicts, collectives ride ICI.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional
@@ -36,6 +37,7 @@ from ...core.checkpoint import RoundCheckpointer
 from ...core.contribution import ContributionAssessorManager
 from ...core.mesh import build_mesh
 from ...core.security import FedMLAttacker, FedMLDefender
+from ...core.security.defense import sharded as sharded_defense
 from ..sampling import client_sampling, build_schedule
 
 # PRNG fold tags reserved for the DP noise streams (shared with the SP
@@ -61,6 +63,43 @@ def _pad_clients(fed_train: ClientData, num_clients: int, n_devices: int):
             return jnp.pad(a, pads)
         fed_train = jax.tree_util.tree_map(padleaf, fed_train)
     return fed_train, cpd, total
+
+
+def _maybe_enable_compile_cache(args) -> None:
+    """Opt-in persistent XLA compilation cache (``compile_cache_dir``):
+    repeat runs reuse the compiled fused round programs instead of paying
+    the multi-second compile that dominates short-run wall time (the
+    ``fedavg_digits_time_to_90pct_s`` bench is mostly compile). The knob is
+    process-global (``jax.config``), so the first engine wins; failures are
+    never fatal — a run without the cache is just slower."""
+    path = getattr(args, "compile_cache_dir", None)
+    if not path:
+        return
+    path = os.path.abspath(os.path.expanduser(str(path)))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as e:
+        logger.warning("compile_cache_dir %s ignored (%s: %s)", path,
+                       type(e).__name__, e)
+        return
+    # also cache fast-compiling programs (jax's defaults skip sub-second
+    # compiles, which would exclude every small-model test program)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # older jax: knob absent — dir alone still works
+            pass
+    try:
+        # jax decides cache-used ONCE per task; any compile before this
+        # point (data loading jits small programs) froze the verdict with
+        # no dir configured — reset so it re-evaluates with ours
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
+    logger.info("persistent XLA compilation cache at %s", path)
 
 
 def _check_extras_compat(opt, params, dp, robust_mode: bool) -> None:
@@ -97,6 +136,7 @@ class TPUSimulator:
         self.bundle = bundle
         self.opt = optimizer
         self.spec = spec
+        _maybe_enable_compile_cache(args)
         self.mesh = mesh if mesh is not None else build_mesh(
             getattr(args, "mesh_shape", None))
         self.n_devices = self.mesh.shape[AXIS_CLIENT]
@@ -170,10 +210,33 @@ class TPUSimulator:
                 "configured: the defense takes precedence and the user "
                 "aggregator is SKIPPED", self.defender.defense_type)
         _check_extras_compat(self.opt, self.params, self.dp, defended_mode)
-        # ONE dispatch per defended round: when the defense has a sharded
-        # kernel, the whole robust pipeline (train -> attack -> defense ->
-        # CDP -> server transform) fuses into a single jitted program
+        # ONE dispatch per defended round: every built-in defense now has a
+        # sharded kernel, so the whole robust pipeline (train -> attack ->
+        # defense -> CDP -> server transform) fuses into a single jitted
+        # program — contribution assessment rides the same program (the
+        # post-attack sharded matrix is an extra output; subset values are
+        # evaluated on device, only [K] scores come host-side)
+        self._true_d = int(sum(int(np.prod(l.shape)) for l in
+                               jax.tree_util.tree_leaves(self.params)))
+        self._d_pad = self._true_d + ((-self._true_d) % self.n_devices)
         self.robust_fused = self._resolve_robust_fused()
+        # defenses with cross-round state (foolsgold history, cclip
+        # momentum, slsgd prev-global, cross_round prev updates) keep it as
+        # a DEVICE-RESIDENT feature-sharded pytree: threaded through the
+        # fused multi-round scan like client_states, donated, and saved in
+        # checkpoints so crash-resume replays identical defense verdicts
+        self._defense_state = None
+        self._defense_state_specs: Dict[str, Any] = {}
+        if (self.defender.is_defense_enabled() and self._use_sharded_defense()
+                and sharded_defense.is_stateful(self.defender.defense_type)):
+            self._defense_state_specs = sharded_defense.defense_state_spec(
+                self.defender.defense_type, AXIS_CLIENT)
+            self._defense_state = jax.tree_util.tree_map(
+                lambda z, s: jax.device_put(z, NamedSharding(self.mesh, s)),
+                sharded_defense.defense_state_init(
+                    self.defender.defense_type, int(fed_dataset.num_clients),
+                    self._d_pad),
+                self._defense_state_specs)
         self._round_fn = (self._build_robust_fn() if self.robust_fused
                           else self._build_collect_fn() if self.robust_mode
                           else self._build_round_fn())
@@ -184,12 +247,53 @@ class TPUSimulator:
         self.ckpt = RoundCheckpointer(
             getattr(args, "checkpoint_dir", None),
             int(getattr(args, "checkpoint_every_rounds", 0) or 0))
+        if (self.ckpt.enabled and self.defender.is_defense_enabled()
+                and sharded_defense.is_stateful(self.defender.defense_type)
+                and self._defense_state is None):
+            # host-kernel path (sharded_defense: false): the defender's
+            # numpy state lives outside the checkpoint — a resumed run
+            # restarts it cold and can diverge from the uninterrupted one
+            logger.warning(
+                "%s keeps cross-round state, but the host-kernel path "
+                "does not checkpoint it — crash-resume restarts the "
+                "defense state cold; use the default sharded path for "
+                "checkpointed defense state", self.defender.defense_type)
         self.history: List[Dict[str, Any]] = []
 
     def _ckpt_state(self):
-        return {"params": self.params, "server_state": self.server_state,
-                "client_states": self.client_states, "rng": self.rng,
-                "dp": self.dp.state_dict()}
+        st = {"params": self.params, "server_state": self.server_state,
+              "client_states": self.client_states, "rng": self.rng,
+              "dp": self.dp.state_dict()}
+        if self._defense_state is not None:
+            # cross-round defense state (e.g. the foolsgold similarity
+            # history) must survive a crash, or a resumed run would score
+            # clients against an amnesiac history and diverge from the
+            # uninterrupted trajectory
+            st["defense_state"] = self._defense_state
+        return st
+
+    def _ckpt_latest(self):
+        """Restore the newest checkpoint, tolerating the defense-state
+        leaf's presence flipping between save and resume: a checkpoint
+        written before a stateful defense was configured (or by a version
+        without sharded stateful defenses) lacks the ``defense_state``
+        key, and orbax refuses a template with extra structure — retry
+        without the leaf rather than making a valid checkpoint unloadable
+        (the defense then resumes from its cold-start state, loudly)."""
+        template = self._ckpt_state()
+        try:
+            return self.ckpt.latest(template)
+        except Exception as e:
+            if "defense_state" not in template:
+                raise
+            logger.warning(
+                "checkpoint restore with the defense-state leaf failed "
+                "(%s: %s); retrying without it — the %s defense will "
+                "resume from cold-start state", type(e).__name__, e,
+                self.defender.defense_type)
+            template = {k: v for k, v in template.items()
+                        if k != "defense_state"}
+            return self.ckpt.latest(template)
 
     def _load_ckpt_state(self, st):
         self.params = jax.device_put(st["params"], self.repl_sharding)
@@ -199,6 +303,11 @@ class TPUSimulator:
                                             self.client_sharding)
         self.rng = jnp.asarray(st["rng"])
         self.dp.load_state_dict(st["dp"])
+        if "defense_state" in st:
+            self._defense_state = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(jnp.asarray(a),
+                                            NamedSharding(self.mesh, s)),
+                dict(st["defense_state"]), self._defense_state_specs)
 
     # ------------------------------------------------------------------
     def _make_round_core(self):
@@ -474,9 +583,10 @@ class TPUSimulator:
         [D, S, ...]) so the host can run the attack->defense pipeline on
         the full update matrix — the mesh equivalent of the reference
         ServerAggregator receiving the individual client models
-        (``fedml_aggregator.py:58-78``). Contribution assessment and user
-        ServerAggregators always take this path; sharded-capable defenses
-        take :meth:`_build_robust_fn` unless ``robust_fused`` says host."""
+        (``fedml_aggregator.py:58-78``). User ServerAggregators and
+        ``sharded_defense: false`` configs take this path; every built-in
+        defense (and contribution assessment) takes
+        :meth:`_build_robust_fn` unless ``robust_fused`` says host."""
         core = self._make_collect_core()
 
         def round_body(params, server_state, local_data, local_states,
@@ -510,28 +620,34 @@ class TPUSimulator:
         return jax.jit(shard_fn, donate_argnums=self._donate_args(3))
 
     # ------------------------------------------------------------------
-    def _make_robust_core(self):
+    def _make_robust_core(self, emit_matrix: bool = False):
         """The per-shard FUSED robust round: slot-scan training, on-device
-        model-attack injection, the feature-sharded defense, central-DP
-        noise, and the server transform — the whole defended round with no
-        host round-trip. The [D, S, ...] update stack never leaves device:
-        an ``all_to_all`` turns rows-with-all-features into all-rows-with-
+        model-attack injection, the feature-sharded defense (with its
+        cross-round state threaded in and out), central-DP noise, and the
+        server transform — the whole defended round with no host
+        round-trip. The [D, S, ...] update stack never leaves device: an
+        ``all_to_all`` turns rows-with-all-features into all-rows-with-
         a-feature-shard, landing bit-for-bit the same [K, D/n] layout (and
         attack/defense PRNG streams) as the host-dispatch sharded path in
-        :meth:`_robust_aggregate`, so the two are parity-testable."""
-        from ...core.security.defense import sharded as sharded_defense
+        :meth:`_robust_aggregate`, so the two are parity-testable.
+
+        ``emit_matrix`` additionally returns the POST-ATTACK sharded matrix
+        and the [K] weights (what the defense saw) — the contribution
+        assessor's input; off, XLA never materializes the extra output."""
         collect = self._make_collect_core()
         opt = self.opt
         dp = self.dp
         n_dev = self.n_devices
-        dfd = self.defender
+        defense_type = (self.defender.defense_type
+                        if self.defender.is_defense_enabled() else "mean")
+        hp = sharded_defense.DefenseHP.from_defender(self.defender)
         attack_type = (self.attacker.attack_type
                        if self.attacker.is_model_attack() else None)
         attack_scale = float(getattr(self.attacker, "attack_scale", 1.0))
 
         def core(params, server_state, local_data, local_states,
-                 sched_idx, sched_active, sched_work, rows, byz_mask,
-                 round_key, hyper):
+                 sched_idx, sched_active, sched_work, rows, byz_mask, ids,
+                 dstate, round_key, hyper):
             upd_stack, w_stack, states, acc_ex, acc_w, acc_m = collect(
                 params, server_state, local_data, local_states,
                 sched_idx, sched_active, sched_work, round_key, hyper)
@@ -555,11 +671,11 @@ class TPUSimulator:
                     attack_type, mat_s, byz_mask,
                     jax.random.fold_in(round_key, ATTACK_FOLD),
                     attack_scale, AXIS_CLIENT)
-            vec_s = sharded_defense.defend_shard(
-                mat_s, w, AXIS_CLIENT, dfd.defense_type,
-                byzantine_count=dfd.byzantine_count,
-                multi_k=dfd.krum_param_m,
-                trim_fraction=float(dfd.trim_fraction))
+            vec_s, new_dstate = sharded_defense.defend_shard_stateful(
+                mat_s, w, AXIS_CLIENT, defense_type, hp, state=dstate,
+                ids=ids,
+                key=jax.random.fold_in(round_key, DEFENSE_FOLD),
+                true_d=true_d)
             vec = jax.lax.all_gather(vec_s, AXIS_CLIENT, tiled=True)[:true_d]
             agg_update = vector_to_tree_like(vec, params)
             if dp.is_global_dp_enabled():
@@ -573,47 +689,64 @@ class TPUSimulator:
             new_params, new_sstate = opt.server_update(
                 params, server_state, agg_update, agg_extras,
                 hyper.round_idx)
-            return new_params, new_sstate, states, metrics
+            out = (new_params, new_sstate, states, new_dstate, metrics)
+            return out + (mat_s, w) if emit_matrix else out
 
         return core
 
     def _build_robust_fn(self):
         """ONE dispatch per defended round (vs three-plus-host-work on the
-        host-dispatch path)."""
-        core = self._make_robust_core()
+        host-dispatch path). With contribution assessment enabled the same
+        program also emits the post-attack sharded update matrix."""
+        emit = self.contribution.enabled
+        core = self._make_robust_core(emit_matrix=emit)
+        state_specs = self._defense_state_specs
 
         def round_body(params, server_state, local_data, local_states,
                        sched_idx, sched_active, sched_work, rows, byz_mask,
-                       round_key, hyper):
+                       ids, dstate, round_key, hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
-            new_params, new_sstate, states, metrics = core(
+            out = core(
                 params, server_state, sq(local_data), sq(local_states),
                 sched_idx[0], sched_active[0], sched_work[0], rows,
-                byz_mask, round_key, hyper)
+                byz_mask, ids, dstate, round_key, hyper)
+            new_params, new_sstate, states, new_dstate, metrics = out[:5]
             states = jax.tree_util.tree_map(lambda a: a[None], states)
-            return new_params, new_sstate, states, metrics
+            res = (new_params, new_sstate, states, new_dstate, metrics)
+            return res + out[5:] if emit else res
 
+        out_specs = (P(), P(), P(AXIS_CLIENT), state_specs, P())
+        if emit:
+            out_specs = out_specs + (P(None, AXIS_CLIENT), P())
         shard_fn = shard_map(
             round_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
-                      P(), P(), P(), P()),
-            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+                      P(), P(), P(), state_specs, P(), P()),
+            out_specs=out_specs,
             check_vma=False,
         )
-        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
+        # contribution assessment evaluates coalitions around the ROUND-
+        # START params after the dispatch returns, so params must not be
+        # donated then (the assessor would read a deleted buffer)
+        donate = (1, 3, 10) if emit else (0, 1, 3, 10)
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(*donate))
 
     def _build_robust_fused_fn(self):
         """R defended rounds in ONE dispatch: the robust core under an
         outer ``lax.scan``, mirroring :meth:`_build_fused_fn` — defended
         runs amortize the same ~120 ms dispatch constant (BASELINE.md §3b)
-        the undefended fused path already eliminates."""
+        the undefended fused path already eliminates. Cross-round defense
+        state rides the scan CARRY (foolsgold's round-R history feeds round
+        R+1 inside the same dispatch), sampled ids ride the xs."""
         core = self._make_robust_core()
+        state_specs = self._defense_state_specs
 
         def rounds_body(params, server_state, local_data, local_states,
                         sched_idxs, sched_actives, sched_works, rows_r,
-                        byz_r, round_keys, round_idxs, hyper):
+                        byz_r, ids_r, dstate, round_keys, round_idxs,
+                        hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             local_data = sq(local_data)
             local_states = sq(local_states)
@@ -622,67 +755,111 @@ class TPUSimulator:
             sched_works = sched_works[:, 0]
 
             def one_round(carry, xs):
-                params, server_state, states = carry
-                idx_r, act_r, work_r, rows_i, byz_i, key_r, ridx_r = xs
+                params, server_state, states, dstate = carry
+                idx_r, act_r, work_r, rows_i, byz_i, ids_i, key_r, ridx_r \
+                    = xs
                 hyper_r = hyper.replace(round_idx=ridx_r)
-                new_p, new_s, states, metrics = core(
+                new_p, new_s, states, dstate, metrics = core(
                     params, server_state, local_data, states,
-                    idx_r, act_r, work_r, rows_i, byz_i, key_r, hyper_r)
-                return (new_p, new_s, states), metrics
+                    idx_r, act_r, work_r, rows_i, byz_i, ids_i, dstate,
+                    key_r, hyper_r)
+                return (new_p, new_s, states, dstate), metrics
 
-            (params, server_state, states), metrics = jax.lax.scan(
-                one_round, (params, server_state, local_states),
+            (params, server_state, states, dstate), metrics = jax.lax.scan(
+                one_round, (params, server_state, local_states, dstate),
                 (sched_idxs, sched_actives, sched_works, rows_r, byz_r,
-                 round_keys, round_idxs))
+                 ids_r, round_keys, round_idxs))
             states = jax.tree_util.tree_map(lambda a: a[None], states)
-            return params, server_state, states, metrics  # metrics: [R]
+            return params, server_state, states, dstate, metrics  # [R]
 
         shard_fn = shard_map(
             rounds_body,
             mesh=self.mesh,
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(None, AXIS_CLIENT), P(None, AXIS_CLIENT),
-                      P(None, AXIS_CLIENT), P(), P(), P(), P(), P()),
-            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+                      P(None, AXIS_CLIENT), P(), P(), P(), state_specs,
+                      P(), P(), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), state_specs, P()),
             check_vma=False,
         )
-        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
+        return jax.jit(shard_fn,
+                       donate_argnums=self._donate_args(0, 1, 3, 10))
 
     def _resolve_robust_fused(self) -> bool:
         """``robust_fused`` knob: auto (default) fuses whenever the
-        sharded defense path applies; ``host`` keeps the 3-dispatch
+        sharded defense path applies (every built-in defense) OR the run
+        is contribution-only (no defense — the fused program aggregates
+        with the ``mean`` kernel and emits the sharded matrix for the
+        on-device assessor); ``host`` keeps the 3-dispatch
         host-orchestrated pipeline; ``fused`` demands fusion and refuses
-        configs that cannot fuse (contribution assessment, user
-        ServerAggregators, defenses without a sharded kernel)."""
+        configs that cannot fuse (user ServerAggregators,
+        ``sharded_defense: false``)."""
         pref = str(getattr(self.args, "robust_fused", "auto")
                    or "auto").lower()
         if pref in ("false", "0", "no", "host"):
+            if self.robust_mode:
+                self._log_host_path("robust_fused: %r" % pref)
             return False
-        ok = self.robust_mode and self._use_sharded_defense()
+        ok = self.robust_mode and (self._use_sharded_defense()
+                                   or self._fusable_without_defense())
         if pref in ("true", "1", "yes", "fused") and self.robust_mode \
                 and not ok:
             raise ValueError(
                 "robust_fused: this config cannot fuse the robust round "
-                "(it needs a sharded-capable defense and no contribution "
-                "assessment / user ServerAggregator); use robust_fused: "
-                "auto or host")
+                "(it needs the sharded defense path — no user "
+                "ServerAggregator, sharded_defense not forced off); use "
+                "robust_fused: auto or host")
         return ok
+
+    def _fusable_without_defense(self) -> bool:
+        """Contribution-only robust runs (no defense, no model attack, no
+        user aggregator) fuse via the ``mean`` kernel: the round is the
+        plain weighted average, plus the sharded matrix output the
+        assessor consumes."""
+        return (self.contribution.enabled
+                and not self.defender.is_defense_enabled()
+                and not self.attacker.is_model_attack()
+                and self.server_aggregator is None)
+
+    def _log_host_path(self, reason: str) -> None:
+        """Say ONCE which config knob forced the host robust path — a
+        silently-slow defended run is a support ticket, a logged one is a
+        config fix."""
+        if not getattr(self, "_host_path_logged", False):
+            self._host_path_logged = True
+            logger.info("robust rounds take the HOST-dispatch path: %s",
+                        reason)
 
     def _use_sharded_defense(self) -> bool:
         """Sharded (feature-parallel, no host materialization) defense is
-        the DEFAULT whenever the configured defense supports it; set
-        ``sharded_defense: false`` to force the host path. Contribution
-        assessment and user ServerAggregators need the full matrix, so they
-        keep the host path."""
+        the DEFAULT whenever a defense is configured — every built-in
+        defense now has a sharded kernel; set ``sharded_defense: false``
+        to force the host kernels. User ServerAggregators need the
+        host-ordered full matrix, so they keep the host path. Contribution
+        assessment no longer disqualifies the sharded path: it runs on the
+        sharded matrix the round program already emits."""
         from ...core.security.defense import sharded
+        if not self.defender.is_defense_enabled():
+            return False
         pref = str(getattr(self.args, "sharded_defense", "auto")
                    or "auto").lower()
         if pref in ("false", "0", "no", "host"):
+            self._log_host_path("sharded_defense: %r forces the host "
+                                "kernels" % pref)
             return False
-        return (self.defender.is_defense_enabled()
-                and sharded.supports_sharded(self.defender.defense_type)
-                and self.server_aggregator is None
-                and not self.contribution.enabled)
+        if not sharded.supports_sharded(self.defender.defense_type):
+            # unreachable for today's DEFENSE_TYPES (all sharded) — kept
+            # for defenses added without a sharded kernel
+            self._log_host_path(
+                "defense_type %r has no sharded kernel (sharded: %s)"
+                % (self.defender.defense_type,
+                   sharded.sharded_defense_names()))
+            return False
+        if self.server_aggregator is not None:
+            self._log_host_path("a user ServerAggregator consumes the "
+                                "host-ordered update matrix")
+            return False
+        return True
 
     def _robust_rows(self, sampled, n_slots: int):
         """Map sampled client ids onto the device-major [D*S] update grid:
@@ -748,16 +925,31 @@ class TPUSimulator:
             byz_mask = (jnp.asarray(self.attacker.byzantine_mask(ids),
                                     jnp.float32)
                         if attack_type else None)
-            vec = sharded.defend_matrix_sharded(
+            stateful = self._defense_state is not None
+            out = sharded.defend_matrix_sharded(
                 self.mesh, AXIS_CLIENT, mat, w,
                 self.defender.defense_type,
-                byzantine_count=self.defender.byzantine_count,
-                multi_k=self.defender.krum_param_m,
-                trim_fraction=self.defender.trim_fraction,
+                hp=sharded.DefenseHP.from_defender(self.defender),
                 attack_type=attack_type,
                 attack_scale=getattr(self.attacker, "attack_scale", 1.0),
                 byz_mask=byz_mask,
-                attack_key=jax.random.fold_in(round_key, ATTACK_FOLD))
+                attack_key=jax.random.fold_in(round_key, ATTACK_FOLD),
+                defense_key=jax.random.fold_in(round_key, DEFENSE_FOLD),
+                state=self._defense_state,
+                ids=jnp.asarray(ids, jnp.int32),
+                return_matrix=self.contribution.enabled)
+            if not isinstance(out, tuple):
+                out = (out,)
+            vec = out[0]
+            if stateful:
+                self._defense_state = out[1]
+            if self.contribution.enabled:
+                # the assessor must see the POST-ATTACK matrix the defense
+                # saw, still feature-sharded — scores come from the same
+                # on-device kernel as the fused path (self.params is still
+                # the round-start model here: _server_update runs later)
+                self._assess_contribution_fused(out[-1], w, sampled,
+                                                round_idx, self.params)
             agg = vector_to_tree_like(vec[:true_d], self.params)
             if self.dp.is_global_dp_enabled():
                 agg = self.dp.add_global_noise(
@@ -790,6 +982,65 @@ class TPUSimulator:
             agg = self.dp.add_global_noise(
                 agg, jax.random.fold_in(round_key, DP_CDP_FOLD))
         return agg
+
+    def _assess_contribution_fused(self, mat, w, sampled, round_idx,
+                                   params):
+        """LOO / GTG-Shapley on the FEATURE-SHARDED update matrix: the
+        subset-value kernel does the masked weighted average on the shards,
+        gathers only the [D] candidate vector (model-sized, same as the
+        params the eval needs anyway), and evaluates on a held-out eval set
+        SHARDED over the device axis — one jitted program per coalition
+        query, only the final [K] scores cross to the host. This is what
+        lets ``contribution.enabled`` ride the fused robust round instead
+        of forcing the 3-dispatch host path. ``params`` must be the
+        ROUND-START model (host-path semantics: coalition values measure
+        what subsets of this round's updates would have produced), which
+        is why the contribution-enabled robust program does not donate its
+        params input."""
+        if not hasattr(self, "_contrib_value_fn"):
+            spec = self.spec
+            true_d = self._true_d
+            test = self.fed.test
+            nb = int(test["x"].shape[0])
+            pad = (-nb) % self.n_devices
+
+            def shard_batches(a):
+                a = jnp.asarray(a)
+                if pad:  # padded batches carry mask 0: they count nothing
+                    a = jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+                return jax.device_put(
+                    a, NamedSharding(self.mesh, P(AXIS_CLIENT)))
+
+            self._contrib_test = tuple(
+                shard_batches(test[k]) for k in ("x", "y", "mask"))
+
+            def value_body(params, mat_s, weights, mask, x_s, y_s, m_s):
+                wm = weights * mask
+                denom = jnp.maximum(jnp.sum(wm), 1e-12)
+                vec_s = jnp.einsum("k,kd->d", wm / denom, mat_s)
+                vec = jax.lax.all_gather(vec_s, AXIS_CLIENT,
+                                         tiled=True)[:true_d]
+                cand = jax.tree_util.tree_map(
+                    jnp.add, params, vector_to_tree_like(vec, params))
+                stats = evaluate(spec, cand, x_s, y_s, m_s)
+                stats = {k: jax.lax.psum(v, AXIS_CLIENT)
+                         for k, v in stats.items()}
+                return stats["correct"] / jnp.maximum(stats["count"], 1.0)
+
+            self._contrib_value_fn = jax.jit(shard_map(
+                value_body, mesh=self.mesh,
+                in_specs=(P(), P(None, AXIS_CLIENT), P(), P(),
+                          P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT)),
+                out_specs=P(),
+                check_vma=False,
+            ))
+        tx, ty, tm = self._contrib_test
+        w32 = jnp.asarray(w, jnp.float32)
+        vfn = lambda mask: float(self._contrib_value_fn(
+            params, mat, w32, jnp.asarray(mask, jnp.float32), tx, ty, tm))
+        self.contribution.assess_values(vfn, len(sampled),
+                                        client_ids=list(sampled),
+                                        round_idx=round_idx)
 
     def _assess_contribution(self, mat, w, sampled, round_idx):
         """Shapley/LOO over the flattened update matrix — the subset-value
@@ -855,6 +1106,11 @@ class TPUSimulator:
             mean_real = float(np.mean(np.sum(
                 np.any(real_batches > 0, axis=-1), axis=-1)))
             steps = n_sampled * int(hyper.epochs) * mean_real
+            # chaos: dropped clients run zero steps, stragglers a fraction
+            # — scale by the plan's mean work fraction or MFU under
+            # injection would count never-executed steps as useful work
+            if self.chaos.injects_availability:
+                steps *= self.chaos.expected_work_fraction
             return per_batch * steps
         except Exception as e:
             # never crash a bench over cost analysis — but a silent 0.0
@@ -878,12 +1134,27 @@ class TPUSimulator:
         hyper_r = hyper.replace(round_idx=jnp.int32(round_idx))
         if self.robust_fused:
             rows, byz = self._robust_rows(sampled, int(idx.shape[1]))
-            (self.params, self.server_state, self.client_states,
-             metrics) = self._traced(
+            dstate = (self._defense_state if self._defense_state is not None
+                      else {})
+            prev_params = self.params  # round-START params: the assessor's
+            # reference point (not donated when contribution is enabled)
+            out = self._traced(
                 "robust_round_fused", 1, self._round_fn,
                 self.params, self.server_state, self.train_data,
                 self.client_states, idx, active, work, jnp.asarray(rows),
-                jnp.asarray(byz), round_key, hyper_r)
+                jnp.asarray(byz), jnp.asarray(sampled, jnp.int32), dstate,
+                round_key, hyper_r)
+            (self.params, self.server_state, self.client_states,
+             new_dstate, metrics) = out[:5]
+            if self._defense_state is not None:
+                self._defense_state = new_dstate
+            if self.contribution.enabled:
+                # the same dispatch emitted the post-attack sharded matrix;
+                # coalition values apply subsets of THIS round's updates to
+                # the round-start params (host-path semantics); only the
+                # [K] scores come host-side
+                self._assess_contribution_fused(out[5], out[6], sampled,
+                                                round_idx, prev_params)
             self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
             return metrics
         if self.robust_mode:
@@ -967,14 +1238,18 @@ class TPUSimulator:
         """Run ``n_rounds`` rounds as ONE device dispatch (schedules and
         round keys precomputed host-side, stacked, scanned on-device).
         Returns the per-round metrics list. Robust mode fuses too when the
-        sharded defense path applies (``robust_fused``); only host-bound
-        robust configs (contribution assessment, user ServerAggregators,
-        host-only defenses) fall back to the per-round path."""
-        if n_rounds == 1 or (self.robust_mode and not self.robust_fused):
+        sharded defense path applies (``robust_fused``); host-bound robust
+        configs (user ServerAggregators, ``sharded_defense: false``) fall
+        back to the per-round path. Contribution-enabled runs stay
+        per-round as well — each round is still ONE fused dispatch, but the
+        assessor needs that round's update matrix (and issues its own
+        coalition-eval dispatches) before the next round runs."""
+        if n_rounds == 1 or (self.robust_mode and not self.robust_fused) \
+                or (self.robust_fused and self.contribution.enabled):
             return [self.run_round(start_round + i, hyper)
                     for i in range(n_rounds)]
-        idxs, acts, works, keys, ridxs, rows_r, byz_r = ([], [], [], [], [],
-                                                         [], [])
+        idxs, acts, works, keys, ridxs, rows_r, byz_r, ids_r = (
+            [], [], [], [], [], [], [], [])
         # every round pads to the simulator-canonical width (padded slots
         # carry active=0 and are masked in the round body): build_schedule
         # buckets slot counts per round (powers of two), and a per-block
@@ -995,6 +1270,7 @@ class TPUSimulator:
                 rows, byz = self._robust_rows(sampled, width)
                 rows_r.append(rows)
                 byz_r.append(byz)
+                ids_r.append(np.asarray(sampled, np.int32))
             part += len(sampled) / max(self.fed.num_clients, 1)
         sched_sharding = NamedSharding(self.mesh, P(None, AXIS_CLIENT))
         idxs = jax.device_put(jnp.stack([jnp.asarray(i) for i in idxs],
@@ -1009,14 +1285,19 @@ class TPUSimulator:
         if self.robust_fused:
             if not hasattr(self, "_robust_fused_fn"):
                 self._robust_fused_fn = self._build_robust_fused_fn()
+            dstate = (self._defense_state if self._defense_state is not None
+                      else {})
             (self.params, self.server_state, self.client_states,
-             metrics) = self._traced(
+             new_dstate, metrics) = self._traced(
                 "robust_rounds_fused", n_rounds, self._robust_fused_fn,
                 self.params, self.server_state, self.train_data,
                 self.client_states, idxs, acts, works,
                 jnp.stack([jnp.asarray(r) for r in rows_r]),
                 jnp.stack([jnp.asarray(b) for b in byz_r]),
-                keys, ridxs, hyper_0)
+                jnp.stack([jnp.asarray(i) for i in ids_r]),
+                dstate, keys, ridxs, hyper_0)
+            if self._defense_state is not None:
+                self._defense_state = new_dstate
         else:
             if not hasattr(self, "_fused_fn"):
                 self._fused_fn = self._build_fused_fn()
@@ -1038,7 +1319,7 @@ class TPUSimulator:
                            epochs=int(args.epochs))
         t0 = time.time()
         start_round = 0
-        restored = self.ckpt.latest(self._ckpt_state())
+        restored = self._ckpt_latest()
         if restored is not None:
             step, st = restored
             self._load_ckpt_state(st)

@@ -38,3 +38,42 @@ def test_gtg_shapley_ranks_clients():
     v_n = float(eval_fn({"w": jnp.zeros((1,)) + (1.0 + 0.9 - 2.0) / 3.0}))
     v_0 = float(eval_fn({"w": jnp.zeros((1,))}))
     assert abs(vals.sum() - (v_n - v_0)) < 1e-4
+
+
+def test_value_fn_drivers_match_pytree_api():
+    """The v(mask)-callable drivers (what the fused TPU path feeds with
+    its sharded subset-evaluation kernel) must produce the SAME scores as
+    the stacked-pytree API — they are the same algorithm, the callable
+    just hides where the coalition value is computed."""
+    from fedml_tpu.core.contribution import (gtg_shapley_values,
+                                             leave_one_out_values)
+    params, updates, weights, eval_fn = make_problem()
+
+    def vfn(mask):
+        w = weights * mask
+        denom = jnp.maximum(jnp.sum(w), 1e-12)
+        agg = jnp.sum(updates["w"] * (w / denom)[:, None], axis=0)
+        return float(eval_fn({"w": params["w"] + agg}))
+
+    loo_a = leave_one_out(params, updates, weights, eval_fn)
+    loo_b = leave_one_out_values(vfn, 3)
+    np.testing.assert_allclose(loo_a, loo_b, atol=1e-6)
+    gtg_a = gtg_shapley(params, updates, weights, eval_fn, max_perms=10,
+                        truncation_eps=0.0, convergence_eps=1e-6)
+    gtg_b = gtg_shapley_values(vfn, 3, max_perms=10, truncation_eps=0.0,
+                               convergence_eps=1e-6)
+    np.testing.assert_allclose(gtg_a, gtg_b, atol=1e-6)
+
+
+def test_manager_assess_values_records_history():
+    from fedml_tpu.core.contribution import ContributionAssessorManager
+    from fedml_tpu.arguments import Arguments
+    mgr = ContributionAssessorManager(
+        Arguments(contribution_method="loo"))
+    assert mgr.enabled
+    vals = mgr.assess_values(lambda mask: float(jnp.sum(mask)), 4,
+                             client_ids=[7, 8, 9, 10], round_idx=2)
+    # v is additive in the mask: every LOO marginal is exactly 1
+    np.testing.assert_allclose(vals, np.ones(4), atol=1e-6)
+    assert mgr.history[0]["round"] == 2
+    assert mgr.history[0]["client_ids"] == [7, 8, 9, 10]

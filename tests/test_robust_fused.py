@@ -120,19 +120,238 @@ class TestFusedRobustParity:
 
     def test_robust_fused_refuses_unfusable_config(self):
         """robust_fused: fused must refuse (not silently degrade) configs
-        that cannot fuse — here a host-only defense."""
-        args = sim_args(enable_defense=True, defense_type="foolsgold",
-                        robust_fused="fused")
+        that cannot fuse — here the sharded path is forced off."""
+        args = sim_args(enable_defense=True, defense_type="multi_krum",
+                        sharded_defense="false", robust_fused="fused")
         with pytest.raises(ValueError, match="robust_fused"):
             build_sim(args)
 
-    def test_host_only_robust_configs_fall_back(self):
-        """Contribution assessment needs the full matrix on the host —
-        auto must fall back to the collect path, not crash."""
+    def test_host_only_robust_configs_fall_back(self, caplog):
+        """sharded_defense: false keeps the host kernels — auto must fall
+        back to the collect path (not crash) and say WHICH knob forced
+        the host path, exactly once."""
+        args = sim_args(enable_defense=True, defense_type="multi_krum",
+                        sharded_defense="false")
+        with caplog.at_level(logging.INFO,
+                             logger="fedml_tpu.simulation.tpu.engine"):
+            sim = build_sim(args)
+            assert sim.robust_mode and not sim.robust_fused
+            sim.run_round(0, hyper_for(args))
+            sim.run_round(1, hyper_for(args))
+        host_logs = [r for r in caplog.records
+                     if "HOST-dispatch path" in r.getMessage()]
+        assert len(host_logs) == 1
+        assert "sharded_defense" in host_logs[0].getMessage()
+
+
+class TestNewFusedDefenses:
+    """ISSUE 4: bulyan / RFA / foolsgold (and the other former host-only
+    defenses) fuse — the single-dispatch program must match the
+    host-dispatch path client-for-client, stateful history included."""
+
+    def _parity(self, **kw):
+        r_fused = fedml_tpu.run_simulation(backend="tpu",
+                                           args=sim_args(**kw))
+        r_host = fedml_tpu.run_simulation(
+            backend="tpu", args=sim_args(robust_fused="host", **kw))
+        assert_params_close(r_fused["params"], r_host["params"])
+        return r_fused, r_host
+
+    @pytest.mark.parametrize("defense", ["bulyan", "rfa", "foolsgold"])
+    def test_defense_parity_under_attack(self, defense):
+        """Same seeds, same verdicts: fused == host client-for-client for
+        the defenses PR 2 left on the host path, with a byzantine-flip
+        attack in the loop (the regime these defenses exist for)."""
+        self._parity(enable_defense=True, defense_type=defense,
+                     byzantine_client_num=2, **ATTACK_KW)
+
+    @pytest.mark.parametrize("defense", ["cclip", "cross_round", "slsgd"])
+    def test_stateful_defense_parity(self, defense):
+        """Cross-round device state (cclip momentum, cross_round previous
+        updates, slsgd prev-global) must evolve identically on both
+        paths across a multi-round run."""
+        self._parity(enable_defense=True, defense_type=defense,
+                     comm_round=4)
+
+    def test_fused_selected_for_all_builtin_defenses(self):
+        """Every defense in DEFENSE_TYPES now takes the fused path under
+        robust_fused: auto — the host fallback is gone for built-ins."""
+        from fedml_tpu.core.security.defense import DEFENSE_TYPES
+        for d in DEFENSE_TYPES:
+            sim = build_sim(sim_args(enable_defense=True, defense_type=d))
+            assert sim.robust_fused, d
+
+    def test_foolsgold_downweights_sybils_on_device(self):
+        """Semantics, not just parity: two colluding clients pushing the
+        same poisoned direction every round must end up down-weighted
+        versus the honest majority (the history accumulates on device)."""
+        args = sim_args(enable_defense=True, defense_type="foolsgold",
+                        enable_attack=True, attack_type="byzantine_flip",
+                        byzantine_client_num=2, attack_scale=5.0)
+        sim = build_sim(args)
+        assert sim.robust_fused and sim._defense_state is not None
+        hyper = hyper_for(args)
+        for r in range(3):
+            sim.run_round(r, hyper)
+        hist = np.asarray(sim._defense_state["history"])
+        assert np.abs(hist).sum() > 0  # accumulated, not amnesiac
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree_util.tree_leaves(sim.params))
+
+
+class TestFoolsgoldCheckpoint:
+    """The foolsgold history is engine state now — it must ride
+    RoundCheckpointer saves so crash-resume replays identical weights."""
+
+    def test_defense_state_in_ckpt_state(self):
         args = sim_args(enable_defense=True, defense_type="foolsgold")
         sim = build_sim(args)
-        assert sim.robust_mode and not sim.robust_fused
+        st = sim._ckpt_state()
+        assert "defense_state" in st and "history" in st["defense_state"]
         sim.run_round(0, hyper_for(args))
+        assert np.abs(np.asarray(sim._defense_state["history"])).sum() > 0
+
+    def test_foolsgold_history_checkpoint_roundtrip(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        args = sim_args(enable_defense=True, defense_type="foolsgold",
+                        checkpoint_dir=str(tmp_path / "ckpt"),
+                        checkpoint_every_rounds=2, comm_round=4)
+        fedml_tpu.run_simulation(backend="tpu", args=args)
+        args2 = sim_args(enable_defense=True, defense_type="foolsgold",
+                         checkpoint_dir=str(tmp_path / "ckpt"),
+                         checkpoint_every_rounds=2, comm_round=4)
+        sim = build_sim(args2)
+        restored = sim.ckpt.latest(sim._ckpt_state())
+        assert restored is not None and restored[0] == 3
+        assert "defense_state" in restored[1]
+        hist = np.asarray(restored[1]["defense_state"]["history"])
+        assert np.abs(hist).sum() > 0  # the history came back, not zeros
+        sim._load_ckpt_state(restored[1])
+        sim.run_round(4, hyper_for(args2))  # donation-safe after restore
+
+    def test_restore_tolerates_missing_defense_state_leaf(self, tmp_path):
+        """A checkpoint written WITHOUT a stateful defense (no
+        defense_state leaf) must stay loadable when foolsgold is enabled
+        on resume: the engine retries without the leaf (cold-start
+        history) instead of making the checkpoint unreadable."""
+        pytest.importorskip("orbax.checkpoint")
+        kw = dict(checkpoint_dir=str(tmp_path / "ckpt"),
+                  checkpoint_every_rounds=2)
+        fedml_tpu.run_simulation(backend="tpu",
+                                 args=sim_args(comm_round=4, **kw))
+        r = fedml_tpu.run_simulation(
+            backend="tpu", args=sim_args(comm_round=6, enable_defense=True,
+                                         defense_type="foolsgold", **kw))
+        assert r["final_test_acc"] is not None
+
+    def test_foolsgold_crash_resume_matches_uninterrupted(self, tmp_path):
+        """Crash at round 3 (after its checkpoint flushes) + resume must
+        land on the SAME params as the uninterrupted run — which can only
+        happen if the resumed run restores the similarity history (an
+        amnesiac history re-pardons the sybils and diverges)."""
+        pytest.importorskip("orbax.checkpoint")
+        from fedml_tpu.core.chaos import ChaosCrash
+        kw = dict(enable_defense=True, defense_type="foolsgold",
+                  enable_attack=True, attack_type="byzantine_flip",
+                  byzantine_client_num=2, attack_scale=5.0,
+                  comm_round=6, checkpoint_every_rounds=2, random_seed=9)
+        full = fedml_tpu.run_simulation(
+            backend="tpu",
+            args=sim_args(checkpoint_dir=str(tmp_path / "full"), **kw))
+        with pytest.raises(ChaosCrash):
+            fedml_tpu.run_simulation(
+                backend="tpu",
+                args=sim_args(checkpoint_dir=str(tmp_path / "crash"),
+                              chaos_crash_at_round=3, **kw))
+        resumed = fedml_tpu.run_simulation(
+            backend="tpu",
+            args=sim_args(checkpoint_dir=str(tmp_path / "crash"),
+                          chaos_crash_at_round=3, **kw))
+        assert_params_close(full["params"], resumed["params"])
+
+
+class TestContributionFusion:
+    """contribution.enabled no longer disqualifies fusion: the round stays
+    ONE dispatch (the program emits the post-attack sharded matrix), the
+    subset values are evaluated on device, only [K] scores come host."""
+
+    def test_contribution_with_defense_stays_fused_single_dispatch(self):
+        args = sim_args(contribution_method="loo", **DEFENSE_KW)
+        sim = build_sim(args)
+        assert sim.contribution.enabled and sim.robust_fused
+        sim.run_round(0, hyper_for(args))
+        assert sim.dispatch_stats["dispatches"] == 1  # the round itself
+        rec = sim.contribution.history[0]
+        assert len(rec["contributions"]) == 8
+        assert np.isfinite(rec["contributions"]).all()
+
+    def test_contribution_only_run_fuses_with_mean_kernel(self):
+        """No defense configured: the fused program aggregates with the
+        mean kernel and still feeds the assessor; blocks fall back to
+        per-round dispatches (the assessor needs each round's matrix)."""
+        args = sim_args(contribution_method="loo")
+        sim = build_sim(args)
+        assert sim.robust_mode and sim.robust_fused
+        sim.run_rounds_fused(0, 2, hyper_for(args))
+        assert len(sim.contribution.history) == 2
+        assert sim.dispatch_stats["dispatches"] == 2  # one per round
+
+    def test_contribution_params_parity_fused_vs_host(self):
+        """The fused contribution path must not perturb training: params
+        match the host-fallback path (collect + host assessment) exactly,
+        and both paths rank the same clients."""
+        kw = dict(contribution_method="loo", comm_round=2, **DEFENSE_KW)
+        r_fused = fedml_tpu.run_simulation(backend="tpu",
+                                           args=sim_args(**kw))
+        r_host = fedml_tpu.run_simulation(
+            backend="tpu", args=sim_args(robust_fused="host",
+                                         sharded_defense="false", **kw))
+        assert_params_close(r_fused["params"], r_host["params"])
+
+    def test_contribution_values_match_host_fallback(self):
+        """Coalition values are computed around the ROUND-START params.
+        The fused scores must match the pre-ISSUE-4 host fallback's scores
+        — assessing around the post-round params (the round's aggregate
+        applied twice) would silently skew every LOO/Shapley value."""
+        kw = dict(contribution_method="loo", **DEFENSE_KW)
+        sim_f = build_sim(sim_args(**kw))
+        sim_h = build_sim(sim_args(robust_fused="host",
+                                   sharded_defense="false", **kw))
+        assert sim_f.robust_fused and not sim_h.robust_fused
+        hyper = hyper_for(sim_args(**kw))
+        sim_f.run_round(0, hyper)
+        sim_h.run_round(0, hyper)
+        cf = np.asarray(sim_f.contribution.history[0]["contributions"])
+        ch = np.asarray(sim_h.contribution.history[0]["contributions"])
+        np.testing.assert_allclose(cf, ch, atol=1e-5)
+
+    def test_gtg_shapley_rides_fused_path(self):
+        args = sim_args(contribution_method="gtg_shapley",
+                        shapley_max_perms=4, **DEFENSE_KW)
+        sim = build_sim(args)
+        assert sim.robust_fused
+        sim.run_round(0, hyper_for(args))
+        assert len(sim.contribution.history[0]["contributions"]) == 8
+
+
+class TestCompileCache:
+    def test_compile_cache_dir_knob_wires_jax_config(self, tmp_path):
+        """Opt-in persistent compilation cache: the knob must land in
+        jax.config and create the directory; absent knob changes nothing."""
+        cache = tmp_path / "xla-cache"
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            args = sim_args(compile_cache_dir=str(cache))
+            sim = build_sim(args)
+            assert jax.config.jax_compilation_cache_dir == str(cache)
+            assert cache.is_dir()
+            sim.run_round(0, hyper_for(args))  # compiles go through cache
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    def test_compile_cache_off_by_default(self):
+        args = sim_args()
+        assert getattr(args, "compile_cache_dir", None) is None
 
 
 class TestDonation:
@@ -221,6 +440,27 @@ class TestCompileStability:
         sim.run_rounds_fused(4, 4, hyper)
         sim.run_rounds_fused(8, 4, hyper)
         assert xla_compile_counter.delta() == 0
+
+    @pytest.mark.parametrize("defense", ["bulyan", "rfa", "foolsgold"])
+    def test_new_defense_8round_block_compiles_once(
+            self, defense, xla_compile_counter):
+        """ISSUE 4 acceptance pin: an 8-round fused block with each newly
+        fused defense compiles exactly ONE program (the compile counter
+        reads 1), and later blocks add zero compiles — stateful history
+        threading must not break the canonical-width invariant."""
+        args = sim_args(client_num_in_total=16, client_num_per_round=8,
+                        enable_defense=True, defense_type=defense,
+                        byzantine_client_num=2)
+        sim = build_sim(args)
+        assert sim.robust_fused
+        hyper = hyper_for(args)
+        sim.run_rounds_fused(0, 8, hyper)
+        assert sim.dispatch_stats["dispatches"] == 1
+        assert sim.dispatch_stats["compiles"] == 1
+        xla_compile_counter.reset()
+        sim.run_rounds_fused(8, 8, hyper)
+        assert xla_compile_counter.delta() == 0
+        assert sim.dispatch_stats["compiles"] == 1  # still 1: no recompile
 
     def test_digits_8round_fused_compile_count_pinned(
             self, xla_compile_counter):

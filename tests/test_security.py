@@ -226,6 +226,17 @@ class TestShardedDefense:
             "median": lambda: robust_agg.coordinate_median(mat, w)[0],
             "trimmed_mean": lambda: robust_agg.trimmed_mean(mat, w, 0.1)[0],
             "three_sigma": lambda: robust_agg.three_sigma(mat, w)[0],
+            # ISSUE 4: the formerly host-only stateless defenses
+            "bulyan": lambda: robust_agg.bulyan(mat, w, 2)[0],
+            "rfa": lambda: robust_agg.geometric_median(mat, w)[0],
+            "norm_clip": lambda: robust_agg.norm_clip(mat, w, 5.0)[0],
+            "outlier_detection":
+                lambda: robust_agg.outlier_detection(mat, w)[0],
+            "residual_reweight":
+                lambda: robust_agg.residual_reweight(mat, w)[0],
+            "rlr": lambda: robust_agg.robust_learning_rate(mat, w)[0],
+            "wbc": lambda: robust_agg.wbc(mat, w)[0],
+            "soteria": lambda: robust_agg.soteria(mat, w, 0.5)[0],
         }
         for d, host_fn in cases.items():
             out = sharded.defend_matrix_sharded(
@@ -237,6 +248,68 @@ class TestShardedDefense:
                                            np.asarray(host_fn()),
                                            rtol=2e-4, atol=2e-5,
                                            err_msg=d)
+
+    def test_sharded_stateful_matches_host_across_rounds(self):
+        """FoolsGold / cclip / slsgd / cross_round carry cross-round state
+        — the sharded kernels must reproduce the host kernels' trajectory
+        over several rounds, state threading included."""
+        from fedml_tpu.core.mesh import build_mesh
+        from fedml_tpu.core.security.defense import robust_agg, sharded
+        mesh = build_mesh({"client": 8})
+        rs = np.random.RandomState(7)
+        w = jnp.ones(6)
+        ids = jnp.arange(6, dtype=jnp.int32)
+        mats = [jnp.asarray(rs.randn(6, 50).astype(np.float32))
+                for _ in range(3)]
+
+        # foolsgold: accumulated history drives the weights
+        hist = np.zeros((6, 50), np.float32)
+        state = None
+        for m in mats:
+            hist[np.arange(6)] += np.asarray(m)
+            host_vec, _ = robust_agg.foolsgold(m, w, jnp.asarray(hist))
+            out, state = sharded.defend_matrix_sharded(
+                mesh, "client", m, w, "foolsgold", state=state, ids=ids)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(host_vec),
+                                       rtol=2e-4, atol=2e-5)
+
+        # cclip: momentum carries
+        mom, state = None, None
+        for m in mats:
+            host_vec, _ = robust_agg.centered_clip(m, w, 10.0, momentum=mom)
+            mom = host_vec
+            out, state = sharded.defend_matrix_sharded(
+                mesh, "client", m, w, "cclip", state=state)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(host_vec),
+                                       rtol=2e-4, atol=2e-5)
+
+        # cross_round: an oscillating client is dropped in round 2
+        state = None
+        m1 = mats[0]
+        _, state = sharded.defend_matrix_sharded(
+            mesh, "client", m1, w, "cross_round", state=state, ids=ids)
+        m2 = m1.at[0].set(-m1[0])
+        host_v2, info = robust_agg.cross_round_filter(
+            m2, w, m1, jnp.ones(6))
+        assert float(info["kept"]) == 5.0
+        v2, state = sharded.defend_matrix_sharded(
+            mesh, "client", m2, w, "cross_round", state=state, ids=ids)
+        np.testing.assert_allclose(np.asarray(v2), np.asarray(host_v2),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_unknown_defense_error_lists_sharded_names(self):
+        """The no-sharded-path ValueError must NAME the supported
+        defenses, not just refuse."""
+        from fedml_tpu.core.mesh import build_mesh
+        from fedml_tpu.core.security.defense import sharded
+        mesh = build_mesh({"client": 8})
+        with pytest.raises(ValueError) as ei:
+            sharded.defend_matrix_sharded(
+                mesh, "client", jnp.ones((4, 16)), jnp.ones(4), "bogus")
+        msg = str(ei.value)
+        assert "bulyan" in msg and "rfa" in msg and "foolsgold" in msg
 
     def test_engine_uses_sharded_defense(self):
         import fedml_tpu
