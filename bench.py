@@ -403,6 +403,88 @@ def bench_chaos_dropout(target_acc=0.90, max_rounds=80):
     }), flush=True)
 
 
+def bench_chaos_selection(target_acc=0.90, max_rounds=80):
+    """Participant-selection axis (core/selection, ISSUE 5): digits
+    FedAvg+LR with PARTIAL participation (5 of 10 clients per round)
+    under the chaos bench's seeded 20% dropout + 10% stragglers —
+    ``uniform`` (the static default: fixed cohort size, blind draw) vs
+    ``oort`` (loss-utility cohorts) and ``reputation``, both with
+    adaptive over-sampling from the OBSERVED Beta-posterior dropout rate
+    in place of the static ``chaos_over_sample`` knob. Same 90% digits
+    target as the other chaos leg; a selection strategy must strictly
+    beat uniform rounds-to-target for the subsystem to earn its keep."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    def leg(strategy: str):
+        extra = {}
+        if strategy != "uniform":
+            extra = dict(client_selection=strategy,
+                         selection_adaptive_oversample=True,
+                         selection_max_over_sample=1.0)
+        args = Arguments(
+            dataset="digits", model="lr", client_num_in_total=10,
+            client_num_per_round=5, comm_round=max_rounds, epochs=1,
+            batch_size=32, learning_rate=0.1, frequency_of_the_test=10_000,
+            random_seed=0, chaos_dropout_prob=0.2,
+            chaos_straggler_prob=0.1, chaos_straggler_work=0.5,
+            chaos_seed=7, chaos_tolerance=True, **extra)
+        fed, output_dim = load(args)
+        bundle = create(args, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        opt = create_optimizer(args, spec)
+        sim = TPUSimulator(args, fed, bundle, opt, spec)
+        hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                           epochs=1)
+        t0 = time.perf_counter()
+        hit_round, acc = None, 0.0
+        for round_idx in range(max_rounds):
+            sim.run_round(round_idx, hyper)
+            stats = sim._evaluate(sim.params, sim.fed.test["x"],
+                                  sim.fed.test["y"], sim.fed.test["mask"])
+            acc = float(stats["correct"]) / max(float(stats["count"]), 1.0)
+            if hit_round is None and acc >= target_acc:
+                hit_round = round_idx
+                break
+        return {"rounds_to_target": hit_round, "final_acc": acc,
+                "wall_s": time.perf_counter() - t0,
+                "provenance": getattr(fed, "provenance", "real")}
+
+    uni = leg("uniform")
+    oort = leg("oort")
+    rep = leg("reputation")
+    best = min((l for l in (oort, rep)
+                if l["rounds_to_target"] is not None),
+               key=lambda l: l["rounds_to_target"], default=oort)
+    print(json.dumps({
+        "metric": "fedavg_chaos_selection_rounds_to_target",
+        "value": best["rounds_to_target"],
+        "unit": f"rounds to {target_acc:.0%} digits test acc under seeded "
+                f"20% dropout + 10% stragglers (5 of 10 clients/round, "
+                f"FedAvg+LR, best selection strategy; max {max_rounds})",
+        "vs_baseline": (uni["rounds_to_target"] / max(
+                            best["rounds_to_target"], 1)
+                        if best["rounds_to_target"] is not None
+                        and uni["rounds_to_target"] is not None else None),
+        "uniform_rounds_to_target": uni["rounds_to_target"],
+        "oort_rounds_to_target": oort["rounds_to_target"],
+        "reputation_rounds_to_target": rep["rounds_to_target"],
+        "uniform_final_acc": round(uni["final_acc"], 4),
+        "oort_final_acc": round(oort["final_acc"], 4),
+        "reputation_final_acc": round(rep["final_acc"], 4),
+        "uniform_wall_s": round(uni["wall_s"], 2),
+        "oort_wall_s": round(oort["wall_s"], 2),
+        "data_provenance": uni["provenance"],
+    }), flush=True)
+
+
 def bench_engine_mfu_resnet18():
     """Engine MFU on an MXU-friendly federated CV workload (VERDICT r4
     item 2): FedAvg ResNet-18 (64..512-wide channels), 64 clients/round,
@@ -921,6 +1003,8 @@ def run():
             ("fedavg_cross_silo_wire_bytes_per_round",
              bench_cross_silo_wire),
             ("fedavg_chaos_dropout_rounds_to_target", bench_chaos_dropout),
+            ("fedavg_chaos_selection_rounds_to_target",
+             bench_chaos_selection),
             ("fedopt_shakespeare_rnn_rounds_per_hour",
              bench_shakespeare_fedopt),
             ("fedllm_lora_federated_round_s", bench_federated_lora),

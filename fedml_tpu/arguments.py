@@ -117,6 +117,33 @@ _SCHEMA: Dict[str, Any] = {
     # sample ceil(client_num_per_round * (1 + frac)) clients so that after
     # expected dropout the surviving cohort still hits the target size
     "chaos_over_sample": 0.0,
+    # selection_args — adaptive participant selection & client reputation
+    # (core/selection). Defaults are a strict no-op: uniform selection on
+    # the legacy sampling stream produces bit-identical schedules.
+    "client_selection": "uniform",   # uniform|power_of_choice|oort|reputation
+    # legacy: reference-parity per-round stream (ignores random_seed, like
+    # the reference's np.random.seed(round_idx) — but via a private
+    # RandomState, no longer clobbering the process-global RNG);
+    # seeded: default_rng((random_seed, round_idx)) — the fixed stream
+    "sampling_stream": "legacy",
+    # size the sampled cohort from the OBSERVED Beta-posterior dropout
+    # rate (ceil(k / (1 - p))) instead of the static chaos_over_sample
+    # factor; capped by selection_max_over_sample so the canonical
+    # schedule width (and the compile-once invariant) never moves
+    "selection_adaptive_oversample": False,
+    "selection_max_over_sample": 1.0,
+    "selection_loss_window": 8,      # last-K training losses per client
+    "selection_ema_alpha": 0.2,      # latency / work-fraction EMA weight
+    # reputation: a client's normalized inclusion posterior over defense
+    # verdicts (its Beta-posterior keep-rate relative to the cohort mean,
+    # in [0, 1]); clients below rep_threshold are benched as renormalized
+    # in-program dropout, never benching past min_keep_frac of the cohort
+    "selection_rep_threshold": 0.3,
+    "selection_min_keep_frac": 0.5,
+    "poc_d_factor": 2.0,             # power-of-choice candidate multiplier
+    "oort_explore_frac": 0.1,        # cohort fraction exploring new clients
+    "oort_alpha": 2.0,               # system-utility latency exponent
+    "oort_pref_latency_s": 0.0,      # 0 = observed median latency
     # cross-silo: a timed-out round aggregates only if at least
     # ceil(frac * expected) silos reported; below quorum the server keeps
     # waiting (another timeout interval) instead of averaging a sliver

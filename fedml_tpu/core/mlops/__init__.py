@@ -143,6 +143,30 @@ def log_chaos(round_idx: Optional[int] = None,
     _emit("chaos", rec)
 
 
+def log_selection(round_idx: int, strategy: str,
+                  sampled: Optional[list] = None,
+                  excluded: Optional[list] = None,
+                  target_n: Optional[int] = None,
+                  dropout_posterior: Optional[float] = None,
+                  **extra: Any) -> None:
+    """One participant-selection decision (core/selection): which clients
+    the strategy scheduled, which it benched (reputation exclusions — the
+    in-program-dropout path), the adaptive cohort target, and the pooled
+    dropout posterior that sized it."""
+    rec: Dict[str, Any] = {"round_idx": int(round_idx),
+                           "strategy": str(strategy)}
+    if sampled is not None:
+        rec["sampled"] = [int(c) for c in sampled]
+    if excluded is not None:
+        rec["excluded"] = [int(c) for c in excluded]
+    if target_n is not None:
+        rec["target_n"] = int(target_n)
+    if dropout_posterior is not None:
+        rec["dropout_posterior"] = float(dropout_posterior)
+    rec.update(extra)
+    _emit("selection", rec)
+
+
 def log_dispatch(name: str, wall_s: float, rounds: int = 1,
                  compiles: int = 0) -> None:
     """One device dispatch at the engine seam: host-side wall time of the

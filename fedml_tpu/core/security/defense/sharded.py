@@ -228,8 +228,9 @@ def _psum_row_norms(mat_s: jnp.ndarray, axis: str) -> jnp.ndarray:
 
 def _selection_weights(defense_type: str, dists: jnp.ndarray,
                        weights: jnp.ndarray, byzantine_count: int,
-                       multi_k: int) -> jnp.ndarray:
-    """[K] aggregation weights from the replicated [K, K] distance matrix."""
+                       multi_k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[K] aggregation weights from the replicated [K, K] distance matrix,
+    plus the [K] selection mask (the defense's per-client verdict)."""
     k = dists.shape[0]
     if defense_type in ("krum", "multi_krum"):
         m = 1 if defense_type == "krum" else multi_k
@@ -238,8 +239,8 @@ def _selection_weights(defense_type: str, dists: jnp.ndarray,
         scores = jnp.sum(sorted_d[:, 1:closest + 1], axis=1)
         order = jnp.argsort(scores)
         sel = jnp.zeros(k).at[order[:m]].set(1.0)
-        return sel * weights
-    return weights  # mean
+        return sel * weights, sel
+    return weights, jnp.ones(k, weights.dtype)  # mean
 
 
 def _bulyan_shard(mat_s, weights, axis, hp: DefenseHP):
@@ -258,7 +259,7 @@ def _bulyan_shard(mat_s, weights, axis, hp: DefenseHP):
     dist_to_med = jnp.abs(chosen - med[None])
     _, nearest = jax.lax.top_k(-dist_to_med.T, beta)  # [D/n, beta]
     vals = jnp.take_along_axis(chosen.T, nearest, axis=1)
-    return jnp.mean(vals, axis=1)
+    return jnp.mean(vals, axis=1), jnp.zeros(k).at[sel].set(1.0)
 
 
 def _rfa_shard(mat_s, weights, axis, hp: DefenseHP, eps: float = 1e-8):
@@ -291,7 +292,7 @@ def _three_sigma_shard(mat_s, weights, axis):
     mu = jnp.median(scores)
     sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
     keep = (scores <= mu + 3.0 * sd).astype(weights.dtype)
-    return robust_agg.weighted_mean(mat_s, weights * keep)
+    return robust_agg.weighted_mean(mat_s, weights * keep), keep
 
 
 def _norm_clip_shard(mat_s, weights, axis, hp: DefenseHP):
@@ -305,7 +306,7 @@ def _outlier_shard(mat_s, weights, axis, hp: DefenseHP):
     mu = jnp.median(norms)
     sd = 1.4826 * jnp.median(jnp.abs(norms - mu)) + 1e-12
     keep = (jnp.abs(norms - mu) <= hp.z_threshold * sd).astype(mat_s.dtype)
-    return robust_agg.weighted_mean(mat_s, weights * keep)
+    return robust_agg.weighted_mean(mat_s, weights * keep), keep
 
 
 def _residual_shard(mat_s, weights, axis, hp: DefenseHP):
@@ -314,7 +315,7 @@ def _residual_shard(mat_s, weights, axis, hp: DefenseHP):
     resid = jnp.sqrt(jax.lax.psum(part, axis))
     mad = jnp.median(jnp.abs(resid - jnp.median(resid))) + 1e-12
     conf = jnp.clip(hp.resid_lam * mad / jnp.maximum(resid, 1e-12), 0.0, 1.0)
-    return robust_agg.weighted_mean(mat_s, weights * conf)
+    return robust_agg.weighted_mean(mat_s, weights * conf), conf
 
 
 def _rlr_shard(mat_s, weights, axis, hp: DefenseHP):
@@ -350,7 +351,7 @@ def _wbc_shard(mat_s, weights, axis, hp: DefenseHP):
     assign = assign_to(c)
     majority = (jnp.sum(assign) > k / 2).astype(jnp.int32)
     keep = (assign == majority).astype(mat_s.dtype)
-    return robust_agg.weighted_mean(mat_s, weights * keep)
+    return robust_agg.weighted_mean(mat_s, weights * keep), keep
 
 
 def _soteria_shard(mat_s, weights, axis, hp: DefenseHP, true_d: int):
@@ -452,7 +453,7 @@ def _cross_round_shard(mat_s, weights, axis, hp: DefenseHP, state, ids):
                      (cos >= hp.cr_threshold).astype(mat_s.dtype), 1.0)
     new_state = {"prev": state["prev"].at[ids].set(mat_s),
                  "has": state["has"].at[ids].set(1.0)}
-    return robust_agg.weighted_mean(mat_s, weights * keep), new_state
+    return robust_agg.weighted_mean(mat_s, weights * keep), new_state, keep
 
 
 def _foolsgold_shard(mat_s, weights, axis, state, ids):
@@ -463,7 +464,7 @@ def _foolsgold_shard(mat_s, weights, axis, state, ids):
     hist_rows = state["history"][ids] + mat_s
     new_state = {"history": state["history"].at[ids].set(hist_rows)}
     wv = _foolsgold_weights_shard(hist_rows, axis)
-    return robust_agg.weighted_mean(mat_s, weights * wv), new_state
+    return robust_agg.weighted_mean(mat_s, weights * wv), new_state, wv
 
 
 # ---------------------------------------------------------------------------
@@ -480,63 +481,85 @@ def defend_shard_stateful(
     ids: Optional[jnp.ndarray] = None,
     key: Optional[jax.Array] = None,
     true_d: Optional[int] = None,
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
     """The per-shard defense kernel: [K, D/n] feature shard + replicated
     [K] weights (+ optional cross-round ``state``, sampled client ``ids``,
-    noise ``key``) -> (defended aggregate shard [D/n], new state). Pure
-    SPMD body meant to run INSIDE an existing ``shard_map`` over ``axis``
-    — this is the ONE implementation shared by
+    noise ``key``) -> (defended aggregate shard [D/n], new state,
+    [K] verdict). Pure SPMD body meant to run INSIDE an existing
+    ``shard_map`` over ``axis`` — this is the ONE implementation shared by
     :func:`defend_matrix_sharded` (host-dispatch path) and the engine's
     fused robust round program; any drift between the two would silently
-    break their client-for-client parity."""
+    break their client-for-client parity.
+
+    The **verdict** is the defense's per-client effective inclusion in
+    [0, 1] (1 = fully kept, 0 = excluded): the krum/bulyan selection mask,
+    three_sigma/outlier/wbc/cross_round keep flags, residual confidences,
+    foolsgold weights. Coordinate-wise and norm-shaping defenses (median,
+    trimmed_mean, rfa, norm_clip, soteria, weak_dp, crfl, cclip, slsgd)
+    have no per-client exclusion notion and report all-ones. It is
+    replicated and [K]-sized — free to emit — and feeds the selection
+    subsystem's reputation scores with zero extra dispatches."""
     hp = hp or DefenseHP()
     state = state if state is not None else {}
+    ones = jnp.ones(mat_s.shape[0], jnp.float32)
     d = _canon(defense_type)
     if d == "mean":
-        return robust_agg.weighted_mean(mat_s, weights), state
+        return robust_agg.weighted_mean(mat_s, weights), state, ones
     if d == "coordinate_median":
-        return robust_agg.coordinate_median(mat_s, weights)[0], state
+        return robust_agg.coordinate_median(mat_s, weights)[0], state, ones
     if d == "trimmed_mean":
         return (robust_agg.trimmed_mean(mat_s, weights,
-                                        hp.trim_fraction)[0], state)
+                                        hp.trim_fraction)[0], state, ones)
     if d == "three_sigma":
-        return _three_sigma_shard(mat_s, weights, axis), state
+        vec, keep = _three_sigma_shard(mat_s, weights, axis)
+        return vec, state, keep
     if d == "bulyan":
-        return _bulyan_shard(mat_s, weights, axis, hp), state
+        vec, sel = _bulyan_shard(mat_s, weights, axis, hp)
+        return vec, state, sel
     if d == "rfa":
-        return _rfa_shard(mat_s, weights, axis, hp), state
+        return _rfa_shard(mat_s, weights, axis, hp), state, ones
     if d == "norm_clip":
-        return _norm_clip_shard(mat_s, weights, axis, hp), state
+        return _norm_clip_shard(mat_s, weights, axis, hp), state, ones
     if d == "outlier_detection":
-        return _outlier_shard(mat_s, weights, axis, hp), state
+        vec, keep = _outlier_shard(mat_s, weights, axis, hp)
+        return vec, state, keep
     if d == "residual_reweight":
-        return _residual_shard(mat_s, weights, axis, hp), state
+        vec, conf = _residual_shard(mat_s, weights, axis, hp)
+        return vec, state, conf
     if d == "rlr":
-        return _rlr_shard(mat_s, weights, axis, hp), state
+        return _rlr_shard(mat_s, weights, axis, hp), state, ones
     if d == "wbc":
-        return _wbc_shard(mat_s, weights, axis, hp), state
+        vec, keep = _wbc_shard(mat_s, weights, axis, hp)
+        return vec, state, keep
     if d == "soteria":
         if true_d is None:
             raise ValueError("soteria's per-row quantile needs true_d "
                              "(the unpadded feature dim)")
-        return _soteria_shard(mat_s, weights, axis, hp, int(true_d)), state
+        return (_soteria_shard(mat_s, weights, axis, hp, int(true_d)),
+                state, ones)
     if d == "weak_dp":
-        return _weak_dp_shard(mat_s, weights, axis, hp, key), state
+        return _weak_dp_shard(mat_s, weights, axis, hp, key), state, ones
     if d == "crfl":
-        return _crfl_shard(mat_s, weights, axis, hp, key), state
+        return _crfl_shard(mat_s, weights, axis, hp, key), state, ones
     if d == "foolsgold":
-        return _foolsgold_shard(mat_s, weights, axis, state, ids)
+        vec, new_state, wv = _foolsgold_shard(mat_s, weights, axis, state,
+                                              ids)
+        return vec, new_state, wv
     if d == "cclip":
-        return _cclip_shard(mat_s, weights, axis, hp, state)
+        vec, new_state = _cclip_shard(mat_s, weights, axis, hp, state)
+        return vec, new_state, ones
     if d == "slsgd":
-        return _slsgd_shard(mat_s, weights, axis, hp, state)
+        vec, new_state = _slsgd_shard(mat_s, weights, axis, hp, state)
+        return vec, new_state, ones
     if d == "cross_round":
-        return _cross_round_shard(mat_s, weights, axis, hp, state, ids)
+        vec, new_state, keep = _cross_round_shard(mat_s, weights, axis, hp,
+                                                  state, ids)
+        return vec, new_state, keep
     # krum / multi_krum: selection weights from the psum'd Gram
     dists = _psum_dists(mat_s, axis)
-    sel_w = _selection_weights(d, dists, weights,
-                               hp.byzantine_count, hp.multi_k)
-    return robust_agg.weighted_mean(mat_s, sel_w), state
+    sel_w, sel = _selection_weights(d, dists, weights,
+                                    hp.byzantine_count, hp.multi_k)
+    return robust_agg.weighted_mean(mat_s, sel_w), state, sel
 
 
 def defend_shard(mat_s: jnp.ndarray, weights: jnp.ndarray, axis: str,
@@ -551,7 +574,8 @@ def defend_shard(mat_s: jnp.ndarray, weights: jnp.ndarray, axis: str,
                          "call defend_shard_stateful with a state pytree")
     hp = DefenseHP(byzantine_count=byzantine_count, multi_k=multi_k,
                    trim_fraction=trim_fraction)
-    vec, _ = defend_shard_stateful(mat_s, weights, axis, defense_type, hp)
+    vec, _, _ = defend_shard_stateful(mat_s, weights, axis, defense_type,
+                                      hp)
     return vec
 
 
@@ -577,13 +601,13 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
         if attack_type is not None:
             mat_s = _apply_attack_shard(attack_type, mat_s, byz_mask, akey,
                                         attack_scale, axis)
-        vec, new_state = defend_shard_stateful(
+        vec, new_state, verdict = defend_shard_stateful(
             mat_s, weights, axis, defense_type, hp, state=state, ids=ids,
             key=dkey, true_d=true_d)
-        out = (vec, new_state)
+        out = (vec, new_state, verdict)
         return out + (mat_s,) if return_matrix else out
 
-    out_specs = (P(axis), state_spec)
+    out_specs = (P(axis), state_spec, P())
     if return_matrix:
         out_specs = out_specs + (P(None, axis),)
     return jax.jit(shard_map(
@@ -612,6 +636,7 @@ def defend_matrix_sharded(
     ids: Optional[jnp.ndarray] = None,
     defense_key: Optional[jax.Array] = None,
     return_matrix: bool = False,
+    return_verdict: bool = False,
 ):
     """[K, D] (feature-sharded over ``axis``) -> defended aggregate [D]
     (feature-sharded). The caller owns placement; this never gathers D
@@ -625,7 +650,9 @@ def defend_matrix_sharded(
     client ``ids``, or both default to a cold start over ``K`` clients);
     with ``return_matrix=True`` the post-attack sharded matrix is appended
     (the contribution assessor's input — it must see what the defense
-    saw)."""
+    saw); with ``return_verdict=True`` the [K] per-client verdict (see
+    :func:`defend_shard_stateful`) is appended LAST — the selection
+    subsystem's reputation input."""
     if not supports_sharded(defense_type):
         raise ValueError(
             f"defense_type {defense_type!r} has no sharded kernel; host "
@@ -666,10 +693,12 @@ def defend_matrix_sharded(
     out = fn(mat, jnp.asarray(weights, jnp.float32),
              jnp.asarray(byz_mask, jnp.float32), attack_key, defense_key,
              state if stateful else {}, jnp.asarray(ids, jnp.int32))
-    vec, new_state = out[0], out[1]
+    vec, new_state, verdict = out[0], out[1], out[2]
     result = (vec[:d],)
     if stateful:
         result = result + (new_state,)
     if return_matrix:
-        result = result + (out[2],)
+        result = result + (out[3],)
+    if return_verdict:
+        result = result + (verdict,)
     return result[0] if len(result) == 1 else result

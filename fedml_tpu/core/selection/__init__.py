@@ -1,0 +1,32 @@
+"""Adaptive participant selection & client reputation.
+
+Turns the signals the framework already produces — per-round losses,
+observed work fractions and dropouts (chaos ledger), cross-silo upload
+latencies, defense exclusion verdicts — into *who trains next round*:
+
+* :class:`ClientStatsStore` — per-client EMA latency/work, Beta-posterior
+  dropout estimate, last-K losses, defense-decayed reputation; NumPy
+  state that rides :class:`~fedml_tpu.core.checkpoint.RoundCheckpointer`.
+* strategies behind the ``client_selection`` knob: ``uniform`` (default,
+  bit-identical schedules), ``power_of_choice``, ``oort``,
+  ``reputation`` (low-reputation clients become renormalized in-program
+  dropout — the byzantine-aware-dropout closer).
+* :class:`SelectionManager` — the engine/server seam: lazy device-array
+  observation queue, adaptive over-sampling from the dropout posterior.
+
+Selection is host-side policy; cohorts ride the jitted round programs
+purely as schedule DATA, so the canonical slot width and the compile-once
+invariant hold for every strategy.
+"""
+
+from .manager import SelectionManager, slot_placement
+from .stats import ClientStatsStore
+from .strategies import (SELECTION_STRATEGIES, OortSelection,
+                         PowerOfChoiceSelection, ReputationSelection,
+                         SelectionStrategy, UniformSelection, cap_bench,
+                         create_strategy)
+
+__all__ = ["ClientStatsStore", "SelectionManager", "SelectionStrategy",
+           "UniformSelection", "PowerOfChoiceSelection", "OortSelection",
+           "ReputationSelection", "SELECTION_STRATEGIES",
+           "cap_bench", "create_strategy", "slot_placement"]

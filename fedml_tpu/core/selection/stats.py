@@ -1,0 +1,211 @@
+"""Per-client observed statistics — the selection subsystem's memory.
+
+Every signal here already flows through the framework and was previously
+thrown away at the aggregation seam: per-round training losses (the round
+programs' per-slot metrics), observed work fractions and dropouts (the
+chaos ``FaultLedger`` seam), cross-silo upload latencies (the server FSM's
+broadcast→receipt clock), and defense exclusion verdicts (the robust
+pipeline's per-client weights). The store folds them into compact
+per-client state:
+
+* ``ema_latency`` / ``ema_work`` — exponential moving averages of observed
+  round latency (cross-silo) and completed work fraction (simulator).
+* a **Beta-posterior dropout estimate**: ``drop_obs`` / ``part_obs``
+  counts over a weakly-informative Beta(1, 19) prior (≈5% prior dropout),
+  so one flaky round does not brand a client and a reliable history is not
+  erased by one miss. Posterior mean = (a0+drops)/(a0+b0+obs).
+* ``losses`` — a last-K ring buffer of observed mean training losses per
+  client (Power-of-Choice ranks on the latest, Oort on the RMS).
+* ``reputation`` — a NORMALIZED inclusion posterior over defense
+  verdicts: each client's Beta-posterior probability of being kept by the
+  defense, divided by the cohort mean and clipped to [0, 1]. The
+  normalization is load-bearing — selection-style defenses (krum picks m
+  of K rows) exclude honest clients every round too, so the absolute
+  exclusion rate is meaningless; what brands a byzantine client is being
+  excluded consistently MORE than the cohort. Unobserved clients score
+  1.0 (innocent until evidence).
+
+All state is plain NumPy arrays, so ``state_dict``/``load_state_dict``
+round-trip through :class:`~fedml_tpu.core.checkpoint.RoundCheckpointer`
+(orbax ``StandardSave``) and crash-resume replays identical selections.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+# weakly-informative dropout prior: Beta(1, 19) -> 5% prior mean. Strong
+# enough that a single observed dropout doesn't spike the posterior,
+# weak enough that ~10 rounds of real behavior dominate it.
+DROP_PRIOR_A = 1.0
+DROP_PRIOR_B = 19.0
+
+
+class ClientStatsStore:
+    """Observed per-client statistics over a fixed population of ``n``
+    clients (or silo ranks). Pure host-side NumPy — observations never
+    touch the device, queries are vectorized reads."""
+
+    def __init__(self, num_clients: int, loss_window: int = 8,
+                 ema_alpha: float = 0.2,
+                 drop_prior: tuple = (DROP_PRIOR_A, DROP_PRIOR_B)):
+        n = int(num_clients)
+        if n <= 0:
+            raise ValueError("ClientStatsStore needs a positive population")
+        self.n = n
+        self.loss_window = max(int(loss_window), 1)
+        self.ema_alpha = float(ema_alpha)
+        # dropout-prior strength is a population property: cross-device
+        # cohorts see many cheap observations (keep the default heavy
+        # prior), cross-silo servers see one observation per slow round
+        # (callers pass a lighter prior so benching reacts in rounds,
+        # not epochs)
+        self.drop_prior_a = float(drop_prior[0])
+        self.drop_prior_b = float(drop_prior[1])
+        self.losses = np.zeros((n, self.loss_window), np.float32)
+        self.loss_count = np.zeros(n, np.int32)   # total losses ever seen
+        self.loss_ptr = np.zeros(n, np.int32)     # ring write cursor
+        self.ema_latency = np.zeros(n, np.float32)
+        self.has_latency = np.zeros(n, np.float32)
+        self.ema_work = np.ones(n, np.float32)
+        self.drop_obs = np.zeros(n, np.float32)   # observed dropouts
+        self.part_obs = np.zeros(n, np.float32)   # observed participations
+        self.incl_obs = np.zeros(n, np.float32)   # defense kept (verdicts)
+        self.excl_obs = np.zeros(n, np.float32)   # defense excluded
+        self.times_selected = np.zeros(n, np.int32)
+        self.last_selected = np.full(n, -1, np.int32)
+
+    # --- observations -------------------------------------------------------
+    def record_selected(self, round_idx: int, ids: Sequence[int]) -> None:
+        ids = np.asarray(list(ids), np.int32)
+        if ids.size == 0:
+            return
+        self.times_selected[ids] += 1
+        self.last_selected[ids] = int(round_idx)
+
+    def record_availability(self, client_id: int, participated: bool,
+                            work: float = 1.0) -> None:
+        """One (round, client) availability outcome: feeds the Beta
+        posterior and (for participants) the work-fraction EMA. Callers
+        must NOT report selector-forced exclusions here — a client the
+        selector itself benched is not evidence about its reliability."""
+        c = int(client_id)
+        if participated:
+            self.part_obs[c] += 1.0
+            a = self.ema_alpha
+            self.ema_work[c] = (1.0 - a) * self.ema_work[c] + a * float(work)
+        else:
+            self.drop_obs[c] += 1.0
+
+    def record_loss(self, client_id: int, loss: float) -> None:
+        c = int(client_id)
+        loss = float(loss)
+        if not np.isfinite(loss):
+            return
+        p = int(self.loss_ptr[c])
+        self.losses[c, p] = loss
+        self.loss_ptr[c] = (p + 1) % self.loss_window
+        self.loss_count[c] = self.loss_count[c] + 1
+
+    def record_latency(self, client_id: int, latency_s: float) -> None:
+        c = int(client_id)
+        lat = float(latency_s)
+        if not np.isfinite(lat) or lat < 0.0:
+            return
+        if self.has_latency[c] > 0:
+            a = self.ema_alpha
+            self.ema_latency[c] = (1.0 - a) * self.ema_latency[c] + a * lat
+        else:
+            self.ema_latency[c] = lat
+            self.has_latency[c] = 1.0
+
+    def record_verdict(self, ids: Sequence[int],
+                       verdict: Sequence[float]) -> None:
+        """One round's defense verdict ([K] effective inclusion in [0, 1],
+        1 = fully kept): accumulate inclusion/exclusion evidence. A
+        continuous verdict (foolsgold weights, residual confidences)
+        contributes fractionally to both sides."""
+        ids = np.asarray(list(ids), np.int32)
+        v = np.clip(np.asarray(list(verdict), np.float32), 0.0, 1.0)
+        if ids.size == 0 or ids.size != v.size:
+            return
+        np.add.at(self.incl_obs, ids, v)
+        np.add.at(self.excl_obs, ids, 1.0 - v)
+
+    @property
+    def reputation(self) -> np.ndarray:
+        """[n] normalized inclusion posterior in [0, 1]: the Beta(1, 1)
+        posterior mean of P(kept by the defense), divided by the cohort
+        mean over OBSERVED clients and clipped. Relative scoring is what
+        makes this robust to harsh selection-style defenses (krum keeps m
+        of K every round — absolute exclusion rates brand everyone);
+        unobserved clients score 1.0."""
+        obs = self.incl_obs + self.excl_obs
+        raw = (1.0 + self.incl_obs) / (2.0 + obs)
+        seen = obs > 0
+        if not bool(np.any(seen)):
+            return np.ones(self.n, np.float32)
+        pop = float(np.mean(raw[seen]))
+        rep = np.clip(raw / max(pop, 1e-9), 0.0, 1.0)
+        return np.where(seen, rep, 1.0).astype(np.float32)
+
+    # --- queries ------------------------------------------------------------
+    def dropout_posterior_mean(self,
+                               ids: Optional[Iterable[int]] = None
+                               ) -> np.ndarray:
+        """Per-client posterior mean dropout probability."""
+        a = self.drop_prior_a + self.drop_obs
+        b = self.drop_prior_b + self.part_obs
+        post = a / (a + b)
+        if ids is None:
+            return post
+        return post[np.asarray(list(ids), np.int32)]
+
+    def population_dropout_mean(self) -> float:
+        """POOLED posterior mean over the whole population — the adaptive
+        over-sampling signal (per-client posteriors would be noise-
+        dominated early; the pooled estimate converges in a few rounds)."""
+        a = self.drop_prior_a + float(np.sum(self.drop_obs))
+        b = self.drop_prior_b + float(np.sum(self.part_obs))
+        return float(a / (a + b))
+
+    def last_loss(self) -> np.ndarray:
+        """[n] most recently observed loss; +inf for never-observed
+        clients (Power-of-Choice treats unknown as maximally interesting —
+        exploration falls out for free)."""
+        seen = self.loss_count > 0
+        idx = (self.loss_ptr - 1) % self.loss_window
+        last = self.losses[np.arange(self.n), idx]
+        return np.where(seen, last, np.inf).astype(np.float32)
+
+    def rms_loss(self) -> np.ndarray:
+        """[n] root-mean-square of the recorded loss window (Oort's
+        statistical-utility core); NaN for never-observed clients so the
+        strategy can substitute its exploration value."""
+        k = np.minimum(self.loss_count, self.loss_window)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ms = np.sum(self.losses ** 2, axis=1) / np.maximum(k, 1)
+        return np.where(k > 0, np.sqrt(ms), np.nan).astype(np.float32)
+
+    # --- persistence --------------------------------------------------------
+    _FIELDS = ("losses", "loss_count", "loss_ptr", "ema_latency",
+               "has_latency", "ema_work", "drop_obs", "part_obs",
+               "incl_obs", "excl_obs", "times_selected", "last_selected")
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return {f: np.asarray(getattr(self, f)).copy() for f in self._FIELDS}
+
+    def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
+        for f in self._FIELDS:
+            if f not in state:
+                raise ValueError(f"selection state missing field {f!r}")
+            cur = getattr(self, f)
+            val = np.asarray(state[f], dtype=cur.dtype)
+            if val.shape != cur.shape:
+                raise ValueError(
+                    f"selection state field {f!r} has shape {val.shape}, "
+                    f"expected {cur.shape} (population or loss-window "
+                    "mismatch with the checkpoint)")
+            setattr(self, f, val.copy())

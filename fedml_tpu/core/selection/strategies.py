@@ -1,0 +1,207 @@
+"""Pluggable participant-selection strategies (``client_selection`` knob).
+
+Selection stays HOST-side by design: a strategy turns the
+:class:`~fedml_tpu.core.selection.stats.ClientStatsStore`'s observed
+history into the next round's cohort, and the cohort rides the jitted
+round programs purely as schedule DATA (indices / active mask / work
+fractions) — the compiled programs never change shape, so the canonical
+slot width and the compile-once invariant hold for every strategy.
+
+Strategies:
+
+* ``uniform`` — the reference's per-round draw, bit-identical to the
+  pre-selection schedules at default knobs (it delegates to
+  :func:`~fedml_tpu.simulation.sampling.client_sampling` on the same
+  stream).
+* ``power_of_choice`` (Cho et al., 2020) — sample ``d = d_factor * k``
+  candidates uniformly, keep the ``k`` with the highest last observed
+  loss. Unobserved clients rank as +inf loss, so exploration is built in.
+* ``oort`` (Lai et al., OSDI 2021, simplified) — utility = statistical
+  utility (RMS of the recent loss window + a temporal-uncertainty bonus
+  for stale clients) × a system penalty for clients slower than the
+  preferred latency; an ε fraction of each cohort explores never-selected
+  clients.
+* ``reputation`` — the byzantine-aware-dropout closer: sample on the
+  UNIFORM stream (schedules stay comparable), then bench sampled clients
+  whose defense-verdict reputation fell below the threshold. The engine
+  turns benched clients into in-program dropout (work fraction 0,
+  renormalized over survivors under ``chaos_tolerance``) instead of
+  letting the defense zero their rows round after round — they stop
+  burning training compute, and the denominator no longer carries them.
+
+Every stochastic draw is a pure function of ``(random_seed, strategy tag,
+round_idx)`` via a fresh ``np.random.default_rng`` — rerunning a round
+with the same observed history replays the same cohort, which is what
+makes crash-resume selections assertable.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ...simulation.sampling import client_sampling, sampling_stream_from_args
+from .stats import ClientStatsStore
+
+logger = logging.getLogger(__name__)
+
+# domain-separation tags for the per-strategy PRNG streams
+_TAG_POC = 101
+_TAG_OORT = 103
+
+SELECTION_STRATEGIES = ("uniform", "power_of_choice", "oort", "reputation")
+
+Selection = Tuple[List[int], List[int]]  # (sampled ids, benched subset)
+
+
+def cap_bench(cohort_n: int, flagged, badness, keep_frac: float,
+              quorum: int = 1) -> List[int]:
+    """The ONE bench-floor policy, shared by the simulator's reputation
+    strategy and the cross-silo server's silo selection: never bench below
+    ``max(quorum, ceil(keep_frac * cohort))`` survivors, and when the
+    flagged set exceeds the cap keep only the WORST offenders (highest
+    ``badness``). An adversary that poisons scores must not be able to
+    empty a cohort, and a policy fix here fixes both callers."""
+    min_keep = max(int(quorum), int(np.ceil(keep_frac * cohort_n)), 1)
+    max_bench = max(cohort_n - min_keep, 0)
+    flagged = list(flagged)
+    if len(flagged) > max_bench:
+        flagged = sorted(flagged, key=badness, reverse=True)[:max_bench]
+    return flagged
+
+
+class SelectionStrategy:
+    """``select(round_idx, n) -> (sampled, excluded)``: ``sampled`` is the
+    scheduled cohort in placement order; ``excluded`` ⊆ ``sampled`` are
+    clients the strategy benches — the engine schedules them with work
+    fraction 0 (renormalized in-program dropout), it does not unschedule
+    them, so schedule shapes stay strategy-independent."""
+
+    name = "?"
+
+    def __init__(self, args, num_clients: int, store: ClientStatsStore):
+        self.args = args
+        self.n = int(num_clients)
+        self.store = store
+        self.seed = int(getattr(args, "random_seed", 0) or 0)
+        self.stream = sampling_stream_from_args(args)
+
+    def _uniform(self, round_idx: int, n: int) -> List[int]:
+        return [int(c) for c in client_sampling(
+            round_idx, self.n, n, random_seed=self.seed,
+            stream=self.stream)]
+
+    def _rng(self, tag: int, round_idx: int) -> np.random.Generator:
+        return np.random.default_rng((self.seed, tag, int(round_idx)))
+
+    def select(self, round_idx: int, n: int) -> Selection:
+        raise NotImplementedError
+
+
+class UniformSelection(SelectionStrategy):
+    name = "uniform"
+
+    def select(self, round_idx: int, n: int) -> Selection:
+        return self._uniform(round_idx, n), []
+
+
+class PowerOfChoiceSelection(SelectionStrategy):
+    name = "power_of_choice"
+
+    def select(self, round_idx: int, n: int) -> Selection:
+        n = min(int(n), self.n)
+        d_factor = float(getattr(self.args, "poc_d_factor", 2.0) or 2.0)
+        d = int(min(self.n, max(n, int(np.ceil(n * max(d_factor, 1.0))))))
+        rng = self._rng(_TAG_POC, round_idx)
+        cands = rng.choice(self.n, d, replace=False)
+        # highest-loss first; the candidate draw is already a random
+        # permutation, so equal scores tie-break randomly but stably
+        score = self.store.last_loss()[cands]
+        order = np.argsort(-score, kind="stable")
+        return [int(c) for c in cands[order[:n]]], []
+
+
+class OortSelection(SelectionStrategy):
+    name = "oort"
+
+    def _utility(self, round_idx: int) -> np.ndarray:
+        st = self.store
+        stat = st.rms_loss()
+        seen = np.isfinite(stat)
+        # never-observed clients get the observed mean utility (neutral):
+        # the explore slots are their on-ramp, not a fake-high score
+        fill = float(np.nanmean(stat)) if bool(np.any(seen)) else 1.0
+        stat = np.where(seen, stat, fill)
+        # temporal uncertainty (Oort eq. 2): clients not picked recently
+        # regain priority instead of starving on a stale low loss
+        age = np.maximum(int(round_idx) - st.last_selected, 1)
+        stat = stat + np.sqrt(0.1 * np.log(max(round_idx, 1) + 1.0) / age)
+        # system utility: penalize clients slower than the preferred
+        # latency (knob; 0 = the observed median), Oort's (T/t)^alpha
+        alpha = float(getattr(self.args, "oort_alpha", 2.0) or 0.0)
+        lat = np.where(st.has_latency > 0, st.ema_latency, np.nan)
+        pref = float(getattr(self.args, "oort_pref_latency_s", 0.0) or 0.0)
+        if pref <= 0.0:
+            pref = (float(np.nanmedian(lat))
+                    if bool(np.any(st.has_latency > 0)) else 0.0)
+        if pref > 0.0 and alpha > 0.0:
+            with np.errstate(invalid="ignore", divide="ignore"):
+                pen = np.power(pref / np.maximum(lat, 1e-9), alpha)
+            sys_u = np.where(np.isnan(lat) | (lat <= pref), 1.0,
+                             np.minimum(pen, 1.0))
+        else:
+            sys_u = np.ones(self.n, np.float32)
+        # the simulator has no wall-clock per client, but it observes work
+        # fractions: chronic stragglers (low EMA work) are the same signal
+        return stat * sys_u * np.clip(st.ema_work, 0.05, 1.0)
+
+    def select(self, round_idx: int, n: int) -> Selection:
+        n = min(int(n), self.n)
+        rng = self._rng(_TAG_OORT, round_idx)
+        explore_frac = float(getattr(self.args, "oort_explore_frac", 0.1)
+                             or 0.0)
+        unexplored = np.flatnonzero(self.store.times_selected == 0)
+        n_explore = min(int(np.ceil(n * max(explore_frac, 0.0))),
+                        len(unexplored), n)
+        explore = (rng.choice(unexplored, n_explore, replace=False)
+                   if n_explore else np.empty(0, np.int64))
+        util = self._utility(round_idx)
+        util[explore] = -np.inf  # already taken by the explore slots
+        order = np.argsort(-util, kind="stable")
+        exploit = order[:n - n_explore]
+        return [int(c) for c in np.concatenate([exploit, explore])], []
+
+
+class ReputationSelection(SelectionStrategy):
+    name = "reputation"
+
+    def select(self, round_idx: int, n: int) -> Selection:
+        sampled = self._uniform(round_idx, n)
+        thresh = float(getattr(self.args, "selection_rep_threshold", 0.3)
+                       or 0.0)
+        rep = self.store.reputation
+        benched = cap_bench(
+            len(sampled), [c for c in sampled if rep[c] < thresh],
+            badness=lambda c: -rep[c],
+            keep_frac=float(getattr(self.args, "selection_min_keep_frac",
+                                    0.5) or 0.5))
+        return sampled, benched
+
+
+_STRATEGIES = {cls.name: cls for cls in
+               (UniformSelection, PowerOfChoiceSelection, OortSelection,
+                ReputationSelection)}
+
+
+def create_strategy(args, num_clients: int,
+                    store: ClientStatsStore) -> SelectionStrategy:
+    name = str(getattr(args, "client_selection", "uniform")
+               or "uniform").lower()
+    cls = _STRATEGIES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"client_selection {name!r} unknown; choose from "
+            f"{tuple(sorted(_STRATEGIES))}")
+    return cls(args, num_clients, store)

@@ -28,6 +28,7 @@ consensus distance as the run result (the reference's eval worker role).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -37,6 +38,8 @@ import numpy as np
 from ..core.algframe.client_trainer import make_trainer_spec
 from ..core.algframe.local_training import evaluate
 from ..core.algframe.types import TrainHyper
+from ..core.chaos import FaultPlan
+from ..core.distributed.communication.backoff import backoff_delays
 from ..core.distributed.communication.message import (Message, tree_to_wire,
                                                       wire_to_tree)
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
@@ -102,6 +105,15 @@ class GossipNodeManager(FedMLCommManager):
         self._finals: Dict[int, Any] = {}
         self.history: List[Dict[str, Any]] = []
         self.result: Optional[dict] = None
+        # chaos tolerance: gossip has no server to time out a round, so a
+        # lost N2N_PARAMS frame would deadlock BOTH endpoints. Under
+        # injected link faults a monitor thread retransmits recent-round
+        # params whenever progress stalls (backoff-paced via the shared
+        # helper; receivers are idempotent, so duplicates are free).
+        self.chaos_plan = FaultPlan.from_args(args)
+        self._stop_resend = threading.Event()
+        self._sent_wires: Dict[int, Any] = {}  # recent rounds' own params
+        self._final_wire: Optional[Any] = None
 
     # --- jitted math --------------------------------------------------------
     def _train_impl(self, params, round_key, hyper):
@@ -133,8 +145,14 @@ class GossipNodeManager(FedMLCommManager):
 
     def run(self) -> None:
         self.register_message_receive_handlers()
-        self._kick_round()
-        self.com_manager.handle_receive_message()
+        if self.chaos_plan.injects_link_faults:
+            t = threading.Thread(target=self._resend_loop, daemon=True)
+            t.start()
+        try:
+            self._kick_round()
+            self.com_manager.handle_receive_message()
+        finally:
+            self._stop_resend.set()
 
     def _kick_round(self) -> None:
         """Train locally and ship the trained params to every neighbor."""
@@ -143,12 +161,65 @@ class GossipNodeManager(FedMLCommManager):
             self.params, round_key,
             self.hyper.replace(round_idx=jnp.int32(self.round_idx)))
         wire = tree_to_wire(self._trained)
+        # retransmission cache: a SLOW neighbor may still need our round-r
+        # params after we advanced to r+1 (its copy was lost) — keep the
+        # last few rounds' wires so the resend loop can replay them
+        self._sent_wires[self.round_idx] = wire
+        for r in sorted(self._sent_wires):
+            if r < self.round_idx - 2:
+                del self._sent_wires[r]
         for j in self.neighbors:
             m = Message(GossipMsg.N2N_PARAMS, self.rank, j)
             m.add_params(GossipMsg.K_PARAMS, wire)
             m.add_params(GossipMsg.K_ROUND, self.round_idx)
             self._send_with_retry(m)
         self._try_mix()
+
+    def _resend_loop(self) -> None:
+        """Chaos-link tolerance: when no progress happens for a (jittered,
+        backoff-growing) interval, retransmit the cached recent-round
+        params to every neighbor — fresh sends draw fresh link-fault
+        decisions, so seeded loss eventually lets a copy through. Resets
+        to the fast cadence whenever progress resumes."""
+        def fresh():
+            return backoff_delays(base_s=0.5, factor=2.0, max_s=4.0,
+                                  seed=(self.rank + 1) * 7919)
+
+        delays = fresh()
+        marker = None
+        while not self._stop_resend.wait(next(delays)):
+            cur = (self.round_idx, len(self._inbox.get(self.round_idx, {})),
+                   self._final_wire is not None, len(self._finals))
+            if cur != marker:
+                marker = cur
+                delays = fresh()
+                continue
+            try:
+                # ALWAYS replay the cached round wires — even after this
+                # node finalized, a slower neighbor may still be waiting
+                # on our round-r params (skipping them here deadlocked
+                # the pair: we only nagged rank 0 while the neighbor
+                # could never finish its round)
+                # snapshot: _kick_round trims this dict on the main
+                # thread — iterating it live would raise mid-cycle and
+                # the broad except below would silently skip the whole
+                # retransmission pass
+                for r, wire in sorted(list(self._sent_wires.items())):
+                    for j in self.neighbors:
+                        m = Message(GossipMsg.N2N_PARAMS, self.rank, j)
+                        m.add_params(GossipMsg.K_PARAMS, wire)
+                        m.add_params(GossipMsg.K_ROUND, r)
+                        self.send_message(m)
+                if self._final_wire is not None and self.rank != 0:
+                    m = Message(GossipMsg.N2Z_FINAL, self.rank, 0)
+                    m.add_params(GossipMsg.K_PARAMS, self._final_wire)
+                    self.send_message(m)
+                logger.info("gossip node %d: stalled at round %d — "
+                            "retransmitted params to neighbors", self.rank,
+                            self.round_idx)
+            except Exception as e:
+                logger.debug("gossip node %d resend failed: %s", self.rank,
+                             e)
 
     def _send_with_retry(self, msg: Message, timeout_s: float = 60.0) -> None:
         """Peer processes come up at their own pace and there is no server
@@ -167,6 +238,8 @@ class GossipNodeManager(FedMLCommManager):
 
     def _on_params(self, msg: Message) -> None:
         r = int(msg.get(GossipMsg.K_ROUND))
+        if r < self.round_idx:
+            return  # stale retransmission of a round we already mixed
         sender = msg.get_sender_id()
         self._inbox.setdefault(r, {})[sender] = wire_to_tree(
             msg.get(GossipMsg.K_PARAMS), self._template)
@@ -192,10 +265,11 @@ class GossipNodeManager(FedMLCommManager):
 
     def _finalize(self) -> None:
         if self.rank != 0:
+            self._final_wire = tree_to_wire(self.params)
             m = Message(GossipMsg.N2Z_FINAL, self.rank, 0)
-            m.add_params(GossipMsg.K_PARAMS, tree_to_wire(self.params))
+            m.add_params(GossipMsg.K_PARAMS, self._final_wire)
             self.send_message(m)
-            return  # wait for FINISH
+            return  # wait for FINISH (the resend loop replays a lost one)
         self._finals[0] = self.params
         self._maybe_report()
 
@@ -207,6 +281,8 @@ class GossipNodeManager(FedMLCommManager):
     def _maybe_report(self) -> None:
         if self.rank != 0 or len(self._finals) < self.n:
             return
+        if self.result is not None:
+            return  # duplicated final frame after the report went out
         stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves),
             *[self._finals[i] for i in range(self.n)])

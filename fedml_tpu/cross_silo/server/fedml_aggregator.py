@@ -25,6 +25,9 @@ from ...core.collectives import (tree_weighted_average,
                                  vector_to_tree_like)
 from ...core.dp import FedMLDifferentialPrivacy
 from ...core.security import FedMLDefender, stack_to_matrix
+from ...core.selection import ClientStatsStore
+from ...simulation.sampling import (client_sampling,
+                                    sampling_stream_from_args)
 
 logger = logging.getLogger(__name__)
 
@@ -57,8 +60,26 @@ class FedMLAggregator:
         # min 1) — averaging a one-silo sliver under heavy chaos is worse
         # than waiting another timeout interval
         frac = float(getattr(args, "round_quorum_frac", 0.0) or 0.0)
-        self.quorum = max(1, int(np.ceil(frac * self.client_num))) \
+        self._quorum_frac = frac
+        self._base_quorum = max(1, int(np.ceil(frac * self.client_num))) \
             if frac > 0 else 1
+        self.quorum = self._base_quorum
+        # silo selection (core/selection): per-RANK observed upload
+        # latencies + quorum history (which silos missed their rounds),
+        # consulted by select_silos when a non-uniform client_selection
+        # strategy is configured. Passive (records only) otherwise.
+        self.selection_strategy = str(getattr(args, "client_selection",
+                                              "uniform") or "uniform").lower()
+        self.silo_stats = ClientStatsStore(
+            max(self.client_num + 1, 2),
+            loss_window=int(getattr(args, "selection_loss_window", 8) or 8),
+            ema_alpha=float(getattr(args, "selection_ema_alpha", 0.2)
+                            or 0.2),
+            # light prior: a silo server gets ONE availability observation
+            # per (slow, minutes-long) round — benching must react within
+            # a handful of missed rounds, not nineteen
+            drop_prior=(1.0, 4.0))
+        self._expected = self.client_num
         self._lock = threading.Condition()
         self._reset_round()
 
@@ -67,6 +88,70 @@ class FedMLAggregator:
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict: Dict[int, bool] = {}
         self._round_start = time.time()
+        # restore BOTH per-round values: a quorum scaled down by
+        # set_round_expected must not leak into later rounds that bench
+        # nobody (it would silently weaken the configured quorum floor)
+        self._expected = self.client_num
+        self.quorum = getattr(self, "_base_quorum", 1)
+
+    # --- per-round expected cohort (silo selection seam) --------------------
+    def set_round_expected(self, n: int) -> None:
+        """Shrink THIS round's all-received barrier to the silos actually
+        selected (select_silos). Quorum scales with it. Reset to the full
+        cohort by the post-aggregation _reset_round."""
+        with self._lock:
+            self._expected = max(1, min(int(n), self.client_num))
+            if self._quorum_frac > 0:
+                self.quorum = max(1, int(np.ceil(self._quorum_frac
+                                                 * self._expected)))
+            self._lock.notify_all()
+
+    # --- observed silo behavior (fed by the server FSM) ---------------------
+    def observe_upload(self, rank: int, latency_s: float) -> None:
+        """One silo upload's broadcast→receipt latency."""
+        if 0 <= int(rank) < self.silo_stats.n:
+            self.silo_stats.record_latency(int(rank), float(latency_s))
+
+    def observe_round(self, reported, expected) -> None:
+        """Round-close quorum history: which of the silos the round
+        expected actually reported — the Beta-posterior dropout evidence
+        silo selection runs on. ``expected`` must be the SELECTED cohort
+        only: a benched silo losing the shrunken barrier's race is not
+        dropout evidence (counting it would self-reinforce the bench
+        forever). A benched silo that DOES report heals — that is the
+        redemption path."""
+        rep = set(int(r) for r in reported)
+        exp = set(int(r) for r in expected)
+        for r in exp:
+            if 0 <= r < self.silo_stats.n:
+                self.silo_stats.record_availability(r, participated=r in rep)
+        for r in rep - exp:
+            if 0 <= r < self.silo_stats.n:
+                self.silo_stats.record_availability(r, participated=True)
+
+    def select_silos(self, online_ranks) -> List[int]:
+        """Which online silos to include in the next round. ``uniform``
+        (default): all of them — byte-identical FSM. Non-uniform
+        strategies bench silos whose posterior dropout probability is
+        high (they would only burn the round timeout), never benching
+        below max(quorum, min_keep_frac) of the online set."""
+        ranks = sorted(int(r) for r in online_ranks)
+        if self.selection_strategy == "uniform" or len(ranks) <= 1:
+            return ranks
+        from ...core.selection.strategies import cap_bench
+        # benching is driven by the dropout POSTERIOR alone: silos have
+        # no defense-verdict stream feeding silo_stats, so a reputation
+        # condition here would be dead code implying a signal that does
+        # not exist
+        post = self.silo_stats.dropout_posterior_mean()
+        flaky = [r for r in ranks
+                 if r < self.silo_stats.n and post[r] > 0.5]
+        benched = set(cap_bench(
+            len(ranks), flaky, badness=lambda r: post[r],
+            keep_frac=float(getattr(self.args, "selection_min_keep_frac",
+                                    0.5) or 0.5),
+            quorum=self.quorum))
+        return [r for r in ranks if r not in benched]
 
     def add_local_trained_result(self, index: int, model_params,
                                  sample_num: float) -> None:
@@ -104,7 +189,7 @@ class FedMLAggregator:
 
     def check_whether_all_receive(self) -> bool:
         with self._lock:
-            return len(self.model_dict) >= self.client_num
+            return len(self.model_dict) >= self._expected
 
     def wait_all_or_timeout(self) -> bool:
         """Block until every expected silo reported, or the round timeout
@@ -117,7 +202,7 @@ class FedMLAggregator:
         with self._lock:
             while True:
                 n = len(self.model_dict)
-                if n >= self.client_num:
+                if n >= self._expected:
                     return True
                 remaining = None
                 if self.round_timeout_s > 0:
@@ -169,18 +254,22 @@ class FedMLAggregator:
         return self.eval_fn(self.global_params)
 
     # --- selection (reference :113,:139) ------------------------------------
+    # Both draws ride simulation.sampling.client_sampling: the legacy
+    # stream (default) reproduces the reference's np.random.seed(round_idx)
+    # sequence bit-for-bit WITHOUT clobbering the process-global RNG, and
+    # sampling_stream: seeded folds random_seed in.
     def client_selection(self, round_idx: int, client_num_in_total: int,
                          client_num_per_round: int) -> List[int]:
-        if client_num_in_total == client_num_per_round:
-            return list(range(client_num_in_total))
-        np.random.seed(round_idx)
-        return list(np.random.choice(range(client_num_in_total),
-                                     client_num_per_round, replace=False))
+        return [int(c) for c in client_sampling(
+            round_idx, client_num_in_total, client_num_per_round,
+            random_seed=int(getattr(self.args, "random_seed", 0) or 0),
+            stream=sampling_stream_from_args(self.args))]
 
     def data_silo_selection(self, round_idx: int, data_silo_num: int,
                             client_num_in_total: int) -> List[int]:
         if data_silo_num <= client_num_in_total:
             return list(range(client_num_in_total))
-        np.random.seed(round_idx)
-        return list(np.random.choice(range(data_silo_num),
-                                     client_num_in_total, replace=False))
+        return [int(c) for c in client_sampling(
+            round_idx, data_silo_num, client_num_in_total,
+            random_seed=int(getattr(self.args, "random_seed", 0) or 0),
+            stream=sampling_stream_from_args(self.args))]

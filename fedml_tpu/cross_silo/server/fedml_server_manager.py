@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, Optional
 
 import jax
@@ -40,6 +41,9 @@ class FedMLServerManager(FedMLCommManager):
     chaos = FaultPlan()
     quorum = 1
     _timeout_graced = False
+    _bcast_t0 = None
+    _round_targets: list = []
+    _round_selected: list = []
 
     def __init__(self, args, aggregator, comm=None, rank: int = 0,
                  size: int = 0, backend: str = "INPROC"):
@@ -66,8 +70,8 @@ class FedMLServerManager(FedMLCommManager):
         self.chaos_ledger = FaultLedger()
         # quorum for the timeout path: below it, grant ONE grace interval
         # before degrading (single source of truth: FedMLAggregator.quorum
-        # — the blocking wait_all_or_timeout API applies the same policy
-        # for callers outside this event-driven FSM)
+        # — read LIVE in _complete_round, because silo selection scales it
+        # per round via set_round_expected; a snapshot here would diverge)
         self.quorum = self.aggregator.quorum
         self._timeout_graced = False
         # wire-efficient updates: clients upload compressed deltas that
@@ -83,6 +87,12 @@ class FedMLServerManager(FedMLCommManager):
         # process's encodes: all S2C traffic; in-proc sessions also count
         # the client threads' uploads, which is what the bench wants)
         self._wire_mark = WIRE_STATS.total_bytes
+        # silo selection (core/selection): the broadcast timestamp clocks
+        # per-silo upload latencies; _round_targets is the rank set the
+        # round expects (all online ranks at default knobs — byte-
+        # identical FSM; non-uniform strategies may bench flaky silos)
+        self._bcast_t0: Optional[float] = None
+        self._round_targets: list = []
 
     def _global_f32_vec(self) -> np.ndarray:
         """The global model flattened to a host f32 vector — the SINGLE
@@ -132,7 +142,10 @@ class FedMLServerManager(FedMLCommManager):
             # mid-flight. After a dense init it is the exact global vector.
             # Broadcast-only specs (method None) get no deltas: skip.
             self._bcast_prev_vec = self._global_f32_vec()
-        for i, rank in enumerate(sorted(self.client_online_status)):
+        self._round_targets = sorted(self.client_online_status)
+        self._round_selected = list(self._round_targets)
+        self._bcast_t0 = time.time()
+        for i, rank in enumerate(self._round_targets):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
@@ -147,6 +160,7 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        recv_t = time.time()
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
         update = msg.get(MyMessage.MSG_ARG_KEY_MODEL_UPDATE)
         if is_compressed_payload(update):  # delta vs the broadcast model
@@ -192,6 +206,14 @@ class FedMLServerManager(FedMLCommManager):
                     "server: dropping stale upload from silo %s "
                     "(round %s, now %d)", sender, up_round, self.round_idx)
                 return
+        if self._bcast_t0 is not None:
+            # broadcast→receipt wall time: the silo-selection latency
+            # signal (the silo's train time + both wire hops — what the
+            # round critical path pays for this silo). Recorded only for
+            # CURRENT-round uploads: a chaos-delayed duplicate from a
+            # past round would log a bogus cross-round latency and skew
+            # which silos a non-uniform strategy benches.
+            self.aggregator.observe_upload(sender, recv_t - self._bcast_t0)
         if not self.aggregator.check_whether_all_receive():
             # elastic rounds (capability beyond the reference, SURVEY §5.3):
             # a dead silo must not stall the barrier forever — arm a
@@ -231,8 +253,12 @@ class FedMLServerManager(FedMLCommManager):
                 self._round_timer.cancel()
                 self._round_timer = None
             reported = len(self.aggregator.model_dict)
+            # read the aggregator's CURRENT quorum: silo selection may
+            # have scaled it to this round's shrunken expected cohort
+            quorum_now = getattr(getattr(self, "aggregator", None),
+                                 "quorum", None) or self.quorum
             if from_timeout:
-                if reported < self.quorum and not self._timeout_graced:
+                if reported < quorum_now and not self._timeout_graced:
                     # tolerance: below quorum (or zero reports), grant ONE
                     # grace interval — stragglers and compile-skewed
                     # first rounds beat averaging a sliver of the cohort.
@@ -244,7 +270,7 @@ class FedMLServerManager(FedMLCommManager):
                         "server round %d: timeout with %d/%d models — "
                         "below quorum %d, granting one grace interval",
                         self.round_idx, reported,
-                        self.aggregator.client_num, self.quorum)
+                        self.aggregator.client_num, quorum_now)
                     this_round = self.round_idx
                     self._round_timer = threading.Timer(
                         self.round_timeout_s,
@@ -294,6 +320,15 @@ class FedMLServerManager(FedMLCommManager):
                                   "reported": reported,
                                   "timeout": bool(from_timeout)})
                 import jax.random as jrandom
+                # quorum history for silo selection: which of the
+                # SELECTED silos actually reported before the round
+                # closed (benched silos losing the shrunken barrier's
+                # race is not dropout evidence — but a benched silo that
+                # reports anyway heals: the redemption path)
+                self.aggregator.observe_round(
+                    list(self.aggregator.model_dict),
+                    self._round_selected
+                    or sorted(self.client_online_status))
                 round_key = jrandom.fold_in(self._root_key, self.round_idx)
                 self.aggregator.aggregate(round_key)
                 # close the round under the SAME lock acquisition that
@@ -379,8 +414,31 @@ class FedMLServerManager(FedMLCommManager):
         client_indexes = self.aggregator.client_selection(
             self.round_idx, int(self.args.client_num_in_total),
             self.client_num)
+        online = sorted(self.client_online_status)
+        selected = self.aggregator.select_silos(online)
+        if len(selected) < len(online):
+            # non-uniform strategy benched flaky silos: shrink this
+            # round's all-received barrier so it does not wait out the
+            # timeout for silos the history says will not report. The
+            # broadcast still goes to EVERYONE — a benched silo that does
+            # report is aggregated and heals its posterior (redemption),
+            # it just no longer holds the round hostage.
+            self.aggregator.set_round_expected(len(selected))
+            logger.info(
+                "server round %d: silo selection benched %s (of %d online)",
+                self.round_idx, sorted(set(online) - set(selected)),
+                len(online))
+        mlops.log_selection(
+            round_idx=self.round_idx,
+            strategy=self.aggregator.selection_strategy,
+            sampled=selected,
+            excluded=sorted(set(online) - set(selected)),
+            target_n=len(selected))
+        self._round_targets = online
+        self._round_selected = selected
         payload = self._sync_payload()
-        for i, rank in enumerate(sorted(self.client_online_status)):
+        self._bcast_t0 = time.time()
+        for i, rank in enumerate(online):
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                           self.rank, rank)
             for key, value in payload:

@@ -9,7 +9,8 @@ from typing import Any, Dict, List, Sequence
 
 import numpy as np
 
-from ..simulation.sampling import client_sampling
+from ..simulation.sampling import (client_sampling,
+                                   sampling_stream_from_args)
 
 logger = logging.getLogger(__name__)
 
@@ -29,8 +30,10 @@ class FASimulator:
         per_round = int(getattr(self.args, "client_num_per_round",
                                 len(self.client_datas)))
         for round_idx in range(rounds):
-            sampled = client_sampling(round_idx, len(self.client_datas),
-                                      per_round)
+            sampled = client_sampling(
+                round_idx, len(self.client_datas), per_round,
+                random_seed=int(getattr(self.args, "random_seed", 0) or 0),
+                stream=sampling_stream_from_args(self.args))
             init_msg = self.aggregator.get_init_msg()
             submissions = []
             for cid in sampled:

@@ -6,6 +6,17 @@ the NCCL simulator's ``client_schedule`` (``nccl/base_framework/Server.py:111``
 — ``np.array_split`` of sampled clients over workers). Here the schedule is a
 *tensor* ([n_devices, n_slots] local indices + active mask) consumed inside
 the jitted round, replacing the broadcast ``client_schedule{i}`` params.
+
+RNG streams: the reference (and this repo's seed state) sampled via
+``np.random.seed(round_idx)`` + global ``np.random.choice`` — which clobbers
+the PROCESS-GLOBAL NumPy RNG every round and ignores ``args.random_seed``
+(every run samples identically). ``stream="legacy"`` reproduces that exact
+sequence WITHOUT touching global state (a fresh ``RandomState(round_idx)``
+is bit-compatible with the global-seed path) and stays the default so
+existing schedules are bit-identical; ``stream="seeded"`` is the fixed
+stream — a ``np.random.default_rng((random_seed, round_idx))`` Generator, so
+different seeds sample different cohorts and the draw is still a pure
+function of ``(seed, round)``.
 """
 
 from __future__ import annotations
@@ -14,14 +25,40 @@ from typing import List, Tuple
 
 import numpy as np
 
+SAMPLING_STREAMS = ("legacy", "seeded")
+
+
+def sampling_stream_from_args(args) -> str:
+    """The ``sampling_stream`` knob, validated. ``legacy`` (default) keeps
+    the reference's per-round stream bit-identical; ``seeded`` folds
+    ``random_seed`` in."""
+    stream = str(getattr(args, "sampling_stream", "legacy")
+                 or "legacy").lower()
+    if stream not in SAMPLING_STREAMS:
+        raise ValueError(f"sampling_stream {stream!r} unknown; choose from "
+                         f"{SAMPLING_STREAMS}")
+    return stream
+
 
 def client_sampling(round_idx: int, client_num_in_total: int,
-                    client_num_per_round: int) -> List[int]:
+                    client_num_per_round: int, random_seed: int = 0,
+                    stream: str = "legacy") -> List[int]:
+    if stream not in SAMPLING_STREAMS:  # same contract as the args knob
+        raise ValueError(f"sampling_stream {stream!r} unknown; choose from "
+                         f"{SAMPLING_STREAMS}")
     if client_num_in_total == client_num_per_round:
         return list(range(client_num_in_total))
-    np.random.seed(round_idx)  # deterministic per round, like the reference
     num = min(client_num_per_round, client_num_in_total)
-    return list(np.random.choice(range(client_num_in_total), num, replace=False))
+    if stream == "legacy":
+        # bit-compatible with the reference's np.random.seed(round_idx) +
+        # global np.random.choice, but via a PRIVATE RandomState — the
+        # process-global RNG is no longer clobbered every round
+        rng = np.random.RandomState(round_idx)
+        return list(rng.choice(range(client_num_in_total), num,
+                               replace=False))
+    gen = np.random.default_rng((int(random_seed), int(round_idx)))
+    return [int(c) for c in gen.choice(client_num_in_total, num,
+                                       replace=False)]
 
 
 def build_schedule(

@@ -23,7 +23,7 @@ import numpy as np
 from ...core.algframe.types import TrainHyper
 from ...core.algframe.local_training import evaluate
 from ...core.collectives import tree_weighted_average
-from ..sampling import client_sampling
+from ..sampling import client_sampling, sampling_stream_from_args
 
 logger = logging.getLogger(__name__)
 
@@ -96,8 +96,10 @@ class HierarchicalSimulator:
         per_round = int(args.client_num_per_round)
         t0 = time.time()
         for round_idx in range(rounds):
-            sampled = set(client_sampling(round_idx, self.fed.num_clients,
-                                          per_round))
+            sampled = set(client_sampling(
+                round_idx, self.fed.num_clients, per_round,
+                random_seed=int(getattr(args, "random_seed", 0) or 0),
+                stream=sampling_stream_from_args(args)))
             group_params, group_weights = [], []
             for g, members in enumerate(self.groups):
                 active = [c for c in members if c in sampled]

@@ -26,7 +26,7 @@ from ...core import mlops
 from ...core.checkpoint import RoundCheckpointer
 from ...core.contribution import ContributionAssessorManager
 from ...core.security import FedMLAttacker, FedMLDefender, stack_to_matrix
-from ..sampling import client_sampling
+from ..sampling import client_sampling, sampling_stream_from_args
 from ..tpu.engine import (ATTACK_FOLD, DEFENSE_FOLD, DP_CDP_FOLD,
                           DP_LDP_FOLD)
 
@@ -139,8 +139,11 @@ class SPSimulator:
             start_round = step + 1
             logger.info("resumed from checkpoint at round %d", step)
         for round_idx in range(start_round, rounds):
-            sampled = client_sampling(round_idx, self.fed.num_clients,
-                                      int(args.client_num_per_round))
+            sampled = client_sampling(
+                round_idx, self.fed.num_clients,
+                int(args.client_num_per_round),
+                random_seed=int(getattr(args, "random_seed", 0) or 0),
+                stream=sampling_stream_from_args(args))
             round_key = jax.random.fold_in(self.rng, round_idx)
             updates, weights, extras_list, states, metrics = [], [], [], [], []
             for cid in sampled:

@@ -38,7 +38,8 @@ from ...core.contribution import ContributionAssessorManager
 from ...core.mesh import build_mesh
 from ...core.security import FedMLAttacker, FedMLDefender
 from ...core.security.defense import sharded as sharded_defense
-from ..sampling import client_sampling, build_schedule
+from ...core.selection import SelectionManager, slot_placement
+from ..sampling import build_schedule
 
 # PRNG fold tags reserved for the DP noise streams (shared with the SP
 # golden loop so LDP/CDP runs stay backend-parity-testable)
@@ -100,6 +101,34 @@ def _maybe_enable_compile_cache(args) -> None:
     except Exception:
         pass
     logger.info("persistent XLA compilation cache at %s", path)
+
+
+def _verdict_from_info(info, k: int) -> Optional[np.ndarray]:
+    """Map a host defense kernel's info dict to the [K] per-client verdict
+    the selection subsystem consumes (selection masks / keep flags /
+    continuous weights). None when the defense exposes no per-client
+    notion — reputation then simply sees no evidence this round.
+
+    Semantic guard: ``selected``/``kept`` must be BINARY masks — host
+    bulyan's ``selected`` carries top-theta row INDICES, which would pass
+    a shape-only check (theta == k when byzantine_count == 0) and brand
+    arbitrary clients. Continuous keys must already live in [0, 1]."""
+    if not isinstance(info, dict):
+        return None
+    for key, binary in (("selected", True), ("kept", True),
+                        ("fg_weights", False), ("confidence", False)):
+        v = info.get(key)
+        if v is None:
+            continue
+        v = np.asarray(v, np.float32)
+        if v.shape != (k,):
+            continue
+        if binary and not np.all((v == 0.0) | (v == 1.0)):
+            continue  # an index list, not an inclusion mask
+        if not binary and (np.min(v) < 0.0 or np.max(v) > 1.0):
+            continue
+        return v
+    return None
 
 
 def _check_extras_compat(opt, params, dp, robust_mode: bool) -> None:
@@ -166,12 +195,43 @@ class TPUSimulator:
         self.chaos = FaultPlan.from_args(args)
         self.chaos_ledger = FaultLedger()
         self.chaos_tolerance = bool(getattr(args, "chaos_tolerance", True))
+        # participant selection (core/selection): host-side policy whose
+        # cohorts ride the jitted programs purely as schedule DATA.
+        # Passive no-op at the default knobs (uniform strategy on the
+        # legacy sampling stream = bit-identical schedules, nothing
+        # observed, nothing checkpointed).
+        self.selection = SelectionManager(args, fed_dataset.num_clients)
+        if (self.selection.strategy_name == "reputation"
+                and not self.chaos_tolerance):
+            # benched clients ride the work-0 dropout channel, which only
+            # RENORMALIZES under tolerance; with tolerance off their full
+            # weight would stay in the denominator and every bench would
+            # dilute the aggregate with zeros — strictly worse than not
+            # benching, so refuse instead of silently degrading
+            raise ValueError(
+                "client_selection: reputation requires chaos_tolerance "
+                "(benched clients are renormalized out of the weighted "
+                "average); with chaos_tolerance: false they would dilute "
+                "every round's aggregate instead")
         over = float(getattr(args, "chaos_over_sample", 0.0) or 0.0)
         base_n = int(args.client_num_per_round)
-        # over-sampling: draw extra clients so the post-dropout cohort
-        # still hits the configured size in expectation
-        self._sample_n = min(int(fed_dataset.num_clients),
+        self._base_n = base_n
+        # static over-sampling: draw extra clients so the post-dropout
+        # cohort still hits the configured size in expectation
+        self._static_n = min(int(fed_dataset.num_clients),
                              int(np.ceil(base_n * (1.0 + max(over, 0.0)))))
+        # _sample_n is the COHORT CAP — the canonical-width anchor. With
+        # adaptive over-sampling the dropout posterior sizes each round's
+        # draw between base_n and this cap; the CAP (not the draw) fixes
+        # the compiled schedule width, so adaptivity never recompiles.
+        if self.selection.adaptive:
+            cap = float(getattr(args, "selection_max_over_sample", 1.0)
+                        or 0.0)
+            self._sample_n = min(
+                int(fed_dataset.num_clients),
+                int(np.ceil(base_n * (1.0 + max(cap, over, 0.0)))))
+        else:
+            self._sample_n = self._static_n
 
         self.attacker = FedMLAttacker(args)
         self.defender = FedMLDefender(args)
@@ -220,6 +280,18 @@ class TPUSimulator:
                                jax.tree_util.tree_leaves(self.params)))
         self._d_pad = self._true_d + ((-self._true_d) % self.n_devices)
         self.robust_fused = self._resolve_robust_fused()
+        if self.robust_fused and self.selection.adaptive:
+            # the fused robust program's defense kernel works on a [K]
+            # cohort whose SHAPE is baked into the compiled program
+            # (rows/byz/ids stack per round inside the fused block): a
+            # posterior-driven cohort-size flip would crash the stack
+            # mid-block and recompile across blocks, breaking the
+            # compile-once invariant — pin the cohort instead
+            self.selection.pin_adaptive(
+                "the fused robust program needs a constant [K] cohort "
+                "shape (compile-once); use robust_fused: host for a "
+                "per-round adaptive cohort under defenses")
+            self._sample_n = self._static_n
         # defenses with cross-round state (foolsgold history, cclip
         # momentum, slsgd prev-global, cross_round prev updates) keep it as
         # a DEVICE-RESIDENT feature-sharded pytree: threaded through the
@@ -270,30 +342,49 @@ class TPUSimulator:
             # clients against an amnesiac history and diverge from the
             # uninterrupted trajectory
             st["defense_state"] = self._defense_state
+        if self.selection.stateful:
+            # selection history (losses, dropout posterior, reputation):
+            # strategies are pure functions of (seed, round, history), so
+            # checkpointing the history is what makes crash-resume replay
+            # IDENTICAL selections instead of re-selecting amnesiacally
+            st["selection"] = self.selection.state_dict()
         return st
 
+    # checkpoint leaves whose presence can legitimately flip between save
+    # and resume (knob changes, version skew); dropped one at a time on
+    # restore failure rather than making a valid checkpoint unloadable
+    _OPTIONAL_CKPT_KEYS = ("selection", "defense_state")
+
     def _ckpt_latest(self):
-        """Restore the newest checkpoint, tolerating the defense-state
-        leaf's presence flipping between save and resume: a checkpoint
-        written before a stateful defense was configured (or by a version
-        without sharded stateful defenses) lacks the ``defense_state``
-        key, and orbax refuses a template with extra structure — retry
-        without the leaf rather than making a valid checkpoint unloadable
-        (the defense then resumes from its cold-start state, loudly)."""
+        """Restore the newest checkpoint, tolerating optional leaves
+        (``defense_state``, ``selection``) whose presence flips between
+        save and resume: a checkpoint written before the feature was
+        configured lacks the key, and orbax refuses a template with extra
+        structure — retry without the leaf rather than failing (the
+        subsystem then resumes from its cold-start state, loudly)."""
         template = self._ckpt_state()
-        try:
-            return self.ckpt.latest(template)
-        except Exception as e:
-            if "defense_state" not in template:
-                raise
-            logger.warning(
-                "checkpoint restore with the defense-state leaf failed "
-                "(%s: %s); retrying without it — the %s defense will "
-                "resume from cold-start state", type(e).__name__, e,
-                self.defender.defense_type)
-            template = {k: v for k, v in template.items()
-                        if k != "defense_state"}
-            return self.ckpt.latest(template)
+        opts = [k for k in self._OPTIONAL_CKPT_KEYS if k in template]
+        # least state lost first: full template, each optional leaf
+        # dropped alone, then all of them
+        candidates = [()] + [(k,) for k in opts]
+        if len(opts) > 1:
+            candidates.append(tuple(opts))
+        last_err = None
+        for drop in candidates:
+            try:
+                restored = self.ckpt.latest(
+                    {k: v for k, v in template.items() if k not in drop})
+            except Exception as e:
+                last_err = e
+                continue
+            if drop and restored is not None:
+                logger.warning(
+                    "checkpoint restore succeeded only without the %s "
+                    "leaf(s) (last error: %s: %s) — the corresponding "
+                    "state resumes cold", "/".join(drop),
+                    type(last_err).__name__, last_err)
+            return restored
+        raise last_err
 
     def _load_ckpt_state(self, st):
         self.params = jax.device_put(st["params"], self.repl_sharding)
@@ -308,6 +399,8 @@ class TPUSimulator:
                 lambda a, s: jax.device_put(jnp.asarray(a),
                                             NamedSharding(self.mesh, s)),
                 dict(st["defense_state"]), self._defense_state_specs)
+        if "selection" in st and self.selection.stateful:
+            self.selection.load_state_dict(st["selection"])
 
     # ------------------------------------------------------------------
     def _make_round_core(self):
@@ -411,11 +504,16 @@ class TPUSimulator:
                     lambda a, n: a.at[li].set(
                         jnp.where(report > 0, n, a[li])), states,
                     new_cstate)
-                return (states, acc_u, acc_ex, acc_w, acc_m), None
+                # per-slot metrics ride out as scan ys: the selection
+                # subsystem's per-CLIENT loss signal (the psum'd acc_m
+                # sums them away). Masked like acc_m; devices keep their
+                # own [S] slices, so the output stays client-sharded.
+                slot_m = jax.tree_util.tree_map(lambda m: m * report, mets)
+                return (states, acc_u, acc_ex, acc_w, acc_m), slot_m
 
-            (states, acc_u, acc_ex, acc_w, acc_m), _ = jax.lax.scan(
+            (states, acc_u, acc_ex, acc_w, acc_m), slot_mets = jax.lax.scan(
                 slot, init, jnp.arange(sched_idx.shape[0]))
-            return finish(states, acc_u, acc_ex, acc_w, acc_m)
+            return finish(states, acc_u, acc_ex, acc_w, acc_m) + (slot_mets,)
 
         return core
 
@@ -451,12 +549,13 @@ class TPUSimulator:
             size 1 for P(client)-sharded inputs — squeeze it, and restore it
             on the sharded output."""
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
-            new_params, new_sstate, states, metrics = core(
+            new_params, new_sstate, states, metrics, slot_mets = core(
                 params, server_state, sq(local_data), sq(local_states),
                 sched_idx[0], sched_active[0], sched_work[0], round_key,
                 hyper)
             states = jax.tree_util.tree_map(lambda a: a[None], states)
-            return new_params, new_sstate, states, metrics
+            slot_mets = jax.tree_util.tree_map(lambda a: a[None], slot_mets)
+            return new_params, new_sstate, states, metrics, slot_mets
 
         shard_fn = shard_map(
             round_body,
@@ -464,7 +563,7 @@ class TPUSimulator:
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(), P()),
-            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P(), P(AXIS_CLIENT)),
             check_vma=False,
         )
         return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
@@ -493,17 +592,20 @@ class TPUSimulator:
                 params, server_state, states = carry
                 idx_r, act_r, work_r, key_r, ridx_r = xs
                 hyper_r = hyper.replace(round_idx=ridx_r)
-                new_p, new_s, states, metrics = core(
+                new_p, new_s, states, metrics, slot_m = core(
                     params, server_state, local_data, states,
                     idx_r, act_r, work_r, key_r, hyper_r)
-                return (new_p, new_s, states), metrics
+                return (new_p, new_s, states), (metrics, slot_m)
 
-            (params, server_state, states), metrics = jax.lax.scan(
-                one_round, (params, server_state, local_states),
-                (sched_idxs, sched_actives, sched_works, round_keys,
-                 round_idxs))
+            (params, server_state, states), (metrics, slot_mets) = \
+                jax.lax.scan(
+                    one_round, (params, server_state, local_states),
+                    (sched_idxs, sched_actives, sched_works, round_keys,
+                     round_idxs))
             states = jax.tree_util.tree_map(lambda a: a[None], states)
-            return params, server_state, states, metrics  # metrics: [R]
+            slot_mets = jax.tree_util.tree_map(lambda a: a[:, None],
+                                               slot_mets)  # [R, 1, S]
+            return params, server_state, states, metrics, slot_mets
 
         shard_fn = shard_map(
             rounds_body,
@@ -511,7 +613,8 @@ class TPUSimulator:
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(None, AXIS_CLIENT), P(None, AXIS_CLIENT),
                       P(None, AXIS_CLIENT), P(), P(), P()),
-            out_specs=(P(), P(), P(AXIS_CLIENT), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P(),
+                       P(None, AXIS_CLIENT)),
             check_vma=False,
         )
         return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
@@ -568,12 +671,18 @@ class TPUSimulator:
                 states = jax.tree_util.tree_map(
                     lambda a, n: a.at[li].set(
                         jnp.where(report > 0, n, a[li])), states, out.client_state)
-                return (states, acc_ex, acc_w, acc_m), (upd, w)
+                # per-slot metrics for the selection subsystem (see
+                # _make_round_core) — masked like acc_m, device-local
+                slot_m = jax.tree_util.tree_map(
+                    lambda m: m * report, out.metrics)
+                return (states, acc_ex, acc_w, acc_m), (upd, w, slot_m)
 
             init = (local_states, zero_extras, jnp.float32(0), zero_metrics)
-            (states, acc_ex, acc_w, acc_m), (upd_stack, w_stack) = jax.lax.scan(
+            ((states, acc_ex, acc_w, acc_m),
+             (upd_stack, w_stack, slot_mets)) = jax.lax.scan(
                 slot, init, jnp.arange(sched_idx.shape[0]))
-            return upd_stack, w_stack, states, acc_ex, acc_w, acc_m
+            return (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
+                    slot_mets)
 
         return core
 
@@ -593,7 +702,8 @@ class TPUSimulator:
                        sched_idx, sched_active, sched_work, round_key,
                        hyper):
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
-            upd_stack, w_stack, states, acc_ex, acc_w, acc_m = core(
+            (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
+             slot_mets) = core(
                 params, server_state, sq(local_data), sq(local_states),
                 sched_idx[0], sched_active[0], sched_work[0], round_key,
                 hyper)
@@ -604,7 +714,9 @@ class TPUSimulator:
             metrics = psum_tree(acc_m)
             states = jax.tree_util.tree_map(lambda a: a[None], states)
             upd_stack = jax.tree_util.tree_map(lambda a: a[None], upd_stack)
-            return upd_stack, w_stack[None], agg_extras, states, metrics
+            slot_mets = jax.tree_util.tree_map(lambda a: a[None], slot_mets)
+            return (upd_stack, w_stack[None], agg_extras, states, metrics,
+                    slot_mets)
 
         shard_fn = shard_map(
             round_body,
@@ -612,7 +724,8 @@ class TPUSimulator:
             in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
                       P(), P()),
-            out_specs=(P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(AXIS_CLIENT), P()),
+            out_specs=(P(AXIS_CLIENT), P(AXIS_CLIENT), P(), P(AXIS_CLIENT),
+                       P(), P(AXIS_CLIENT)),
             check_vma=False,
         )
         # params/server_state are NOT donated here: the host still needs
@@ -648,7 +761,8 @@ class TPUSimulator:
         def core(params, server_state, local_data, local_states,
                  sched_idx, sched_active, sched_work, rows, byz_mask, ids,
                  dstate, round_key, hyper):
-            upd_stack, w_stack, states, acc_ex, acc_w, acc_m = collect(
+            (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
+             slot_mets) = collect(
                 params, server_state, local_data, local_states,
                 sched_idx, sched_active, sched_work, round_key, hyper)
             # [S, ...] stack -> [S, D] f32 local matrix: same leaf order
@@ -671,11 +785,15 @@ class TPUSimulator:
                     attack_type, mat_s, byz_mask,
                     jax.random.fold_in(round_key, ATTACK_FOLD),
                     attack_scale, AXIS_CLIENT)
-            vec_s, new_dstate = sharded_defense.defend_shard_stateful(
-                mat_s, w, AXIS_CLIENT, defense_type, hp, state=dstate,
-                ids=ids,
-                key=jax.random.fold_in(round_key, DEFENSE_FOLD),
-                true_d=true_d)
+            # verdict: the defense's [K] per-client effective inclusion —
+            # replicated and tiny, emitted so reputation updates cost
+            # zero extra dispatches
+            vec_s, new_dstate, verdict = \
+                sharded_defense.defend_shard_stateful(
+                    mat_s, w, AXIS_CLIENT, defense_type, hp, state=dstate,
+                    ids=ids,
+                    key=jax.random.fold_in(round_key, DEFENSE_FOLD),
+                    true_d=true_d)
             vec = jax.lax.all_gather(vec_s, AXIS_CLIENT, tiled=True)[:true_d]
             agg_update = vector_to_tree_like(vec, params)
             if dp.is_global_dp_enabled():
@@ -689,7 +807,8 @@ class TPUSimulator:
             new_params, new_sstate = opt.server_update(
                 params, server_state, agg_update, agg_extras,
                 hyper.round_idx)
-            out = (new_params, new_sstate, states, new_dstate, metrics)
+            out = (new_params, new_sstate, states, new_dstate, metrics,
+                   slot_mets, verdict)
             return out + (mat_s, w) if emit_matrix else out
 
         return core
@@ -710,12 +829,16 @@ class TPUSimulator:
                 params, server_state, sq(local_data), sq(local_states),
                 sched_idx[0], sched_active[0], sched_work[0], rows,
                 byz_mask, ids, dstate, round_key, hyper)
-            new_params, new_sstate, states, new_dstate, metrics = out[:5]
+            (new_params, new_sstate, states, new_dstate, metrics,
+             slot_mets, verdict) = out[:7]
             states = jax.tree_util.tree_map(lambda a: a[None], states)
-            res = (new_params, new_sstate, states, new_dstate, metrics)
-            return res + out[5:] if emit else res
+            slot_mets = jax.tree_util.tree_map(lambda a: a[None], slot_mets)
+            res = (new_params, new_sstate, states, new_dstate, metrics,
+                   slot_mets, verdict)
+            return res + out[7:] if emit else res
 
-        out_specs = (P(), P(), P(AXIS_CLIENT), state_specs, P())
+        out_specs = (P(), P(), P(AXIS_CLIENT), state_specs, P(),
+                     P(AXIS_CLIENT), P())
         if emit:
             out_specs = out_specs + (P(None, AXIS_CLIENT), P())
         shard_fn = shard_map(
@@ -759,18 +882,23 @@ class TPUSimulator:
                 idx_r, act_r, work_r, rows_i, byz_i, ids_i, key_r, ridx_r \
                     = xs
                 hyper_r = hyper.replace(round_idx=ridx_r)
-                new_p, new_s, states, dstate, metrics = core(
-                    params, server_state, local_data, states,
-                    idx_r, act_r, work_r, rows_i, byz_i, ids_i, dstate,
-                    key_r, hyper_r)
-                return (new_p, new_s, states, dstate), metrics
+                new_p, new_s, states, dstate, metrics, slot_m, verdict = \
+                    core(params, server_state, local_data, states,
+                         idx_r, act_r, work_r, rows_i, byz_i, ids_i,
+                         dstate, key_r, hyper_r)
+                return ((new_p, new_s, states, dstate),
+                        (metrics, slot_m, verdict))
 
-            (params, server_state, states, dstate), metrics = jax.lax.scan(
+            ((params, server_state, states, dstate),
+             (metrics, slot_mets, verdicts)) = jax.lax.scan(
                 one_round, (params, server_state, local_states, dstate),
                 (sched_idxs, sched_actives, sched_works, rows_r, byz_r,
                  ids_r, round_keys, round_idxs))
             states = jax.tree_util.tree_map(lambda a: a[None], states)
-            return params, server_state, states, dstate, metrics  # [R]
+            slot_mets = jax.tree_util.tree_map(lambda a: a[:, None],
+                                               slot_mets)  # [R, 1, S]
+            return (params, server_state, states, dstate, metrics,
+                    slot_mets, verdicts)  # metrics/verdicts: [R]
 
         shard_fn = shard_map(
             rounds_body,
@@ -779,7 +907,8 @@ class TPUSimulator:
                       P(None, AXIS_CLIENT), P(None, AXIS_CLIENT),
                       P(None, AXIS_CLIENT), P(), P(), P(), state_specs,
                       P(), P(), P()),
-            out_specs=(P(), P(), P(AXIS_CLIENT), state_specs, P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), state_specs, P(),
+                       P(None, AXIS_CLIENT), P()),
             check_vma=False,
         )
         return jax.jit(shard_fn,
@@ -866,13 +995,12 @@ class TPUSimulator:
         ``rows[k]`` is client k's row, ``byz[k]`` its byzantine-mask entry
         (zeros when no model attack is configured). Shared by the host-
         dispatch and fused robust paths — identical ordering is what makes
-        their defense verdicts comparable client-for-client."""
-        counts = [0] * self.n_devices
-        rows = []
-        for cid in sampled:
-            d = cid // self.cpd
-            rows.append(d * n_slots + counts[d])
-            counts[d] += 1
+        their defense verdicts comparable client-for-client. Derived from
+        the ONE slot-placement loop (``slot_placement``) so update rows,
+        schedules, and the selection subsystem's per-slot bookkeeping can
+        never drift apart."""
+        rows = [d * n_slots + s for _, d, s in
+                slot_placement(sampled, self.n_devices, self.cpd)]
         ids = np.asarray(sampled)
         if self.attacker.is_model_attack():
             byz = np.asarray(self.attacker.byzantine_mask(ids), np.float32)
@@ -937,19 +1065,28 @@ class TPUSimulator:
                 defense_key=jax.random.fold_in(round_key, DEFENSE_FOLD),
                 state=self._defense_state,
                 ids=jnp.asarray(ids, jnp.int32),
-                return_matrix=self.contribution.enabled)
+                return_matrix=self.contribution.enabled,
+                return_verdict=self.selection.track)
             if not isinstance(out, tuple):
                 out = (out,)
             vec = out[0]
+            pos = 1
             if stateful:
-                self._defense_state = out[1]
+                self._defense_state = out[pos]
+                pos += 1
             if self.contribution.enabled:
                 # the assessor must see the POST-ATTACK matrix the defense
                 # saw, still feature-sharded — scores come from the same
                 # on-device kernel as the fused path (self.params is still
                 # the round-start model here: _server_update runs later)
-                self._assess_contribution_fused(out[-1], w, sampled,
+                self._assess_contribution_fused(out[pos], w, sampled,
                                                 round_idx, self.params)
+                pos += 1
+            if self.selection.track:
+                self.selection.note_results(
+                    round_idx, sampled,
+                    slot_placement(sampled, self.n_devices, self.cpd),
+                    verdict=out[pos])
             agg = vector_to_tree_like(vec[:true_d], self.params)
             if self.dp.is_global_dp_enabled():
                 agg = self.dp.add_global_noise(
@@ -964,8 +1101,15 @@ class TPUSimulator:
             mat = self.attacker.poison_updates(
                 mat, ids, jax.random.fold_in(round_key, ATTACK_FOLD))
         if self.defender.is_defense_enabled():
-            vec, _ = self.defender.defend_matrix(
+            vec, info = self.defender.defend_matrix(
                 mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+            if self.selection.track:
+                verdict = _verdict_from_info(info, len(sampled))
+                if verdict is not None:
+                    self.selection.note_results(
+                        round_idx, sampled,
+                        slot_placement(sampled, self.n_devices, self.cpd),
+                        verdict=verdict)
         elif self.server_aggregator is not None:
             # user-pluggable hook chain (reference server_aggregator.py
             # :44/:75/:90) on the stacked matrix
@@ -1132,6 +1276,7 @@ class TPUSimulator:
         work = jax.device_put(jnp.asarray(work), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, round_idx)
         hyper_r = hyper.replace(round_idx=jnp.int32(round_idx))
+        placement = slot_placement(sampled, self.n_devices, self.cpd)
         if self.robust_fused:
             rows, byz = self._robust_rows(sampled, int(idx.shape[1]))
             dstate = (self._defense_state if self._defense_state is not None
@@ -1145,7 +1290,7 @@ class TPUSimulator:
                 jnp.asarray(byz), jnp.asarray(sampled, jnp.int32), dstate,
                 round_key, hyper_r)
             (self.params, self.server_state, self.client_states,
-             new_dstate, metrics) = out[:5]
+             new_dstate, metrics, slot_mets, verdict) = out[:7]
             if self._defense_state is not None:
                 self._defense_state = new_dstate
             if self.contribution.enabled:
@@ -1153,16 +1298,23 @@ class TPUSimulator:
                 # coalition values apply subsets of THIS round's updates to
                 # the round-start params (host-path semantics); only the
                 # [K] scores come host-side
-                self._assess_contribution_fused(out[5], out[6], sampled,
+                self._assess_contribution_fused(out[7], out[8], sampled,
                                                 round_idx, prev_params)
+            # device arrays only — materialized lazily at the next
+            # selection query, never a transfer inside run_round
+            self.selection.note_results(round_idx, sampled, placement,
+                                        slot_metrics=slot_mets,
+                                        verdict=verdict)
             self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
             return metrics
         if self.robust_mode:
             (upd_stack, w_stack, agg_extras, self.client_states,
-             metrics) = self._traced(
+             metrics, slot_mets) = self._traced(
                 "robust_collect", 1, self._round_fn,
                 self.params, self.server_state, self.train_data,
                 self.client_states, idx, active, work, round_key, hyper_r)
+            self.selection.note_results(round_idx, sampled, placement,
+                                        slot_metrics=slot_mets)
             agg_update = self._robust_aggregate(
                 upd_stack, w_stack, sampled, int(idx.shape[1]),
                 round_key, round_idx)
@@ -1173,10 +1325,12 @@ class TPUSimulator:
             self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
             return metrics
         (self.params, self.server_state, self.client_states,
-         metrics) = self._traced(
+         metrics, slot_mets) = self._traced(
             "round", 1, self._round_fn,
             self.params, self.server_state, self.train_data,
             self.client_states, idx, active, work, round_key, hyper_r)
+        self.selection.note_results(round_idx, sampled, placement,
+                                    slot_metrics=slot_mets)
         self.dp.record_round(len(sampled) / max(self.fed.num_clients, 1))
         return metrics
 
@@ -1191,24 +1345,43 @@ class TPUSimulator:
         return min(self.cpd, self._sample_n)
 
     def _schedule_for(self, round_idx: int, pad_to: Optional[int] = None):
-        sampled = client_sampling(round_idx, self.fed.num_clients,
-                                  self._sample_n)
+        # adaptive sizing REPLACES the static chaos_over_sample factor
+        # (documented semantics): its base is the raw per-round target,
+        # not the statically inflated one — otherwise the two compound
+        # and the cohort never shrinks below the static inflation even
+        # at an observed dropout of ~0
+        base = (self._base_n if self.selection.adaptive
+                else self._static_n)
+        target_n = self.selection.round_target(round_idx, base,
+                                               self._sample_n)
+        sampled, excluded = self.selection.select(round_idx, target_n)
         max_slots = min(self.cpd, self._sample_n)
         idx, active = build_schedule(sampled, self.n_devices, self.cpd,
                                      max_slots=max_slots)
         # chaos availability as DATA: per-slot work fractions next to the
-        # active mask (0 = dropped, (0,1) = straggler, 1 = healthy). The
-        # slot placement loop mirrors build_schedule's, so work[d, s]
+        # active mask (0 = dropped, (0,1) = straggler, 1 = healthy).
+        # Reputation-benched clients ride the SAME channel — work 0 is
+        # renormalized in-program dropout under chaos_tolerance, which is
+        # exactly how the byzantine-aware-dropout leftover closes: the
+        # benched client neither trains nor dilutes the denominator.
+        # slot_placement mirrors build_schedule's loop, so work[d, s]
         # lands on exactly the client idx[d, s] trains.
         work = np.ones_like(active)
         faults = None
-        if self.chaos.injects_availability:
-            faults = self.chaos.round_faults(round_idx, sampled)
-            counts = [0] * self.n_devices
-            for cid in sampled:
-                d = cid // self.cpd
-                work[d, counts[d]] = faults.scale_for(cid)
-                counts[d] += 1
+        excl = set(excluded)
+        work_by_client = {int(c): 1.0 for c in sampled}
+        if self.chaos.injects_availability or excl:
+            if self.chaos.injects_availability:
+                faults = self.chaos.round_faults(round_idx, sampled)
+            for cid, d, s in slot_placement(sampled, self.n_devices,
+                                            self.cpd):
+                w = faults.scale_for(cid) if faults is not None else 1.0
+                if cid in excl:
+                    w = 0.0
+                work[d, s] = w
+                work_by_client[cid] = w
+        self.selection.note_schedule(round_idx, sampled, excluded,
+                                     work_by_client, target_n)
         if pad_to is not None and idx.shape[1] < pad_to:
             extra = pad_to - idx.shape[1]
             idx = np.pad(idx, ((0, 0), (0, extra)))
@@ -1250,6 +1423,7 @@ class TPUSimulator:
                     for i in range(n_rounds)]
         idxs, acts, works, keys, ridxs, rows_r, byz_r, ids_r = (
             [], [], [], [], [], [], [], [])
+        sampled_r = []
         # every round pads to the simulator-canonical width (padded slots
         # carry active=0 and are masked in the round body): build_schedule
         # buckets slot counts per round (powers of two), and a per-block
@@ -1261,6 +1435,7 @@ class TPUSimulator:
             sampled, (idx, active, work), faults = self._schedule_for(
                 r, pad_to=width)
             self._ledger_round(r, sampled, active, work, faults)
+            sampled_r.append(sampled)
             idxs.append(idx)
             acts.append(active)
             works.append(work)
@@ -1288,7 +1463,7 @@ class TPUSimulator:
             dstate = (self._defense_state if self._defense_state is not None
                       else {})
             (self.params, self.server_state, self.client_states,
-             new_dstate, metrics) = self._traced(
+             new_dstate, metrics, slot_mets, verdicts) = self._traced(
                 "robust_rounds_fused", n_rounds, self._robust_fused_fn,
                 self.params, self.server_state, self.train_data,
                 self.client_states, idxs, acts, works,
@@ -1302,11 +1477,22 @@ class TPUSimulator:
             if not hasattr(self, "_fused_fn"):
                 self._fused_fn = self._build_fused_fn()
             (self.params, self.server_state, self.client_states,
-             metrics) = self._traced(
+             metrics, slot_mets) = self._traced(
                 "rounds_fused", n_rounds, self._fused_fn,
                 self.params, self.server_state, self.train_data,
                 self.client_states, idxs, acts, works, keys, ridxs,
                 hyper_0)
+            verdicts = None
+        if self.selection.track:
+            # queue each round's slice of the block outputs (lazy device
+            # slices; materialized at the next selection query)
+            for i, sampled in enumerate(sampled_r):
+                sm_i = jax.tree_util.tree_map(lambda a: a[i], slot_mets)
+                self.selection.note_results(
+                    start_round + i, sampled,
+                    slot_placement(sampled, self.n_devices, self.cpd),
+                    slot_metrics=sm_i,
+                    verdict=None if verdicts is None else verdicts[i])
         for _ in range(n_rounds):  # DP accounting stays per-round
             self.dp.record_round(part / n_rounds)
         host = jax.device_get(metrics)
@@ -1372,7 +1558,12 @@ class TPUSimulator:
                     logger.info("round %d: test_acc=%.4f", r,
                                 rec["test_acc"])
                 self.history.append(rec)
-                self.ckpt.maybe_save(r, self._ckpt_state())
+                if self.ckpt.enabled:
+                    # building the state dict is no longer free (a
+                    # stateful selection store flushes its device-array
+                    # observation queue) — skip it when checkpointing is
+                    # off rather than paying a readback per round
+                    self.ckpt.maybe_save(r, self._ckpt_state())
                 mlops.log_round_info(rounds, r)
                 mlops.log({k: v for k, v in rec.items() if k != "round"},
                           step=r)

@@ -665,3 +665,69 @@ def test_engine_ledger_reconciles_injected_and_observed(tmp_path):
     chaos_recs = [l for l in lines if l.get("kind") == "chaos"]
     assert len(chaos_recs) >= 4
     mlops.init(make_args(enable_tracking=False))  # detach the sink
+
+
+# --- chaos for the hierarchical and decentralized paths ----------------------
+# (ROADMAP leftover closed in ISSUE 5: the link-fault interceptor wraps
+# every FedMLCommManager subclass, and the gossip runtime retransmits
+# through injected loss via the shared backoff helper)
+
+class TestChaosHierarchicalAndDecentralized:
+    def test_gossip_session_survives_link_loss(self):
+        """Decentralized gossip has no server to time a round out — a lost
+        N2N_PARAMS frame used to deadlock both endpoints. The resend loop
+        (backoff-paced, idempotent receivers) must carry the session
+        through seeded loss + duplication."""
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.cross_silo.decentralized import run_gossip_inproc
+
+        args = make_args(
+            training_type="cross_silo", client_num_in_total=4,
+            client_num_per_round=4, comm_round=3, topology_neighbors=2,
+            chaos_link_loss_prob=0.15, chaos_link_dup_prob=0.1,
+            chaos_seed=13)
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        result = run_gossip_inproc(args, fed, bundle)
+        assert result is not None, "gossip session stalled under link loss"
+        assert result["rounds"] == 3
+        assert result["final_test_acc"] > 0.5
+
+    def test_gossip_resend_loop_off_without_link_faults(self):
+        """Without link-fault knobs the gossip node must not start the
+        resend machinery (default path unchanged)."""
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.cross_silo.decentralized import GossipNodeManager
+        from fedml_tpu.core.distributed.communication.inproc import (
+            InProcBroker)
+
+        args = make_args(training_type="cross_silo",
+                         client_num_in_total=3, client_num_per_round=3)
+        args.inproc_broker = InProcBroker()
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        node = GossipNodeManager(args, fed, bundle, rank=0, size=3,
+                                 backend="INPROC")
+        assert not node.chaos_plan.injects_link_faults
+        assert not isinstance(node.com_manager, ChaosCommManager)
+        node.com_manager.stop_receive_message()
+
+    def test_hierarchical_session_survives_link_loss(self):
+        """Hierarchical silos ride the same ClientMasterManager FSM: the
+        interceptor wraps their transports, and round timeout + quorum +
+        the ONLINE re-announce carry the session through injected loss."""
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.core.chaos import ChaosCommManager as CCM
+        from fedml_tpu.cross_silo.hierarchical.runner import (
+            run_hierarchical_cross_silo_inproc)
+
+        args = make_args(
+            training_type="cross_silo", client_num_in_total=4,
+            client_num_per_round=2, comm_round=2, round_timeout_s=20.0,
+            chaos_link_loss_prob=0.1, chaos_link_dup_prob=0.1,
+            chaos_seed=17)
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        result = run_hierarchical_cross_silo_inproc(args, fed, bundle)
+        assert result is not None, "hierarchical session stalled"
+        assert len(result["history"]) == 2

@@ -1,0 +1,648 @@
+"""Adaptive participant selection & client reputation (core/selection).
+
+Covers (1) the sampling-stream satellite — the legacy stream is
+bit-compatible with the reference's global-seed draw WITHOUT clobbering
+the process-global RNG, the seeded stream folds random_seed in; (2) the
+ClientStatsStore (Beta-posterior dropout, loss ring, latency EMA, AIMD
+reputation, checkpoint round-trip); (3) strategy behavior and determinism
+given (seed, observed history); (4) the engine seam — default knobs
+produce bit-identical schedules, reputation benches defense-excluded
+clients as renormalized in-program dropout, adaptive over-sampling grows
+the cohort from observed dropout, crash-resume replays identical
+selections, and the fused robust program still compiles exactly once with
+selection enabled; (5) the cross-silo silo-selection seam.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import fedml_tpu
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.selection import (ClientStatsStore, SelectionManager,
+                                      create_strategy, slot_placement)
+from fedml_tpu.simulation.sampling import (client_sampling,
+                                           sampling_stream_from_args)
+
+pytestmark = pytest.mark.selection
+
+
+def make_args(**kw):
+    base = dict(dataset="synthetic_mnist", model="lr",
+                client_num_in_total=8, client_num_per_round=8,
+                comm_round=3, epochs=1, batch_size=16, learning_rate=0.1,
+                frequency_of_the_test=2, random_seed=42)
+    base.update(kw)
+    return Arguments(**base)
+
+
+def build_sim(args):
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    spec = ClassificationTrainer(bundle.apply)
+    return TPUSimulator(args, fed, bundle, create_optimizer(args, spec),
+                        spec)
+
+
+def hyper_for(args):
+    from fedml_tpu.core.algframe.types import TrainHyper
+    return TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                      epochs=int(args.epochs))
+
+
+# --- sampling streams (satellite) -------------------------------------------
+
+class TestSamplingStreams:
+    def test_legacy_stream_matches_reference_draw(self):
+        """RandomState(round) must reproduce the exact sequence the old
+        np.random.seed(round) + global np.random.choice produced."""
+        for r in range(6):
+            np.random.seed(r)
+            ref = list(np.random.choice(range(20), 7, replace=False))
+            got = client_sampling(r, 20, 7, random_seed=123,
+                                  stream="legacy")
+            assert [int(c) for c in ref] == [int(c) for c in got]
+
+    def test_legacy_stream_does_not_clobber_global_rng(self):
+        np.random.seed(777)
+        expect = np.random.random(4)
+        np.random.seed(777)
+        client_sampling(3, 20, 7, stream="legacy")
+        got = np.random.random(4)
+        np.testing.assert_array_equal(expect, got)
+
+    def test_seeded_stream_respects_random_seed(self):
+        a = client_sampling(2, 30, 8, random_seed=1, stream="seeded")
+        b = client_sampling(2, 30, 8, random_seed=2, stream="seeded")
+        c = client_sampling(2, 30, 8, random_seed=1, stream="seeded")
+        assert a == c
+        assert a != b  # different seeds, different cohorts
+
+    def test_stream_knob_validated(self):
+        with pytest.raises(ValueError):
+            client_sampling(0, 10, 4, stream="mystery")
+        with pytest.raises(ValueError):
+            sampling_stream_from_args(make_args(sampling_stream="nope"))
+        assert sampling_stream_from_args(make_args()) == "legacy"
+
+
+# --- ClientStatsStore -------------------------------------------------------
+
+class TestStatsStore:
+    def test_dropout_posterior(self):
+        st = ClientStatsStore(4)
+        p0 = st.dropout_posterior_mean()[0]
+        assert 0.0 < p0 < 0.1  # weakly-informative prior
+        for _ in range(10):
+            st.record_availability(0, participated=False)
+            st.record_availability(1, participated=True)
+        post = st.dropout_posterior_mean()
+        assert post[0] > 0.3
+        assert post[1] < p0
+        assert 0.0 < st.population_dropout_mean() < 1.0
+
+    def test_loss_ring_and_queries(self):
+        st = ClientStatsStore(3, loss_window=4)
+        assert np.isinf(st.last_loss()[0])
+        assert np.isnan(st.rms_loss()[0])
+        for i, loss in enumerate([5.0, 4.0, 3.0, 2.0, 1.0]):
+            st.record_loss(0, loss)
+        assert st.last_loss()[0] == 1.0  # ring wrapped
+        assert np.isclose(st.rms_loss()[0],
+                          np.sqrt(np.mean(np.square([4.0, 3.0, 2.0, 1.0]))))
+        st.record_loss(1, float("nan"))  # ignored, not poisoning the ring
+        assert np.isinf(st.last_loss()[1])
+
+    def test_latency_ema(self):
+        st = ClientStatsStore(2, ema_alpha=0.5)
+        st.record_latency(0, 2.0)
+        assert st.ema_latency[0] == 2.0  # first sample seeds the EMA
+        st.record_latency(0, 4.0)
+        assert np.isclose(st.ema_latency[0], 3.0)
+
+    def test_reputation_normalized_posterior(self):
+        st = ClientStatsStore(4)
+        np.testing.assert_array_equal(st.reputation, np.ones(4))
+        for _ in range(6):  # client 0 always excluded, 1 and 2 kept
+            st.record_verdict([0, 1, 2], [0.0, 1.0, 1.0])
+        rep = st.reputation
+        assert rep[0] < 0.3  # consistently excluded vs cohort -> branded
+        assert rep[1] == 1.0 and rep[2] == 1.0
+        assert rep[3] == 1.0  # unobserved: innocent until evidence
+
+    def test_reputation_tolerates_harsh_selection_defense(self):
+        """krum keeps m of K every round, so honest clients are excluded
+        at the baseline rate too — the NORMALIZED posterior must not
+        brand them, only the consistently-worse-than-cohort client."""
+        st = ClientStatsStore(4)
+        rng = np.random.default_rng(0)
+        for _ in range(30):
+            # defense keeps 2 of 4; client 3 never kept, others rotate
+            kept = rng.choice(3, 2, replace=False)
+            v = np.zeros(4)
+            v[kept] = 1.0
+            st.record_verdict([0, 1, 2, 3], v)
+        rep = st.reputation
+        assert rep[3] < 0.3
+        assert np.all(rep[:3] > 0.6)
+
+    def test_state_dict_roundtrip_and_shape_guard(self):
+        st = ClientStatsStore(4, loss_window=3)
+        st.record_loss(2, 1.5)
+        st.record_availability(1, participated=False)
+        st.record_verdict([0], [0.0])
+        st2 = ClientStatsStore(4, loss_window=3)
+        st2.load_state_dict(st.state_dict())
+        for f in ClientStatsStore._FIELDS:
+            np.testing.assert_array_equal(getattr(st, f), getattr(st2, f))
+        with pytest.raises(ValueError):
+            ClientStatsStore(5, loss_window=3).load_state_dict(
+                st.state_dict())
+
+
+# --- strategies -------------------------------------------------------------
+
+class TestStrategies:
+    def test_uniform_is_bit_identical_to_client_sampling(self):
+        args = make_args(client_num_in_total=20, client_num_per_round=6)
+        strat = create_strategy(args, 20, ClientStatsStore(20))
+        for r in range(5):
+            sampled, excluded = strat.select(r, 6)
+            assert excluded == []
+            assert sampled == client_sampling(r, 20, 6, stream="legacy")
+
+    def test_power_of_choice_prefers_high_loss(self):
+        args = make_args(client_selection="power_of_choice",
+                         client_num_in_total=16, poc_d_factor=4.0)
+        st = ClientStatsStore(16)
+        for c in range(16):  # clients 12..15 have the highest losses
+            st.record_loss(c, float(c))
+        strat = create_strategy(args, 16, st)
+        sampled, _ = strat.select(0, 4)
+        # d=16 candidates == everyone, so top-4 by loss is exact
+        assert sorted(sampled) == [12, 13, 14, 15]
+
+    def test_oort_explores_then_exploits(self):
+        args = make_args(client_selection="oort", client_num_in_total=12,
+                         oort_explore_frac=0.5)
+        st = ClientStatsStore(12)
+        for c in range(6):  # half the population has history
+            st.record_selected(0, [c])
+            st.record_loss(c, 10.0 if c == 3 else 0.1)
+        strat = create_strategy(args, 12, st)
+        sampled, _ = strat.select(5, 4)
+        assert len(sampled) == len(set(sampled)) == 4
+        assert 3 in sampled  # highest-utility explored client
+        # explore slots went to never-selected clients
+        assert any(c >= 6 for c in sampled)
+
+    def test_strategies_deterministic_given_history(self):
+        for name in ("power_of_choice", "oort", "reputation"):
+            args = make_args(client_selection=name, client_num_in_total=16)
+            st = ClientStatsStore(16)
+            for c in range(16):
+                st.record_loss(c, float(16 - c))
+                st.record_selected(0, [c])
+            a = create_strategy(args, 16, st).select(7, 5)
+            b = create_strategy(args, 16, st).select(7, 5)
+            assert a == b
+
+    def test_reputation_benches_low_rep_with_floor(self):
+        args = make_args(client_selection="reputation",
+                         client_num_in_total=8, client_num_per_round=8,
+                         selection_rep_threshold=0.3,
+                         selection_min_keep_frac=0.5)
+        st = ClientStatsStore(8)
+        for _ in range(8):  # five clients consistently excluded, three kept
+            st.record_verdict(list(range(8)), [0.0] * 5 + [1.0] * 3)
+        strat = create_strategy(args, 8, st)
+        sampled, benched = strat.select(0, 8)
+        assert sorted(sampled) == list(range(8))
+        # five fall below the threshold, but the min-keep floor caps
+        # benching at half the cohort
+        assert len(benched) == 4
+        assert set(benched) <= set(range(5))
+
+    def test_unknown_strategy_raises(self):
+        with pytest.raises(ValueError):
+            create_strategy(make_args(client_selection="roulette"), 8,
+                            ClientStatsStore(8))
+
+
+# --- engine seam ------------------------------------------------------------
+
+class TestEngineSelection:
+    def test_default_schedules_bit_identical_to_legacy(self):
+        """uniform + legacy stream (the defaults) must reproduce the
+        pre-subsystem schedule EXACTLY: same sampled ids, same slot
+        tensors, work all-ones."""
+        args = make_args(client_num_in_total=8, client_num_per_round=5)
+        sim = build_sim(args)
+        assert not sim.selection.track  # passive at defaults
+        from fedml_tpu.simulation.sampling import build_schedule
+        for r in range(4):
+            sampled, (idx, active, work), faults = sim._schedule_for(r)
+            np.random.seed(r)  # the reference draw
+            ref = list(np.random.choice(range(8), 5, replace=False))
+            assert [int(c) for c in sampled] == [int(c) for c in ref]
+            ref_idx, ref_active = build_schedule(ref, sim.n_devices,
+                                                 sim.cpd,
+                                                 max_slots=sim.cpd)
+            np.testing.assert_array_equal(idx, ref_idx)
+            np.testing.assert_array_equal(active, ref_active)
+            assert np.all(work == 1.0)
+            assert faults is None
+
+    def test_default_run_params_unchanged_by_subsystem_knobs(self):
+        """Spelling the default selection knobs explicitly must not move
+        a single bit of the trajectory."""
+        r_plain = fedml_tpu.run_simulation(backend="tpu", args=make_args())
+        r_expl = fedml_tpu.run_simulation(backend="tpu", args=make_args(
+            client_selection="uniform", sampling_stream="legacy",
+            selection_adaptive_oversample=False))
+        for a, b in zip(jax.tree_util.tree_leaves(r_plain["params"]),
+                        jax.tree_util.tree_leaves(r_expl["params"])):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_reputation_benches_byzantine_clients_in_program(self):
+        """Deterministic byzantine clients (ids 0..1) + multi_krum: the
+        fused robust program's [K] verdict decays their reputation, and
+        after a few rounds the reputation strategy benches them as
+        work-0 slots (renormalized in-program dropout)."""
+        args = make_args(client_num_in_total=8, client_num_per_round=8,
+                         client_selection="reputation",
+                         enable_defense=True, defense_type="multi_krum",
+                         krum_param_m=6, byzantine_client_num=2,
+                         enable_attack=True, attack_type="byzantine_flip",
+                         attack_scale=5.0, comm_round=8)
+        sim = build_sim(args)
+        assert sim.robust_fused  # selection rides the fused program
+        hyper = hyper_for(args)
+        for r in range(6):
+            sim.run_round(r, hyper)
+        rep = sim.selection.store.reputation
+        assert rep[0] < 0.3 and rep[1] < 0.3
+        # honest clients stay above the bench threshold
+        assert np.all(rep[2:] > 0.3)
+        assert float(np.mean(rep[2:])) > 0.6
+        # the NEXT schedule benches them: their slots carry work 0
+        sampled, (idx, active, work), _ = sim._schedule_for(6)
+        benched_work = {cid: work[d, s] for cid, d, s in
+                        slot_placement(sampled, sim.n_devices, sim.cpd)}
+        assert benched_work[0] == 0.0 and benched_work[1] == 0.0
+        assert all(benched_work[c] == 1.0 for c in sampled
+                   if c not in (0, 1))
+
+    def test_adaptive_oversample_grows_cohort_from_posterior(self):
+        args = make_args(client_num_in_total=16, client_num_per_round=4,
+                         client_selection="uniform",
+                         selection_adaptive_oversample=True,
+                         selection_max_over_sample=1.0,
+                         chaos_dropout_prob=0.4, chaos_seed=11)
+        sim = build_sim(args)
+        assert sim.selection.adaptive and sim.selection.track
+        assert sim._sample_n == 8  # the cap, not the per-round draw
+        # round 0: no history yet -> prior-dominated, near the base
+        # (ceil(4 / (1 - 0.05-prior)) = 5)
+        assert sim.selection.round_target(0, 4, 8) <= 5
+        hyper = hyper_for(args)
+        for r in range(8):
+            sim.run_round(r, hyper)
+        # ~40% observed dropout -> posterior sizes the cohort up
+        target = sim.selection.round_target(8, 4, 8)
+        assert target >= 6
+        post = sim.selection.store.population_dropout_mean()
+        assert 0.2 < post < 0.6
+
+    def test_canonical_width_and_compile_once_with_selection(
+            self, xla_compile_counter):
+        """The fused robust program must compile exactly once per run with
+        selection + adaptive over-sampling enabled — cohort-size changes
+        ride the canonical width as masked padding, never a new shape."""
+        args = make_args(client_num_in_total=8, client_num_per_round=4,
+                         client_selection="oort",
+                         selection_adaptive_oversample=True,
+                         chaos_dropout_prob=0.25, chaos_seed=5,
+                         enable_defense=True, defense_type="multi_krum",
+                         krum_param_m=2, byzantine_client_num=1,
+                         comm_round=12)
+        sim = build_sim(args)
+        assert sim.robust_fused
+        hyper = hyper_for(args)
+        sim.run_rounds_fused(0, 4, hyper)  # warmup compiles everything
+        xla_compile_counter.reset()
+        sim.run_rounds_fused(4, 4, hyper)
+        sim.run_rounds_fused(8, 4, hyper)
+        assert xla_compile_counter.delta() == 0
+        assert sim.dispatch_stats["dispatches"] == 3
+
+    def test_crash_resume_replays_identical_selections(self, tmp_path):
+        """The store rides RoundCheckpointer: a crashed-and-resumed run
+        must keep selecting the SAME cohorts as the uninterrupted one."""
+        kw = dict(client_num_in_total=16, client_num_per_round=4,
+                  client_selection="power_of_choice",
+                  chaos_dropout_prob=0.2, chaos_seed=3, comm_round=8,
+                  checkpoint_every_rounds=2, frequency_of_the_test=100)
+        args_a = make_args(checkpoint_dir=str(tmp_path / "a"), **kw)
+        sim_a = build_sim(args_a)
+        sim_a.run()
+
+        from fedml_tpu.core.chaos import ChaosCrash
+        args_b = make_args(checkpoint_dir=str(tmp_path / "b"),
+                           chaos_crash_at_round=3, **kw)
+        crashed = False
+        try:
+            build_sim(args_b).run()
+        except ChaosCrash as e:
+            crashed = True
+            assert e.round_idx == 3
+        assert crashed
+        args_b2 = make_args(checkpoint_dir=str(tmp_path / "b"), **kw)
+        sim_b = build_sim(args_b2)
+        sim_b.run()  # resumes from the round-3 checkpoint (incl. store)
+        # identical post-run selection state => identical future cohorts.
+        # Cohort-driving counters must match EXACTLY; observed loss/EMA
+        # floats may drift at last-ulp scale between separately compiled
+        # program instances (amplified over post-restore rounds), which
+        # is outside the subsystem's determinism contract — the schedule
+        # comparison below is what guards against a drift large enough
+        # to flip a selection.
+        sa, sb = sim_a.selection.state_dict(), sim_b.selection.state_dict()
+        # these counters are written once per SELECTED (round, client):
+        # exact equality proves the resumed run's rounds 4-7 cohorts were
+        # identical to the uninterrupted run's — the replay claim
+        for field in ("loss_count", "loss_ptr", "times_selected",
+                      "last_selected", "drop_obs", "part_obs", "incl_obs",
+                      "excl_obs", "has_latency"):
+            np.testing.assert_array_equal(sa[field], sb[field],
+                                          err_msg=field)
+        for field in ("losses", "ema_latency", "ema_work"):
+            np.testing.assert_allclose(sa[field], sb[field], atol=1e-2,
+                                       err_msg=field)
+        # and selections are a pure function of (seed, round, store): a
+        # manager rebuilt from the checkpointed state must produce the
+        # same future cohorts as the live one
+        rebuilt = SelectionManager(args_b2, 16)
+        rebuilt.load_state_dict(sb)
+        for r in range(8, 12):
+            assert rebuilt.select(r, 4) == sim_b.selection.select(r, 4)
+
+    def test_selection_state_only_checkpointed_when_stateful(self):
+        sim = build_sim(make_args())
+        assert "selection" not in sim._ckpt_state()
+        sim2 = build_sim(make_args(client_selection="oort",
+                                   client_num_per_round=4))
+        st = sim2._ckpt_state()
+        assert "selection" in st
+        assert isinstance(st["selection"], dict)
+
+    def test_host_robust_path_feeds_reputation(self):
+        """sharded_defense: false (host kernels) still yields verdicts via
+        the defense info dict — reputation works on every robust path."""
+        args = make_args(client_num_in_total=8, client_num_per_round=8,
+                         client_selection="reputation",
+                         enable_defense=True, defense_type="multi_krum",
+                         krum_param_m=6, byzantine_client_num=2,
+                         enable_attack=True, attack_type="byzantine_flip",
+                         attack_scale=5.0, robust_fused="host")
+        sim = build_sim(args)
+        assert not sim.robust_fused
+        hyper = hyper_for(args)
+        for r in range(4):
+            sim.run_round(r, hyper)
+        sim.selection._flush()
+        rep = sim.selection.store.reputation
+        assert rep[0] < 1.0 and rep[1] < 1.0
+        assert np.all(rep[2:] >= rep[0])
+
+
+# --- sharded defense verdicts ----------------------------------------------
+
+class TestDefenseVerdicts:
+    def test_sharded_verdict_flags_byzantine_rows(self):
+        from fedml_tpu.core.mesh import build_mesh
+        from fedml_tpu.core.security.defense import sharded
+        from fedml_tpu.constants import AXIS_CLIENT
+        mesh = build_mesh(None)
+        rng = np.random.default_rng(0)
+        mat = rng.normal(size=(8, 32)).astype(np.float32)
+        mat[:2] += 50.0  # two obvious outliers
+        w = np.ones(8, np.float32)
+        vec, verdict = sharded.defend_matrix_sharded(
+            mesh, AXIS_CLIENT, jnp.asarray(mat), w, "multi_krum",
+            byzantine_count=2, multi_k=4, return_verdict=True)
+        v = np.asarray(verdict)
+        assert v.shape == (8,)
+        assert v[0] == 0.0 and v[1] == 0.0
+        assert int(np.sum(v)) == 4  # multi_k selected rows
+
+    def test_verdict_all_ones_for_coordinatewise_defense(self):
+        from fedml_tpu.core.mesh import build_mesh
+        from fedml_tpu.core.security.defense import sharded
+        from fedml_tpu.constants import AXIS_CLIENT
+        mesh = build_mesh(None)
+        mat = np.random.default_rng(1).normal(size=(6, 16)).astype(
+            np.float32)
+        _, verdict = sharded.defend_matrix_sharded(
+            mesh, AXIS_CLIENT, jnp.asarray(mat), np.ones(6, np.float32),
+            "coordinate_median", return_verdict=True)
+        np.testing.assert_array_equal(np.asarray(verdict), np.ones(6))
+
+
+# --- cross-silo silo selection ----------------------------------------------
+
+class TestSiloSelection:
+    def _agg(self, **kw):
+        from fedml_tpu.cross_silo.server.fedml_aggregator import (
+            FedMLAggregator)
+        args = make_args(training_type="cross_silo",
+                         client_num_per_round=4, **kw)
+        return FedMLAggregator(args, {"w": jnp.zeros(3)})
+
+    def test_uniform_never_benches(self):
+        agg = self._agg()
+        for _ in range(5):
+            agg.observe_round([1, 2], [1, 2, 3, 4])
+        assert agg.select_silos([1, 2, 3, 4]) == [1, 2, 3, 4]
+
+    def test_flaky_silo_benched_with_quorum_floor(self):
+        agg = self._agg(client_selection="reputation",
+                        round_quorum_frac=0.5)
+        for _ in range(10):  # silo 4 never reports
+            agg.observe_round([1, 2, 3], [1, 2, 3, 4])
+        assert agg.select_silos([1, 2, 3, 4]) == [1, 2, 3]
+        # min-keep floor: even if everyone looks flaky, quorum survives
+        for _ in range(20):
+            agg.observe_round([], [1, 2, 3, 4])
+        kept = agg.select_silos([1, 2, 3, 4])
+        assert len(kept) >= 2  # ceil(0.5 * 4)
+
+    def test_round_expected_shrinks_barrier(self):
+        agg = self._agg()
+        agg.set_round_expected(2)
+        agg.add_local_trained_result(1, {"w": jnp.ones(3)}, 1.0)
+        assert not agg.check_whether_all_receive()
+        agg.add_local_trained_result(2, {"w": jnp.ones(3)}, 1.0)
+        assert agg.check_whether_all_receive()
+        agg.aggregate()
+        # _reset_round restores the full-cohort barrier
+        assert agg._expected == agg.client_num
+
+    def test_upload_latency_observed(self):
+        agg = self._agg(client_selection="oort")
+        agg.observe_upload(2, 1.5)
+        agg.observe_upload(2, 2.5)
+        assert agg.silo_stats.has_latency[2] == 1.0
+        assert 1.5 <= agg.silo_stats.ema_latency[2] <= 2.5
+
+
+# --- mlops record -----------------------------------------------------------
+
+def test_log_selection_record(tmp_path):
+    import json
+    from fedml_tpu.core import mlops
+    args = make_args(log_file_dir=str(tmp_path), run_id="sel_test")
+    mlops.init(args)
+    try:
+        mlops.log_selection(round_idx=3, strategy="oort", sampled=[1, 2],
+                            excluded=[7], target_n=2,
+                            dropout_posterior=0.125)
+    finally:
+        # uninstall, not just close: a closed-but-installed sink would
+        # blow up every later test that emits a record
+        mlops._state["sink"].close()
+        mlops._state["sink"] = None
+        mlops._state["enabled"] = False
+    recs = [json.loads(l) for l in
+            open(tmp_path / "run_sel_test.jsonl")]
+    sel = [r for r in recs if r["kind"] == "selection"]
+    assert sel and sel[0]["strategy"] == "oort"
+    assert sel[0]["sampled"] == [1, 2] and sel[0]["excluded"] == [7]
+    assert sel[0]["round_idx"] == 3
+
+
+# --- review regressions ------------------------------------------------------
+
+class TestReviewRegressions:
+    def test_quorum_restored_after_benched_round(self):
+        """A quorum scaled down by set_round_expected must not leak into
+        later rounds that bench nobody."""
+        from fedml_tpu.cross_silo.server.fedml_aggregator import (
+            FedMLAggregator)
+        args = make_args(training_type="cross_silo",
+                         client_num_in_total=10, client_num_per_round=10,
+                         round_quorum_frac=0.8)
+        agg = FedMLAggregator(args, {"w": jnp.zeros(3)})
+        assert agg.quorum == 8
+        agg.set_round_expected(6)
+        assert agg.quorum == 5
+        agg.add_local_trained_result(1, {"w": jnp.ones(3)}, 1.0)
+        agg.aggregate()  # _reset_round
+        assert agg.quorum == 8 and agg._expected == 10
+
+    def test_benched_silo_not_branded_and_redeems(self):
+        """Dropout evidence comes from the SELECTED cohort only; a benched
+        silo that reports anyway heals its posterior (redemption)."""
+        from fedml_tpu.cross_silo.server.fedml_aggregator import (
+            FedMLAggregator)
+        agg = FedMLAggregator(
+            make_args(training_type="cross_silo", client_num_per_round=4,
+                      client_selection="reputation"),
+            {"w": jnp.zeros(3)})
+        # silo 4 benched (not in expected) and silent: NO evidence at all
+        agg.observe_round(reported=[1, 2, 3], expected=[1, 2, 3])
+        assert agg.silo_stats.drop_obs[4] == 0.0
+        assert agg.silo_stats.part_obs[4] == 0.0
+        # benched silo reports anyway: participation evidence (healing)
+        agg.observe_round(reported=[1, 2, 3, 4], expected=[1, 2, 3])
+        assert agg.silo_stats.part_obs[4] == 1.0
+        assert agg.silo_stats.drop_obs[4] == 0.0
+
+    def test_verdict_from_info_rejects_index_arrays(self):
+        """Host bulyan's info['selected'] carries top-theta row INDICES —
+        a shape-only check would brand arbitrary clients when theta == k;
+        only binary masks (and in-[0,1] continuous weights) qualify."""
+        from fedml_tpu.simulation.tpu.engine import _verdict_from_info
+        k = 4
+        # bulyan-style index array: shape (k,) but NOT a mask -> rejected
+        assert _verdict_from_info({"selected": np.array([2, 0, 3, 1])},
+                                  k) is None
+        # krum-style binary mask -> accepted
+        mask = np.array([0.0, 1.0, 1.0, 0.0])
+        np.testing.assert_array_equal(
+            _verdict_from_info({"selected": mask}, k), mask)
+        # continuous weights outside [0, 1] -> rejected; inside -> kept
+        assert _verdict_from_info({"fg_weights": np.array(
+            [0.5, 1.2, 0.1, 0.0])}, k) is None
+        w = np.array([0.5, 0.9, 0.1, 0.0], np.float32)
+        np.testing.assert_array_equal(
+            _verdict_from_info({"fg_weights": w}, k), w)
+        # wrong shape -> rejected
+        assert _verdict_from_info({"kept": np.ones(3)}, k) is None
+
+    def test_adaptive_pinned_under_fused_robust(self):
+        """The fused robust program bakes the [K] cohort shape into the
+        compiled defense kernel: a posterior-driven cohort-size flip
+        would crash the fused stack mid-block and recompile across
+        blocks, so adaptive over-sampling is PINNED (loudly) under
+        robust_fused — and a long run can no longer crash."""
+        args = make_args(client_num_in_total=8, client_num_per_round=4,
+                         selection_adaptive_oversample=True,
+                         chaos_dropout_prob=0.3, chaos_seed=2,
+                         enable_defense=True, defense_type="multi_krum",
+                         krum_param_m=2, byzantine_client_num=1,
+                         comm_round=24, frequency_of_the_test=1000)
+        sim = build_sim(args)
+        assert sim.robust_fused
+        assert not sim.selection.adaptive
+        assert sim._sample_n == sim._static_n
+        hyper = hyper_for(args)
+        for start in range(0, 24, 8):  # enough observations to have
+            sim.run_rounds_fused(start, 8, hyper)  # flipped an unpinned
+        assert sim.dispatch_stats["dispatches"] == 3  # target mid-run
+
+    def test_nonrobust_fused_adaptive_flip_keeps_compile_once(
+            self, xla_compile_counter):
+        """Without a defense the cohort-size flip rides canonical-width
+        padding: the target moves, the compiled shapes do not."""
+        args = make_args(client_num_in_total=16, client_num_per_round=4,
+                         selection_adaptive_oversample=True,
+                         chaos_dropout_prob=0.4, chaos_seed=11,
+                         comm_round=24, frequency_of_the_test=1000)
+        sim = build_sim(args)
+        assert sim.selection.adaptive  # no pin without robust fusion
+        hyper = hyper_for(args)
+        sim.run_rounds_fused(0, 8, hyper)  # warmup compiles everything
+        xla_compile_counter.reset()
+        sim.run_rounds_fused(8, 8, hyper)
+        sim.run_rounds_fused(16, 8, hyper)
+        assert xla_compile_counter.delta() == 0
+        # the adaptive target genuinely moved while shapes stayed put
+        assert sim.selection.round_target(24, 4, sim._sample_n) > 4
+
+    def test_reputation_refuses_intolerant_aggregation(self):
+        """Benching rides the work-0 channel, which only renormalizes
+        under chaos_tolerance — the intolerant combination would dilute
+        every round and must be refused, not silently degrade."""
+        with pytest.raises(ValueError, match="chaos_tolerance"):
+            build_sim(make_args(client_selection="reputation",
+                                chaos_tolerance=False))
+
+    def test_adaptive_base_replaces_static_over_sample(self):
+        """Adaptive sizing REPLACES chaos_over_sample (documented
+        semantics): at a cold-start posterior of ~5% the cohort sits near
+        k, not at the static 1.5k inflation."""
+        args = make_args(client_num_in_total=16, client_num_per_round=4,
+                         chaos_over_sample=0.5,
+                         selection_adaptive_oversample=True)
+        sim = build_sim(args)
+        assert sim._static_n == 6  # ceil(4 * 1.5): the static inflation
+        sampled, _, _ = sim._schedule_for(0)
+        assert len(sampled) <= 5  # ceil(4 / 0.95), NOT the static 6
