@@ -403,6 +403,145 @@ def bench_chaos_dropout(target_acc=0.90, max_rounds=80):
     }), flush=True)
 
 
+def bench_async_chaos(straggler_probs=(0.2, 0.4), sync_rounds=60,
+                      async_pours=100):
+    """Buffered-async axis (core/async_rounds, ISSUE 6): digits FedAvg+LR,
+    10 clients, seeded 10% dropout + straggler faults — the sync round
+    barrier vs ``round_mode: async_buffered`` (K=5 staleness-weighted
+    pours), measured as CLIENT UPDATES INCORPORATED PER SIMULATED HOUR on
+    the shared seeded arrival model (``core/async_rounds/arrivals.py``;
+    both legs train for real — the clock is simulated because one machine
+    serializes what a fleet runs in parallel).
+
+    Time semantics, per leg:
+
+    * sync (the PR 3 barrier): the round closes at a deadline T = 1.35x
+      the slowest client's healthy duration (a tuned ``round_timeout_s``);
+      stragglers (2.5x slowdown) miss it and their uploads are DROPPED
+      (the cross-silo stale-tag behavior), dropped clients stall the round
+      to T. The engine leg runs ``chaos_straggler_work: 0`` so training
+      matches the clock verdict exactly: a straggler contributes nothing.
+    * async: nobody waits — a straggler's update arrives 2.5x late and is
+      staleness-DOWN-WEIGHTED, never dropped; a dropped client's dispatch
+      is lost and the client redeems into the rotation after its duration.
+
+    The win must GROW with fault rate (4th acceptance criterion): sync
+    throughput falls as (1 - p_straggler) — every straggler is wasted
+    work plus a stalled barrier — while async only pays the (mild) extra
+    time the straggler spends training."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.core.async_rounds import client_durations
+    from fedml_tpu.core.chaos import FaultPlan
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.async_engine import AsyncBufferedSimulator
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    n_clients, k, p_drop, seed = 10, 5, 0.1, 7
+    durations = client_durations(n_clients, random_seed=0)
+    deadline = 1.35 * float(np.max(durations))
+
+    def build(extra):
+        args = Arguments(
+            dataset="digits", model="lr", client_num_in_total=n_clients,
+            client_num_per_round=n_clients, epochs=1, batch_size=32,
+            learning_rate=0.1, frequency_of_the_test=10_000, random_seed=0,
+            chaos_dropout_prob=p_drop, chaos_seed=seed, **extra)
+        fed, output_dim = load(args)
+        bundle = create(args, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        opt = create_optimizer(args, spec)
+        return args, fed, bundle, opt, spec
+
+    def eval_acc(sim):
+        stats = sim._evaluate(sim.params, sim.fed.test["x"],
+                              sim.fed.test["y"], sim.fed.test["mask"])
+        return float(stats["correct"]) / max(float(stats["count"]), 1.0)
+
+    def sync_leg(p_strag):
+        # straggler_work 0: a barrier-missed upload contributes nothing —
+        # training and the clock read the SAME plan verdicts
+        args, fed, bundle, opt, spec = build(dict(
+            comm_round=sync_rounds, chaos_straggler_prob=p_strag,
+            chaos_straggler_work=0.0))
+        sim = TPUSimulator(args, fed, bundle, opt, spec)
+        hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                           epochs=1)
+        plan = FaultPlan.from_args(args)
+        sim_t, updates = 0.0, 0
+        wall0 = time.perf_counter()
+        for r in range(sync_rounds):
+            sim.run_round(r, hyper)
+            healthy = [c for c in range(n_clients)
+                       if plan.work_scale(r, c) >= 1.0]
+            # any fault stalls the barrier to its deadline; an all-healthy
+            # round closes when its slowest member reports
+            sim_t += (deadline if len(healthy) < n_clients
+                      else float(np.max(durations[healthy])))
+            updates += len(healthy)
+        return {"updates_per_h": updates / sim_t * 3600.0,
+                "versions_per_h": sync_rounds / sim_t * 3600.0,
+                "final_acc": eval_acc(sim), "sim_t": sim_t,
+                "wall_s": time.perf_counter() - wall0,
+                "provenance": getattr(fed, "provenance", "real")}
+
+    def async_leg(p_strag):
+        args, fed, bundle, opt, spec = build(dict(
+            comm_round=async_pours, round_mode="async_buffered",
+            async_buffer_k=k, chaos_straggler_prob=p_strag,
+            chaos_straggler_work=0.4))  # 2.5x slowdown, full work
+        sim = AsyncBufferedSimulator(args, fed, bundle, opt, spec)
+        wall0 = time.perf_counter()
+        r = sim.run()
+        stal = [h["staleness_mean"] for h in sim.history]
+        return {"updates_per_h": (r["updates_aggregated"]
+                                  / r["virtual_time_s"] * 3600.0),
+                "versions_per_h": r["rounds"] / r["virtual_time_s"] * 3600.0,
+                "final_acc": r["final_test_acc"], "sim_t": r["virtual_time_s"],
+                "wall_s": time.perf_counter() - wall0,
+                "staleness_mean": float(np.mean(stal))}
+
+    legs = {}
+    for p in straggler_probs:
+        legs[p] = {"sync": sync_leg(p), "async": async_leg(p)}
+    p0 = straggler_probs[0]
+    ratios = {p: legs[p]["async"]["updates_per_h"]
+              / max(legs[p]["sync"]["updates_per_h"], 1e-9)
+              for p in straggler_probs}
+    rec = {
+        "metric": "fedavg_async_chaos_updates_per_hour",
+        "value": round(legs[p0]["async"]["updates_per_h"], 1),
+        "unit": (f"client updates incorporated per SIMULATED hour (digits "
+                 f"FedAvg+LR, 10 clients, K={k} buffered-async pours, "
+                 f"seeded {int(p_drop*100)}% dropout + "
+                 f"{int(p0*100)}% stragglers at 2.5x slowdown; sync "
+                 f"barrier deadline {deadline:.2f}s drops late uploads)"),
+        "vs_baseline": round(ratios[p0], 3),
+        "data_provenance": legs[p0]["sync"]["provenance"],
+    }
+    for p in straggler_probs:
+        tag = f"straggler_{int(p*100)}pct"
+        rec[f"{tag}_sync_updates_per_h"] = round(
+            legs[p]["sync"]["updates_per_h"], 1)
+        rec[f"{tag}_async_updates_per_h"] = round(
+            legs[p]["async"]["updates_per_h"], 1)
+        rec[f"{tag}_async_vs_sync"] = round(ratios[p], 3)
+        rec[f"{tag}_sync_final_acc"] = round(legs[p]["sync"]["final_acc"], 4)
+        rec[f"{tag}_async_final_acc"] = round(
+            legs[p]["async"]["final_acc"], 4)
+        rec[f"{tag}_async_staleness_mean"] = round(
+            legs[p]["async"]["staleness_mean"], 2)
+    rec["win_grows_with_fault_rate"] = bool(
+        ratios[straggler_probs[-1]] > ratios[p0])
+    print(json.dumps(rec), flush=True)
+
+
 def bench_chaos_selection(target_acc=0.90, max_rounds=80):
     """Participant-selection axis (core/selection, ISSUE 5): digits
     FedAvg+LR with PARTIAL participation (5 of 10 clients per round)
@@ -1003,6 +1142,7 @@ def run():
             ("fedavg_cross_silo_wire_bytes_per_round",
              bench_cross_silo_wire),
             ("fedavg_chaos_dropout_rounds_to_target", bench_chaos_dropout),
+            ("fedavg_async_chaos_updates_per_hour", bench_async_chaos),
             ("fedavg_chaos_selection_rounds_to_target",
              bench_chaos_selection),
             ("fedopt_shakespeare_rnn_rounds_per_hour",

@@ -148,11 +148,35 @@ _SCHEMA: Dict[str, Any] = {
     # ceil(frac * expected) silos reported; below quorum the server keeps
     # waiting (another timeout interval) instead of averaging a sliver
     "round_quorum_frac": 0.0,
+    # async_args — buffered-async rounds (core/async_rounds, FedBuff +
+    # FedAsync staleness decay). Default `sync` keeps every path
+    # bit-identical: the round barrier, FSM, and engine programs are
+    # untouched until the knob flips.
+    "round_mode": "sync",            # sync | async_buffered
+    "async_buffer_k": 0,             # pour trigger; 0 = half the cohort
+    "async_alpha": 0.6,              # FedAsync mixing rate for each pour
+    "async_staleness_weighting": "polynomial",  # constant|polynomial|hinge
+    "async_staleness_poly": 0.5,     # poly decay exponent / hinge slope
+    "async_hinge_b": 4,              # hinge: free staleness up to b versions
+    # staleness clamp before weighting (stale uploads are DOWN-WEIGHTED,
+    # never dropped); 0 = adaptive from observed arrival-rate posteriors
+    "async_staleness_cap": 16,
+    # cross-silo: pour whatever is buffered (>= 1 update) after this many
+    # seconds without reaching K; 0 falls back to round_timeout_s, then
+    # to a 30 s default — the liveness valve is never OFF in async mode
+    # (a decimated fleet must not stall the pour forever)
+    "async_pour_timeout_s": 0.0,
+    # simulated-arrival heterogeneity (async engine + SP toy durations)
+    "async_duration_sigma": 0.6,
     # comm retry policy (exponential backoff + jitter at the transport
-    # send seam; 0 attempts = fail fast like the pre-chaos transports)
+    # send seam; 0 attempts = fail fast like the pre-chaos transports).
+    # deadline_s caps the TOTAL retry budget in wall seconds — without it
+    # a long per-try timeout times max_attempts can stall an async pour
+    # far past usefulness; 0 = attempt-count bound only (legacy)
     "comm_retry_max_attempts": 4,
     "comm_retry_base_s": 0.2,
     "comm_retry_max_s": 2.0,
+    "comm_retry_deadline_s": 0.0,
     # tracking_args
     "enable_wandb": False,
     "log_file_dir": "~/.cache/fedml_tpu/logs",

@@ -255,6 +255,28 @@ class FaultLedger:
         mlops.log_chaos(round_idx=int(round_idx), injected=injected,
                         observed=observed)
 
+    def record_pour(self, version: int, arrivals: List[Dict[str, Any]],
+                    observed: Dict[str, Any]) -> None:
+        """One buffered-async pour: the per-update arrival records
+        (client, staleness at aggregation, arrival timestamp, dispatch
+        version) plus what the pour observed (count, leftover buffer,
+        staleness cap in force). This is what lets the bench and
+        post-mortems reconstruct the arrival distribution — and what the
+        soak test balances against the buffer's add/pour counters."""
+        rec = {"round_idx": int(version), "pour": True,
+               "injected": {"arrivals": list(arrivals)},
+               "observed": dict(observed)}
+        with self._lock:
+            self._rounds.append(rec)
+        from .. import mlops
+        mlops.log_chaos(round_idx=int(version),
+                        arrivals=list(arrivals),
+                        observed=dict(observed))
+
+    def pours(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r for r in self._rounds if r.get("pour")]
+
     def record_link(self, sender: int, receiver: int, msg_type: Any,
                     decision: LinkDecision) -> None:
         rec = {"sender": int(sender), "receiver": int(receiver),

@@ -48,8 +48,11 @@ def retry_with_backoff(
 ):
     """Run ``fn`` with up to ``max_attempts`` retries after the first try
     (``max_attempts=0`` = fail fast, the pre-chaos behavior). Stops early
-    when ``deadline_s`` (wall seconds from the first attempt) would be
-    exceeded. Re-raises the last failure."""
+    when ``deadline_s`` (wall seconds from the first attempt) is already
+    exceeded or would be by the next delay — the check counts time SPENT
+    INSIDE ``fn`` too, so a slow failing call (connect timeout) cannot
+    stretch the budget by arriving at the check late. Re-raises the last
+    failure."""
     delays = backoff_delays(base_s, factor, max_s, seed=seed)
     t0 = time.monotonic()
     attempt = 0
@@ -72,7 +75,15 @@ def retry_with_backoff(
 def retry_policy_from_args(args) -> dict:
     """The transport-level retry knobs (``comm_retry_*``) as kwargs for
     :func:`retry_with_backoff`; a single reading so TCP/gRPC/decentralized
-    can't drift apart on defaults."""
+    can't drift apart on defaults.
+
+    ``comm_retry_deadline_s`` caps the TOTAL elapsed retry budget (wall
+    seconds from the first attempt) on top of the attempt count: without
+    it, a long per-try timeout times ``max_attempts`` can stall a caller
+    — an async pour most of all — far past the point where retrying is
+    useful. 0 (the default) keeps the legacy attempt-count-only bound."""
+    deadline = float(getattr(args, "comm_retry_deadline_s", 0.0)
+                     if args is not None else 0.0)
     return {
         "max_attempts": int(getattr(args, "comm_retry_max_attempts", 4)
                             if args is not None else 4),
@@ -80,4 +91,5 @@ def retry_policy_from_args(args) -> dict:
                         if args is not None else 0.2),
         "max_s": float(getattr(args, "comm_retry_max_s", 2.0)
                        if args is not None else 2.0),
+        "deadline_s": deadline if deadline > 0 else None,
     }

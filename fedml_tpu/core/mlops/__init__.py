@@ -126,11 +126,17 @@ def log_comm_round(round_idx: int, wire_bytes: int,
 def log_chaos(round_idx: Optional[int] = None,
               injected: Optional[Dict[str, Any]] = None,
               observed: Optional[Dict[str, Any]] = None,
-              link: Optional[Dict[str, Any]] = None) -> None:
+              link: Optional[Dict[str, Any]] = None,
+              arrivals: Optional[list] = None) -> None:
     """Fault-ledger record from the chaos subsystem: what the
     :class:`~fedml_tpu.core.chaos.FaultPlan` injected this round vs what
     the runtime observed at the aggregation seam (or one link fault event).
-    A tolerance bug shows up as the two disagreeing in the run log."""
+    A tolerance bug shows up as the two disagreeing in the run log.
+
+    ``arrivals`` carries a buffered-async pour's per-update records
+    (client, staleness at aggregation time, arrival timestamp, dispatch
+    version) — the raw material for reconstructing arrival distributions
+    in post-mortems and the async bench."""
     rec: Dict[str, Any] = {}
     if round_idx is not None:
         rec["round_idx"] = int(round_idx)
@@ -140,6 +146,8 @@ def log_chaos(round_idx: Optional[int] = None,
         rec["observed"] = observed
     if link is not None:
         rec["link"] = link
+    if arrivals is not None:
+        rec["arrivals"] = arrivals
     _emit("chaos", rec)
 
 

@@ -160,6 +160,12 @@ class SelectionManager:
         if self.track:
             self.store.record_latency(client_id, latency_s)
 
+    def note_arrival(self, client_id: int, interarrival_s: float) -> None:
+        """Buffered-async arrival gap — the arrival-rate posterior's
+        evidence stream (async engine / cross-silo pour loop)."""
+        if self.track:
+            self.store.record_arrival(client_id, interarrival_s)
+
     def _flush(self) -> None:
         pending, self._pending = self._pending, []
         for rec in pending:

@@ -69,6 +69,12 @@ class ClientStatsStore:
         self.loss_ptr = np.zeros(n, np.int32)     # ring write cursor
         self.ema_latency = np.zeros(n, np.float32)
         self.has_latency = np.zeros(n, np.float32)
+        # arrival-rate posterior (buffered-async paths): inter-arrival EMA
+        # + observation count per client. 1/EMA is the arrival rate; with
+        # the pour interval it predicts a client's typical staleness —
+        # what the adaptive staleness cap and async-aware selection read
+        self.ema_interarrival = np.zeros(n, np.float32)
+        self.arr_obs = np.zeros(n, np.float32)
         self.ema_work = np.ones(n, np.float32)
         self.drop_obs = np.zeros(n, np.float32)   # observed dropouts
         self.part_obs = np.zeros(n, np.float32)   # observed participations
@@ -120,6 +126,41 @@ class ClientStatsStore:
         else:
             self.ema_latency[c] = lat
             self.has_latency[c] = 1.0
+
+    def record_arrival(self, client_id: int,
+                       interarrival_s: float) -> None:
+        """One observed gap between this client's consecutive update
+        arrivals (buffered-async paths). The EMA is the arrival-rate
+        posterior's point estimate."""
+        c = int(client_id)
+        gap = float(interarrival_s)
+        if not np.isfinite(gap) or gap <= 0.0:
+            return
+        if self.arr_obs[c] > 0:
+            a = self.ema_alpha
+            self.ema_interarrival[c] = ((1.0 - a) * self.ema_interarrival[c]
+                                        + a * gap)
+        else:
+            self.ema_interarrival[c] = gap
+        self.arr_obs[c] += 1.0
+
+    def arrival_rate(self) -> np.ndarray:
+        """[n] arrivals per unit time (1 / inter-arrival EMA); 0 for
+        never-observed clients — a client with no arrivals has no rate,
+        not an infinite one."""
+        with np.errstate(divide="ignore"):
+            rate = np.where(self.ema_interarrival > 0,
+                            1.0 / self.ema_interarrival, 0.0)
+        return np.where(self.arr_obs > 0, rate, 0.0).astype(np.float32)
+
+    def predicted_staleness(self, pour_interval_s: float) -> np.ndarray:
+        """[n] expected model-version lag of each client's next upload:
+        inter-arrival EMA over the pour interval. NaN for never-observed
+        clients (callers substitute their own prior)."""
+        if not np.isfinite(pour_interval_s) or pour_interval_s <= 0.0:
+            return np.full(self.n, np.nan, np.float32)
+        out = self.ema_interarrival / np.float32(pour_interval_s)
+        return np.where(self.arr_obs > 0, out, np.nan).astype(np.float32)
 
     def record_verdict(self, ids: Sequence[int],
                        verdict: Sequence[float]) -> None:
@@ -192,7 +233,11 @@ class ClientStatsStore:
     # --- persistence --------------------------------------------------------
     _FIELDS = ("losses", "loss_count", "loss_ptr", "ema_latency",
                "has_latency", "ema_work", "drop_obs", "part_obs",
-               "incl_obs", "excl_obs", "times_selected", "last_selected")
+               "incl_obs", "excl_obs", "times_selected", "last_selected",
+               "ema_interarrival", "arr_obs")
+    # fields added after checkpoints already existed in the wild: absent
+    # from an old state dict means "resume cold", not "refuse to load"
+    _OPTIONAL_FIELDS = ("ema_interarrival", "arr_obs")
 
     def state_dict(self) -> Dict[str, np.ndarray]:
         return {f: np.asarray(getattr(self, f)).copy() for f in self._FIELDS}
@@ -200,6 +245,8 @@ class ClientStatsStore:
     def load_state_dict(self, state: Dict[str, np.ndarray]) -> None:
         for f in self._FIELDS:
             if f not in state:
+                if f in self._OPTIONAL_FIELDS:
+                    continue
                 raise ValueError(f"selection state missing field {f!r}")
             cur = getattr(self, f)
             val = np.asarray(state[f], dtype=cur.dtype)

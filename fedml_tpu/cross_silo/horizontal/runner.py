@@ -50,9 +50,19 @@ def build_server(args, fed, bundle, spec=None, backend: Optional[str] = None,
     rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
     init_rng, _ = jax.random.split(rng)
     global_params = bundle.init(init_rng, fed.train.x[0, 0])
+    size = int(getattr(args, "client_num_per_round", 1)) + 1
+    from ...core.async_rounds import round_mode_from_args
+    if round_mode_from_args(args) == "async_buffered":
+        # buffered-async session: pours replace rounds (no barrier FSM)
+        from ..server.async_server import (AsyncFedMLAggregator,
+                                           AsyncFedMLServerManager)
+        aggregator = AsyncFedMLAggregator(args, global_params,
+                                          eval_fn=_make_eval_fn(spec, fed))
+        return AsyncFedMLServerManager(
+            args, aggregator, comm=comm, rank=0, size=size,
+            backend=backend or _wan_backend(args))
     aggregator = FedMLAggregator(args, global_params,
                                  eval_fn=_make_eval_fn(spec, fed))
-    size = int(getattr(args, "client_num_per_round", 1)) + 1
     return FedMLServerManager(
         args, aggregator, comm=comm, rank=0, size=size,
         backend=backend or _wan_backend(args))

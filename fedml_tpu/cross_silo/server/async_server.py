@@ -1,0 +1,433 @@
+"""Cross-silo buffered-async server — no round barrier, ever.
+
+``round_mode: async_buffered`` replaces the all-received barrier of the
+sync FSM (:mod:`.fedml_server_manager`) with a FedBuff pour loop: the
+server aggregates whenever K staleness-weighted uploads are buffered, and
+``comm_round`` counts POURS (global model versions). The sync FSM's
+failure machinery — round timers, quorum, grace intervals, stale-upload
+DROPS — is replaced wholesale, because its premises (a round, a cohort, a
+deadline) no longer exist:
+
+* **Stale uploads are down-weighted, never dropped.** Every sync/upload
+  carries the model version it was trained from; staleness at pour time
+  is ``server_version - upload_version``, weighted by the shared
+  ``core/async_rounds`` decay. A per-version base ring (bounded by the
+  staleness cap) lets the server form each silo's DELTA against the exact
+  base it trained from — dense and compressed uplinks alike — so a
+  straggler from five versions ago still contributes, just faintly.
+
+* **Crashed silos simply stop contributing.** Nothing waits for them; the
+  pour-timeout valve (``async_pour_timeout_s``) pours a partial buffer
+  (>= 1 update) so a decimated fleet keeps making progress, and an empty
+  fire re-broadcasts the current model to every online silo — the nudge
+  that recovers link-lost syncs without per-message bookkeeping. A silo
+  that re-announces ONLINE after the session started is immediately
+  handed the current model: the redemption path.
+
+* **Arrival-rate posteriors feed the staleness cap.** Per-silo upload
+  latencies (sync→receipt, clocked per-silo because broadcasts are no
+  longer simultaneous) land in the PR 5 stats store; with
+  ``async_staleness_cap: 0`` the cap tracks observed latency / pour
+  interval instead of a constant.
+
+Per-update arrival timestamps and staleness are recorded in the
+FaultLedger (``record_pour``) and mirrored to ``mlops.log_chaos`` so the
+bench and post-mortems can reconstruct the arrival distribution.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ...core import mlops
+from ...core.async_rounds import (UpdateBuffer, adaptive_staleness_cap,
+                                  buffer_k_from_args, make_staleness_fn,
+                                  merge_alpha_from_args, pour_weights,
+                                  staleness_cap_from_args,
+                                  staleness_fn_from_args,
+                                  weighting_knobs_from_args)
+from ...core.collectives import tree_flatten_to_vector, vector_to_tree_like
+from ...core.distributed.communication.message import (Message, tree_to_wire,
+                                                       wire_to_tree)
+from ...utils.compression import decompress_vec, is_compressed_payload
+from ..message_define import MyMessage
+from .fedml_aggregator import FedMLAggregator
+from .fedml_server_manager import FedMLServerManager
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncFedMLAggregator(FedMLAggregator):
+    """Buffered-async aggregation state: an :class:`UpdateBuffer` of silo
+    deltas plus the per-version base ring they are formed against."""
+
+    def __init__(self, args, global_params, eval_fn=None):
+        super().__init__(args, global_params, eval_fn=eval_fn)
+        if self.defender.is_defense_enabled() or self.dp.is_dp_enabled():
+            raise ValueError(
+                "round_mode: async_buffered does not yet compose with "
+                "defenses or DP on the cross-silo server (both assume a "
+                "same-version cohort); use round_mode: sync")
+        self.version = 0
+        self.k = buffer_k_from_args(args, self.client_num)
+        self.merge_alpha = merge_alpha_from_args(args)
+        self.staleness_fn = staleness_fn_from_args(args)
+        self.staleness_cap = staleness_cap_from_args(args)
+        self._cap_adaptive = int(getattr(args, "async_staleness_cap", 16)
+                                 or 0) == 0
+        self._weighting_args = args
+        self.buffer = UpdateBuffer(self.k)
+        self._pour_interval_ema: Optional[float] = None
+        self._last_pour_t: Optional[float] = None
+        # version -> host f32 model vector. Ring-bounded by the staleness
+        # cap: uploads older than the ring fall back to the OLDEST
+        # retained base — the residual base drift is folded into an update
+        # whose staleness weight is already saturated-tiny
+        self._base_ring: Dict[int, np.ndarray] = {
+            0: np.asarray(tree_flatten_to_vector(global_params),
+                          np.float32)}
+
+    # --- uploads ------------------------------------------------------------
+    def base_for(self, version: int) -> np.ndarray:
+        ring = self._base_ring
+        if int(version) in ring:
+            return ring[int(version)]
+        oldest = min(ring)
+        logger.warning(
+            "async upload from version %s predates the base ring "
+            "(oldest retained: %d) — using the oldest base; the update's "
+            "staleness weight is saturated anyway", version, oldest)
+        return ring[oldest]
+
+    def add_async_upload(self, rank: int, payload, sample_num: float,
+                         up_version: int, arrival_t: float,
+                         compressed: bool) -> int:
+        """Buffer one silo upload as a delta vs its dispatch base.
+        Returns the buffered count (the pour trigger reads it under the
+        same lock discipline as the add)."""
+        if compressed:
+            # a compressed upload IS the delta vs the broadcast the silo
+            # holds — exactly its dispatch base; no reconstruction needed
+            delta = np.asarray(payload, np.float32)
+        else:
+            # payload: the uploaded model as a flat f32 vector (callers
+            # flatten OUTSIDE any lock — see the manager) or a tree
+            vec = (np.asarray(payload, np.float32)
+                   if isinstance(payload, np.ndarray)
+                   else np.asarray(tree_flatten_to_vector(payload),
+                                   np.float32))
+            delta = vec - self.base_for(up_version)
+        self.buffer.add(int(rank), delta, weight=float(sample_num),
+                        version=int(up_version), arrival_t=float(arrival_t))
+        return len(self.buffer)
+
+    # --- the pour -----------------------------------------------------------
+    def pour(self, max_n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Aggregate up to K buffered deltas: staleness-weighted average,
+        damped by the merge scale, applied to the current global. Returns
+        the per-update arrival records (empty list = nothing to pour)."""
+        entries = self.buffer.pour(self.version, max_n=max_n)
+        if not entries:
+            return []
+        if self._cap_adaptive:
+            # arrival-rate posteriors -> staleness cap: observed silo
+            # latency over the pour interval is how many versions a
+            # routine upload lags
+            self.staleness_cap = adaptive_staleness_cap(
+                self.silo_stats.ema_latency[self.silo_stats.has_latency > 0],
+                self._pour_interval_ema or 0.0)
+            kind, poly_a, hinge_b = weighting_knobs_from_args(
+                self._weighting_args)
+            self.staleness_fn = make_staleness_fn(kind, poly_a, hinge_b,
+                                                  self.staleness_cap)
+        stal = np.asarray([e.staleness(self.version) for e in entries],
+                          np.float64)
+        w = np.asarray([e.weight for e in entries], np.float64)
+        norm_w, merge_scale = pour_weights(w, stal, self.staleness_fn,
+                                           self.merge_alpha)
+        agg = np.zeros(entries[0].update.shape, np.float32)
+        for nw, e in zip(norm_w, entries):
+            agg = agg + np.asarray(e.update, np.float32) * np.float32(nw)
+        base = self._base_ring[self.version]
+        new_vec = base + np.float32(merge_scale) * agg
+        self.global_params = jax.tree_util.tree_map(
+            np.asarray,
+            vector_to_tree_like(np.asarray(new_vec), self.global_params))
+        self.version += 1
+        self._base_ring[self.version] = np.asarray(new_vec, np.float32)
+        for v in [v for v in self._base_ring
+                  if v < self.version - self.staleness_cap]:
+            del self._base_ring[v]
+        now = time.time()
+        if self._last_pour_t is not None:
+            dt = now - self._last_pour_t
+            self._pour_interval_ema = (
+                dt if self._pour_interval_ema is None
+                else 0.8 * self._pour_interval_ema + 0.2 * dt)
+        self._last_pour_t = now
+        return [{"client": e.client_id, "staleness": int(s),
+                 "arrival_t": e.arrival_t, "dispatch_version": e.version,
+                 "weight": e.weight, "norm_weight": float(nw),
+                 "merge_scale": float(merge_scale)}
+                for e, s, nw in zip(entries, stal, norm_w)]
+
+
+class AsyncFedMLServerManager(FedMLServerManager):
+    """Rank 0 of an async session. The sync FSM's round machinery is
+    inert here — this class overrides the two seams that drove it (the
+    upload handler and the post-aggregation sync) with the pour loop."""
+
+    DEFAULT_POUR_TIMEOUT_S = 30.0
+
+    def __init__(self, args, aggregator, comm=None, rank: int = 0,
+                 size: int = 0, backend: str = "INPROC"):
+        super().__init__(args, aggregator, comm=comm, rank=rank, size=size,
+                         backend=backend)
+        if not isinstance(aggregator, AsyncFedMLAggregator):
+            raise ValueError("AsyncFedMLServerManager needs an "
+                             "AsyncFedMLAggregator")
+        if self.cc_spec is not None \
+                and str(getattr(self.cc_spec, "broadcast", "full")) != "full":
+            raise ValueError(
+                "round_mode: async_buffered needs a dense broadcast "
+                "(comm_compression_broadcast: full): bf16/compressed "
+                "downlinks track ONE shared client reconstruction, but "
+                "async silos are synced at different versions")
+        self._pour_lock = threading.Lock()
+        # timer cancel/replace must be atomic: an upload thread re-arming
+        # after a pour races the timer thread re-arming after an empty
+        # fire — unsynchronized, both cancel the same old timer and one
+        # of the two replacements is orphaned alive, firing spuriously
+        self._timer_lock = threading.Lock()
+        self._pour_timer: Optional[threading.Timer] = None
+        self._done = False
+        # per-silo sync timestamps: broadcasts are no longer simultaneous,
+        # so upload latency must be clocked against the silo's OWN sync.
+        # _outstanding tracks silos with a sync awaiting an upload — a
+        # re-sync of such a silo keeps the FIRST timestamp (re-clocking
+        # would understate a slow silo's latency and shrink the adaptive
+        # staleness cap in exactly the wrong direction)
+        self._sync_t: Dict[int, float] = {}
+        self._outstanding: Dict[int, int] = {}
+        self._empty_fires = 0
+        self._last_arrival: Dict[int, float] = {}
+        # liveness valve fallback chain: async_pour_timeout_s ->
+        # round_timeout_s -> a positive default. It must NOT bottom out at
+        # 0 (both knobs default to 0): with K silos crashed the pour
+        # trigger can never fire, and without a timer the session would
+        # hang forever — the exact failure mode this mode exists to remove
+        t = float(getattr(args, "async_pour_timeout_s", 0.0) or 0.0)
+        self.pour_timeout_s = (t if t > 0 else self.round_timeout_s
+                               if self.round_timeout_s > 0
+                               else self.DEFAULT_POUR_TIMEOUT_S)
+
+    # --- handshake + redemption ---------------------------------------------
+    def handle_message_client_status_update(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        already = bool(self.client_online_status.get(sender))
+        super().handle_message_client_status_update(msg)
+        if self.is_initialized and already and not self._done:
+            # a silo re-announcing ONLINE mid-session is a reconnect
+            # (crash-recovered process, healed link): hand it the current
+            # model so it rejoins the rotation — redemption, not a replay
+            logger.info("async server: silo %s reconnected — syncing "
+                        "version %d", sender, self.aggregator.version)
+            self._sync_ranks([sender])
+
+    def send_init_msg(self) -> None:
+        client_indexes = self.aggregator.client_selection(
+            0, int(self.args.client_num_in_total), self.client_num)
+        wire = tree_to_wire(self.aggregator.global_params)
+        self._round_targets = sorted(self.client_online_status)
+        now = time.time()
+        for i, rank in enumerate(self._round_targets):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank,
+                          rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                           self.aggregator.version)
+            self._sync_t[rank] = now
+            self._outstanding[rank] = self.aggregator.version
+            self.send_message(msg)
+        self._arm_pour_timer()
+
+    # --- the async upload seam ----------------------------------------------
+    def handle_message_receive_model_from_client(self, msg: Message) -> None:
+        if self._done:
+            return
+        sender = msg.get_sender_id()
+        if sender not in self._outstanding:
+            # replay guard: every sync to a silo expects exactly ONE
+            # upload (popped below on first receipt). A second copy —
+            # chaos link duplication, a transport retry whose first copy
+            # was delivered, a slow silo answering both the original sync
+            # and a timeout nudge — would double that silo's weight in
+            # the pour and corrupt the arrival-rate EMA with a near-zero
+            # gap. The sync path's stale-tag drop played this role; the
+            # async path replaces it with the outstanding marker.
+            logger.warning(
+                "async server: dropping upload from silo %s with no "
+                "outstanding sync (duplicate or replayed copy)", sender)
+            return
+        recv_t = time.time()
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
+        update = msg.get(MyMessage.MSG_ARG_KEY_MODEL_UPDATE)
+        up_version = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        up_version = (self.aggregator.version if up_version is None
+                      else int(up_version))
+        # deserialize + flatten OUTSIDE the pour lock: full-model wire
+        # decodes are model-sized work, and doing them under the lock
+        # would serialize every transport thread behind every pour —
+        # inflating the very arrival latencies the adaptive staleness
+        # cap is estimated from. Only the base-ring read + buffer add
+        # (cheap) need the lock.
+        if is_compressed_payload(update):
+            payload, compressed = decompress_vec(update), True
+        else:
+            wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+            payload = np.asarray(tree_flatten_to_vector(
+                wire_to_tree(wire, self.aggregator.global_params)),
+                np.float32)
+            compressed = False
+        with self._pour_lock:
+            buffered = self.aggregator.add_async_upload(
+                sender, payload, n, up_version, recv_t,
+                compressed=compressed)
+        # arrival-rate observations: latency vs this silo's OWN sync,
+        # inter-arrival gap (the arrival-rate posterior), and
+        # participation evidence for the dropout posterior
+        t0 = self._sync_t.get(sender)
+        if t0 is not None:
+            self.aggregator.observe_upload(sender, recv_t - t0)
+        self._outstanding.pop(sender, None)
+        prev = self._last_arrival.get(sender)
+        if prev is not None and 0 <= int(sender) < \
+                self.aggregator.silo_stats.n:
+            self.aggregator.silo_stats.record_arrival(sender,
+                                                      recv_t - prev)
+        self.aggregator.observe_round([sender], [sender])
+        self._last_arrival[sender] = recv_t
+        if buffered >= self.aggregator.k:
+            self._pour(reason="buffer")
+
+    # --- the pour loop ------------------------------------------------------
+    def _arm_pour_timer(self) -> None:
+        if self.pour_timeout_s <= 0 or self._done:
+            return
+        with self._timer_lock:
+            if self._done:
+                return
+            if self._pour_timer is not None:
+                self._pour_timer.cancel()
+            self._pour_timer = threading.Timer(self.pour_timeout_s,
+                                               self._on_pour_timeout)
+            self._pour_timer.daemon = True
+            self._pour_timer.start()
+
+    def _on_pour_timeout(self) -> None:
+        if self._done:
+            return
+        if len(self.aggregator.buffer) >= 1:
+            # liveness valve: a decimated fleet (crashes, drops) may never
+            # fill K — pour what arrived rather than stalling the session
+            self._pour(reason="timeout")
+        else:
+            # empty fire: nothing arrived within the window. Silos with NO
+            # outstanding sync are idle for lack of a model — re-sync them
+            # always. Silos with a sync outstanding are either slow (still
+            # training — leave them alone, a re-sync would just queue
+            # duplicate work) or lost their sync/upload to the link — give
+            # those a nudge only every SECOND empty fire, so a genuinely
+            # slow silo is at most halved into duplicates while a
+            # link-lost one still recovers
+            self._empty_fires += 1
+            online = sorted(self.client_online_status)
+            idle = [r for r in online if r not in self._outstanding]
+            nudge = idle if self._empty_fires % 2 else online
+            logger.warning(
+                "async server: pour timeout with empty buffer at version "
+                "%d — re-syncing %s (of %d online, %d outstanding)",
+                self.aggregator.version, nudge, len(online),
+                len(self._outstanding))
+            self._sync_ranks(nudge)
+            self._arm_pour_timer()
+
+    def _pour(self, reason: str) -> None:
+        with self._pour_lock:
+            if self._done:
+                return
+            arrivals = self.aggregator.pour()
+            if not arrivals:
+                self._arm_pour_timer()
+                return
+            version = self.aggregator.version  # post-pour version
+            self.chaos_ledger.record_pour(
+                version - 1, arrivals,
+                observed={"poured": len(arrivals),
+                          "buffered": len(self.aggregator.buffer),
+                          "reason": reason,
+                          "staleness_cap": self.aggregator.staleness_cap})
+            contributors = sorted({int(a["client"]) for a in arrivals})
+        freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
+        rec: Dict[str, Any] = {
+            "round": version - 1, "poured": len(arrivals),
+            "staleness_mean": float(np.mean([a["staleness"]
+                                             for a in arrivals])),
+            "staleness_max": int(max(a["staleness"] for a in arrivals)),
+        }
+        if freq > 0 and ((version - 1) % freq == 0
+                         or version >= self.round_num):
+            stats = self.aggregator.test_on_server()
+            if stats:
+                rec.update(stats)
+                logger.info("async server pour %d (staleness mean %.2f): "
+                            "%s", version - 1, rec["staleness_mean"], stats)
+        self.history.append(rec)
+        mlops.log_round_info(self.round_num, version - 1)
+        if version >= self.round_num:
+            self.finish_session()
+            return
+        self._sync_ranks(contributors)
+        self._arm_pour_timer()
+
+    def _sync_ranks(self, ranks: List[int]) -> None:
+        """Hand the CURRENT model to the given silos (the ones whose
+        updates were just consumed, a reconnecting silo, or — on an empty
+        timeout — everyone). Version rides every sync; uploads echo it."""
+        if not ranks:
+            return
+        version = self.aggregator.version
+        client_indexes = self.aggregator.client_selection(
+            version, int(self.args.client_num_in_total), self.client_num)
+        wire = tree_to_wire(self.aggregator.global_params)
+        now = time.time()
+        for i, rank in enumerate(ranks):
+            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                          self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
+            if rank not in self._outstanding:
+                # first sync of this outstanding period wins the clock: a
+                # timeout-nudge re-sync must not re-zero a slow silo's
+                # observed latency
+                self._sync_t[rank] = now
+            self._outstanding[rank] = version
+            self.send_message(msg)
+
+    def finish_session(self) -> None:
+        self._done = True
+        with self._timer_lock:
+            if self._pour_timer is not None:
+                self._pour_timer.cancel()
+                self._pour_timer = None
+        super().finish_session()

@@ -101,3 +101,37 @@ class FedOptimizer:
         """``agg_update`` and ``agg_extras`` are already weight-averaged by
         the engine (Σ n_k x_k / Σ n_k)."""
         return tree_add(params, agg_update), server_state
+
+    def server_update_async(
+        self,
+        params: PyTree,
+        server_state: PyTree,
+        agg_update: PyTree,
+        agg_extras: Dict[str, Any],
+        round_idx: jnp.ndarray,
+        merge_scale: jnp.ndarray,
+        pour_frac: jnp.ndarray,
+    ) -> Tuple[PyTree, PyTree]:
+        """Buffered-async server transform (``round_mode: async_buffered``).
+
+        ``agg_update``/``agg_extras`` are the staleness-weighted average of
+        one poured buffer; ``merge_scale`` is the pour's absolute damping
+        (FedAsync's ``alpha * s(staleness)`` generalized to a K-buffer:
+        ``alpha * Σ w·s / Σ w``) and ``pour_frac`` the poured fraction of
+        the population (``K / N`` — what replaces the sync cohort fraction
+        in participation-scaled state updates). Both are traced scalars
+        (DATA), so per-pour staleness never recompiles the program.
+
+        Default correction: damp the aggregate (and extras) by
+        ``merge_scale`` and reuse the sync transform — exact for
+        linear-in-the-update transforms (FedAvg/FedProx/FedSGD, SCAFFOLD's
+        ``c`` update via the damped extras). Optimizers whose server step
+        is NOT linear in its input override this (FedOpt's adaptive
+        optimizers normalize away input scale)."""
+        del pour_frac  # linear transforms need no separate fraction
+        scaled = jax.tree_util.tree_map(
+            lambda u: u * merge_scale.astype(u.dtype), agg_update)
+        scaled_ex = jax.tree_util.tree_map(
+            lambda e: e * merge_scale.astype(e.dtype), agg_extras)
+        return self.server_update(params, server_state, scaled, scaled_ex,
+                                  round_idx)

@@ -64,6 +64,24 @@ class FedOpt(FedOptimizer):
             pseudo_grad, server_state["opt_state"], params)
         return optax.apply_updates(params, updates), {"opt_state": opt_state}
 
+    def server_update_async(self, params, server_state, agg_update,
+                            agg_extras, round_idx, merge_scale, pour_frac):
+        """Staleness correction for ADAPTIVE server optimizers: adam/yogi
+        normalize the step by running second moments, so scaling the
+        pseudo-gradient (the base-class default) would be erased by the
+        normalization — a pour of ancient updates would move the model at
+        full rate. Damp the APPLIED STEP instead: moments accumulate the
+        undamped pseudo-gradient (they estimate its statistics, which
+        staleness does not change), the parameter step is scaled by
+        ``merge_scale``."""
+        del pour_frac
+        pseudo_grad = jax.tree_util.tree_map(lambda u: -u, agg_update)
+        updates, opt_state = self.server_opt.update(
+            pseudo_grad, server_state["opt_state"], params)
+        damped = jax.tree_util.tree_map(
+            lambda u: u * merge_scale.astype(u.dtype), updates)
+        return optax.apply_updates(params, damped), {"opt_state": opt_state}
+
 
 @register
 class FedSGD(FedOptimizer):

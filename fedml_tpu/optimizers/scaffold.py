@@ -90,3 +90,22 @@ class SCAFFOLD(FedOptimizer):
             lambda cc, dc: cc + frac.astype(cc.dtype) * dc,
             server_state["c"], agg_extras["delta_c"])
         return new_params, {"c": new_c}
+
+    def server_update_async(self, params, server_state, agg_update,
+                            agg_extras, round_idx, merge_scale, pour_frac):
+        """Staleness correction: the params step is the damped aggregate
+        (linear — same as the base default), but the control variate must
+        advance by the POURED population fraction (``K / N``), not the
+        sync cohort fraction baked into ``self.participation`` — a K-sized
+        pour carries K clients' worth of drift evidence regardless of how
+        many are concurrently in flight. ``delta_c`` is damped by the same
+        ``merge_scale`` as the update: stale drift estimates are as
+        outdated as stale updates."""
+        lr_g = jnp.float32(self.server_lr)
+        new_params = jax.tree_util.tree_map(
+            lambda w, u: w + (lr_g * merge_scale).astype(w.dtype) * u,
+            params, agg_update)
+        new_c = jax.tree_util.tree_map(
+            lambda cc, dc: cc + (pour_frac * merge_scale).astype(cc.dtype)
+            * dc, server_state["c"], agg_extras["delta_c"])
+        return new_params, {"c": new_c}

@@ -59,11 +59,29 @@ class FedMLRunner:
                 self.client_trainer, self.server_aggregator)
         raise ValueError(f"unknown training_type {ttype!r}")
 
+    # federated_optimizer values that dispatch to dedicated protocol
+    # simulators below — none of them runs the TPU engine, so none can
+    # honor `round_mode: async_buffered`; refuse the combination loudly
+    # instead of silently running the protocol's own (synchronous) loop
+    _PROTOCOL_FOS = frozenset((
+        "centralized", "fedgkt", "fednas", "fedseg", "fedgan",
+        "hierarchicalfl", "async_fedavg", "asyncfedavg",
+        "decentralized_fl", "split_nn", "classical_vertical",
+        "vertical_fl", "vfl", "turbo_aggregate", "turboaggregate"))
+
     def _build_simulator(self, args):
         from .core.algframe.client_trainer import make_trainer_spec
         from .optimizers.registry import create_optimizer
         fed, bundle = self.dataset, self.model
         fo = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        from .core.async_rounds import round_mode_from_args
+        async_mode = round_mode_from_args(args) == "async_buffered"
+        if async_mode and fo in self._PROTOCOL_FOS:
+            raise ValueError(
+                f"round_mode: async_buffered is a TPU-engine mode; "
+                f"federated_optimizer {fo!r} runs its own protocol "
+                "simulator and would silently ignore it (the SP async "
+                "equivalent is federated_optimizer: Async_FedAvg)")
         if fo == "centralized":
             from .centralized import CentralizedTrainer
             return CentralizedTrainer(args, fed, bundle)
@@ -111,8 +129,17 @@ class FedMLRunner:
         opt = create_optimizer(args, spec)
         backend = getattr(args, "backend", FEDML_SIMULATION_TYPE_TPU)
         if backend == FEDML_SIMULATION_TYPE_SP:
+            if async_mode:
+                raise ValueError(
+                    "round_mode: async_buffered is a TPU-engine mode; the "
+                    "SP equivalent is federated_optimizer: Async_FedAvg")
             from .simulation.sp.simulator import SPSimulator
             return SPSimulator(args, fed, bundle, opt, spec)
+        if async_mode:
+            from .simulation.tpu.async_engine import AsyncBufferedSimulator
+            return AsyncBufferedSimulator(
+                args, fed, bundle, opt, spec,
+                server_aggregator=self.server_aggregator)
         from .simulation.tpu.engine import TPUSimulator
         return TPUSimulator(args, fed, bundle, opt, spec,
                             server_aggregator=self.server_aggregator)

@@ -8,7 +8,12 @@ durations and drives an event queue; local training stays the shared jitted
 step (SURVEY §2.8: async dispatch is host-side, outside jit, by design).
 
 Merge rule (FedAsync, Xie et al.): w <- (1-a_t) w + a_t w_k with
-a_t = alpha * (1 + t - t_k)^(-poly_a).
+a_t = alpha * s(t - t_k), where s(.) is the shared staleness-decay family
+from ``core/async_rounds`` (polynomial by default — the toy's historical
+``(1 + staleness)^(-poly_a)``; constant/hinge ride the same knobs as the
+production ``round_mode: async_buffered`` paths). One staleness
+implementation for the SP toy, the TPU engine, and the cross-silo server —
+their decay curves can no longer drift apart.
 """
 
 from __future__ import annotations
@@ -24,6 +29,9 @@ import numpy as np
 
 from ...core.algframe.types import TrainHyper
 from ...core.algframe.local_training import evaluate
+from ...core.async_rounds import (durations_from_args,
+                                  merge_alpha_from_args,
+                                  staleness_fn_from_args)
 
 logger = logging.getLogger(__name__)
 
@@ -34,17 +42,17 @@ class AsyncFedAvgSimulator:
         self.fed = fed_dataset
         self.opt = optimizer
         self.spec = spec
-        self.alpha = float(getattr(args, "async_alpha", 0.6) or 0.6)
-        self.poly_a = float(getattr(args, "async_staleness_poly", 0.5) or 0.5)
+        self.alpha = merge_alpha_from_args(args)
+        self.staleness_fn = staleness_fn_from_args(args)
         self.rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
         init_rng, self.rng = jax.random.split(self.rng)
         self.params = bundle.init(init_rng, fed_dataset.train.x[0, 0])
         self._local_train = jax.jit(self.opt.local_train)
         self._evaluate = jax.jit(lambda p, x, y, m: evaluate(spec, p, x, y, m))
-        # per-client simulated round duration: heterogeneous, seeded
-        dr = np.random.RandomState(int(getattr(args, "random_seed", 0)))
-        self.durations = 1.0 + dr.lognormal(0.0, 0.6,
-                                            size=fed_dataset.num_clients)
+        # per-client simulated round duration: heterogeneous, drawn from
+        # the shared seeded arrival model (PR 5 stream discipline —
+        # default_rng((random_seed, tag)), a pure function of the seed)
+        self.durations = durations_from_args(fed_dataset.num_clients, args)
         self.history: List[Dict[str, Any]] = []
 
     def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
@@ -75,7 +83,7 @@ class AsyncFedAvgSimulator:
                 jax.tree_util.tree_map(lambda a: a[cid], self.fed.train),
                 key, hyper.replace(round_idx=jnp.int32(merges)))
             staleness = version - dispatched_version
-            a_t = self.alpha * (1.0 + staleness) ** (-self.poly_a)
+            a_t = self.alpha * float(self.staleness_fn(staleness))
             self.params = jax.tree_util.tree_map(
                 lambda w, u: w + jnp.float32(a_t).astype(w.dtype) * u,
                 self.params, out.update)

@@ -1,0 +1,603 @@
+"""Buffered-async federated rounds on the TPU mesh simulator.
+
+``round_mode: async_buffered`` removes the round barrier: the server pours
+a staleness-weighted buffer of K client updates whenever the K-th arrives
+(FedBuff, Nguyen et al. AISTATS 2022; decay families from FedAsync, Xie et
+al. 2019), so one slow or dead client caps nothing — it is down-weighted
+when it finally lands and redeemed back into the rotation, never waited on.
+
+How the async world maps onto a synchronous mesh:
+
+* **Arrival time is simulated.** Clients get seeded heterogeneous base
+  durations (``core/async_rounds/arrivals.py``); the chaos plan is the
+  adversary — a straggler does full work slowly (duration / work fraction)
+  and a dropped client never delivers, rejoining the idle pool after its
+  duration (the redemption event). A virtual clock + event heap orders
+  arrivals; everything is a pure function of the seeds, so runs (and
+  crash-resumes) replay identical pours.
+
+* **Device work stays one-dispatch-per-pour.** Each pour is ONE jitted
+  ``shard_map`` program that simultaneously (a) aggregates the poured
+  buffer — a ``[K, D]`` matrix of staleness-tagged update vectors, weights
+  and staleness decay riding as DATA — through the staleness-corrected
+  server transform (``FedOptimizer.server_update_async``), and (b) trains
+  the re-dispatched cohort on the PRE-POUR params. The two subgraphs share
+  only that stale input, so XLA overlaps training of cohort N+1 with
+  aggregation of cohort N — the double-buffered dispatch: two model slots
+  (the donated pre-pour params in, the post-pour params out), and the
+  program compiles exactly once (schedules pad to one canonical width, all
+  staleness math is data).
+
+* **A client trains on the model it was handed.** Its update is computed
+  at dispatch (mathematically identical to computing it at arrival, since
+  the base is fixed then) but enters the buffer only when the virtual
+  clock says it arrived — staleness is the honest per-update count of
+  pours that happened in between.
+
+Buffered rows are replicated ``[K, D]`` f32 vectors (update ‖ extras), so
+SCAFFOLD's control variates ride the buffer next to the model delta; for
+LLM-scale models a feature-sharded buffer is the known follow-up.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...constants import AXIS_CLIENT
+from ...core import mlops
+from ...core.async_rounds import (adaptive_staleness_cap, buffer_k_from_args,
+                                  durations_from_args, faulted_duration,
+                                  make_staleness_fn, merge_alpha_from_args,
+                                  pour_weights, staleness_cap_from_args,
+                                  UpdateBuffer, weighting_knobs_from_args)
+from ...core.algframe.types import TrainHyper
+from ...core.chaos import ChaosCrash
+from ...core.collectives import psum_tree, vector_to_tree_like
+from ...core.jax_compat import shard_map
+from ...core.selection import slot_placement
+from ..sampling import build_schedule
+from .engine import TPUSimulator
+
+logger = logging.getLogger(__name__)
+
+_ARRIVE = 0
+_REDEEM = 1
+
+# domain-separation tag for the idle-pool rotation order (distinct from
+# the chaos and duration tags)
+_ROTATION_TAG = 1013
+
+
+class AsyncBufferedSimulator(TPUSimulator):
+    """TPU engine in ``round_mode: async_buffered``. ``comm_round`` counts
+    POURS (global model versions), the async analog of rounds."""
+
+    def __init__(self, args, fed_dataset, bundle, optimizer, spec,
+                 mesh=None, server_aggregator=None):
+        super().__init__(args, fed_dataset, bundle, optimizer, spec,
+                         mesh=mesh, server_aggregator=server_aggregator)
+        # --- config guards: fail loudly, never silently degrade ----------
+        if self.robust_mode:
+            raise ValueError(
+                "round_mode: async_buffered does not yet compose with "
+                "attacks/defenses/contribution/user ServerAggregators — "
+                "robust aggregation assumes a same-version cohort; use "
+                "round_mode: sync for defended runs")
+        if self.dp.is_dp_enabled():
+            raise ValueError(
+                "round_mode: async_buffered does not yet compose with DP "
+                "(per-pour accounting under stale mixed cohorts is an open "
+                "design); use round_mode: sync with DP")
+        if self.selection.strategy_name != "uniform" or self.selection.adaptive:
+            raise ValueError(
+                "round_mode: async_buffered dispatches by arrival rotation "
+                "(no per-round cohort to strategize over yet); use "
+                "client_selection: uniform — arrival-rate posteriors still "
+                "feed the cross-silo silo selection")
+        self.concurrency = min(int(args.client_num_per_round),
+                               int(fed_dataset.num_clients))
+        self.k = buffer_k_from_args(args, self.concurrency)
+        self.merge_alpha = merge_alpha_from_args(args)
+        (self._weighting_kind, self._poly_a,
+         self._hinge_b) = weighting_knobs_from_args(args)
+        self._cap_adaptive = int(getattr(args, "async_staleness_cap", 16)
+                                 or 0) == 0
+        self.staleness_cap = staleness_cap_from_args(args)
+        # validate the weighting knobs NOW, not at the first pour
+        make_staleness_fn(self._weighting_kind, self._poly_a, self._hinge_b,
+                          self.staleness_cap)
+        self.buffer = UpdateBuffer(self.k)
+        self.durations = durations_from_args(fed_dataset.num_clients, args)
+        self._n_k = np.asarray(fed_dataset.train.num_samples, np.float64)
+
+        # flattened-row geometry: update vector ‖ extras vector
+        extras_zero = self.opt.server_extras_zero(self.params)
+        self._extras_d = int(sum(int(np.prod(l.shape)) for l in
+                                 jax.tree_util.tree_leaves(extras_zero)))
+        self._row_d = self._true_d + self._extras_d
+
+        # virtual clock + event heap: (t, seq, kind, cid, version, weight,
+        # duration, vec) — vec is the client's device-resident [row_d]
+        # update row for arrivals, None for redemption events; seq is
+        # unique, so tuple ordering never compares the trailing array
+        self.version = 0
+        self.virtual_t = 0.0
+        self.updates_aggregated = 0
+        self._dispatch_seq = 0
+        self._evseq = 0
+        self._events: List[Any] = []
+        self._pour_interval_ema: Optional[float] = None
+        self._last_pour_t = 0.0
+        # per-client observed arrival latency EMA (simulated seconds) —
+        # the arrival-rate signal behind the adaptive staleness cap
+        self._lat_ema = np.zeros(fed_dataset.num_clients, np.float64)
+        self._lat_seen = np.zeros(fed_dataset.num_clients, np.float64)
+        self._last_arrival_t = np.full(fed_dataset.num_clients, -1.0,
+                                       np.float64)
+        # idle rotation: seeded permutation so dispatch order respects
+        # random_seed via the same (seed, tag) stream discipline
+        order = np.random.default_rng(
+            (int(getattr(args, "random_seed", 0) or 0),
+             _ROTATION_TAG)).permutation(fed_dataset.num_clients)
+        self._idle = deque(int(c) for c in order)
+        self._bootstrapped = False
+
+        self._async_width = min(self.cpd, self.concurrency)
+        self._pour_fn = self._build_async_pour_fn()
+        self._row_fn = jax.jit(lambda m, i: m[i])
+        self._stack_fn = jax.jit(lambda vs: jnp.stack(vs))
+        self._zero_row = jnp.zeros((self._row_d,), jnp.float32)
+
+    # ------------------------------------------------------------------
+    def _build_async_pour_fn(self):
+        """The ONE async program: pour the buffer through the staleness-
+        corrected server transform while training the freshly-dispatched
+        cohort on the pre-pour params (independent subgraphs — XLA
+        overlaps them; two donated model slots)."""
+        emit_extras = self._extras_d > 0
+        collect = self._make_collect_core(emit_extras_stack=emit_extras)
+        opt = self.opt
+        true_d = self._true_d
+        extras_zero = opt.server_extras_zero(self.params)
+        n_total = float(max(self.fed.num_clients, 1))
+
+        def pour_body(params, server_state, local_data, local_states,
+                      sched_idx, sched_active, sched_work,
+                      buf_mat, buf_nw, merge_scale, pour_n,
+                      round_key, hyper):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            res = collect(params, server_state, sq(local_data),
+                          sq(local_states), sched_idx[0], sched_active[0],
+                          sched_work[0], round_key, hyper)
+            (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
+             slot_mets) = res[:7]
+            # [S, ...] local stacks -> [S, row_d] local rows -> gather to
+            # the replicated [n_dev*S, row_d] dispatch matrix (row = d*S+s,
+            # the _robust_rows convention)
+            leaves = jax.tree_util.tree_leaves(upd_stack)
+            n_slots = leaves[0].shape[0]
+            parts = [jnp.reshape(l, (n_slots, -1)).astype(jnp.float32)
+                     for l in leaves]
+            if emit_extras:
+                parts += [jnp.reshape(l, (n_slots, -1)).astype(jnp.float32)
+                          for l in jax.tree_util.tree_leaves(res[7])]
+            rows_mat = jax.lax.all_gather(
+                jnp.concatenate(parts, axis=1), AXIS_CLIENT, axis=0,
+                tiled=True)
+            # the pour: buf_nw is the padded [K] relative mix and
+            # merge_scale the absolute damping, BOTH computed host-side by
+            # core/async_rounds.pour_weights (the one staleness
+            # implementation) and riding as DATA; pour_n (the actual
+            # poured count — partial pours under heavy dropout pour fewer
+            # than K) sizes the population fraction SCAFFOLD's control
+            # variate advances by
+            agg_vec = jnp.einsum("k,kd->d", buf_nw, buf_mat)
+            agg_update = vector_to_tree_like(agg_vec[:true_d], params)
+            agg_extras = (vector_to_tree_like(agg_vec[true_d:], extras_zero)
+                          if emit_extras else {})
+            upd_params, upd_sstate = opt.server_update_async(
+                params, server_state, agg_update, agg_extras,
+                hyper.round_idx, merge_scale, pour_n / n_total)
+            # a no-op pour (bootstrap, drained-heap retry) must leave the
+            # SERVER STATE untouched too: merge_scale=0 already pins the
+            # params, but FedOpt's adam/yogi would still advance its step
+            # count and decay its moments on a zero pseudo-gradient
+            poured = pour_n > 0
+            new_params = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(poured, n, o), upd_params, params)
+            new_sstate = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(poured, n, o), upd_sstate,
+                server_state)
+            metrics = psum_tree(acc_m)
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            slot_mets = jax.tree_util.tree_map(lambda a: a[None], slot_mets)
+            return (new_params, new_sstate, states, rows_mat, metrics,
+                    slot_mets)
+
+        shard_fn = shard_map(
+            pour_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P(), P(), P(AXIS_CLIENT)),
+            check_vma=False,
+        )
+        return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
+
+    # ------------------------------------------------------------------
+    def _staleness_fn(self):
+        if self._cap_adaptive:
+            seen = self._lat_seen > 0
+            self.staleness_cap = adaptive_staleness_cap(
+                self._lat_ema[seen], self._pour_interval_ema or 0.0)
+        return make_staleness_fn(self._weighting_kind, self._poly_a,
+                                 self._hinge_b, self.staleness_cap)
+
+    def _inflight(self) -> int:
+        return len(self._events)
+
+    def _draw_cohort(self, target: int) -> List[int]:
+        """Pop up to ``target`` idle clients, deferring any whose device
+        already filled its canonical slot width this dispatch (the [D, S]
+        schedule shape must never grow, or the program recompiles)."""
+        counts = [0] * self.n_devices
+        cohort: List[int] = []
+        deferred: List[int] = []
+        while self._idle and len(cohort) < target:
+            cid = self._idle.popleft()
+            d = cid // self.cpd
+            if counts[d] >= self._async_width:
+                deferred.append(cid)
+                continue
+            counts[d] += 1
+            cohort.append(cid)
+        self._idle.extendleft(reversed(deferred))
+        return cohort
+
+    def _dispatch_plan(self, cohort: List[int]):
+        """Chaos verdicts + schedule arrays for one dispatch. Returns
+        (idx, active, work, per-client plan rows) — work is 0 only for
+        dropped clients (stragglers do FULL work slowly in async; the
+        fault is their arrival time)."""
+        self._dispatch_seq += 1
+        width = self._async_width
+        idx, active = build_schedule(cohort, self.n_devices, self.cpd,
+                                     max_slots=width)
+        if idx.shape[1] < width:
+            extra = width - idx.shape[1]
+            idx = np.pad(idx, ((0, 0), (0, extra)))
+            active = np.pad(active, ((0, 0), (0, extra)))
+        work = np.ones_like(active)
+        plan = []  # (cid, row, work_scale, duration)
+        inj = self.chaos.injects_availability
+        for cid, d, s in slot_placement(cohort, self.n_devices, self.cpd):
+            ws = self.chaos.work_scale(self._dispatch_seq, cid) if inj \
+                else 1.0
+            if ws <= 0.0:
+                work[d, s] = 0.0  # dropped: no compute, no arrival
+            plan.append((cid, d * width + s, ws,
+                         faulted_duration(self.durations[cid], ws)))
+        return idx, active, work, plan
+
+    def _push_events(self, plan, rows_mat) -> None:
+        """Turn a dispatch plan into future events: arrivals carry the
+        client's update row (extracted as a device slice — computed at
+        dispatch, delivered at arrival); drops become redemption events."""
+        t0 = self.virtual_t
+        dropped = []
+        for cid, row, ws, dur in plan:
+            if ws <= 0.0:
+                kind, vec = _REDEEM, None
+                dropped.append(cid)
+            else:
+                kind, vec = _ARRIVE, self._row_fn(rows_mat,
+                                                  jnp.int32(row))
+            heapq.heappush(self._events,
+                           (t0 + dur, self._evseq, kind, cid, self.version,
+                            float(self._n_k[cid]), dur, vec))
+            self._evseq += 1
+        if dropped:
+            mlops.log_chaos(round_idx=self._dispatch_seq,
+                            injected={"dropped": dropped})
+
+    def _absorb_until(self, n: int) -> bool:
+        """Advance the virtual clock until ``n`` updates are buffered.
+        False when the event heap drains first (everything idle)."""
+        while len(self.buffer) < n:
+            if not self._events:
+                return False
+            t, _, kind, cid, ver, w, dur, vec = heapq.heappop(self._events)
+            self.virtual_t = max(self.virtual_t, t)
+            if kind == _ARRIVE:
+                self.buffer.add(cid, vec, weight=w, version=ver,
+                                arrival_t=t)
+                # observed arrival latency = the FAULTED duration (a
+                # straggler's slowness is the signal, not its base speed)
+                self._note_arrival(cid, dur)
+                if self._last_arrival_t[cid] >= 0:
+                    self.selection.note_arrival(
+                        cid, t - self._last_arrival_t[cid])
+                self._last_arrival_t[cid] = t
+            self._idle.append(cid)
+        return True
+
+    def _note_arrival(self, cid: int, latency_s: float) -> None:
+        a = 0.2
+        if self._lat_seen[cid] > 0:
+            self._lat_ema[cid] = (1 - a) * self._lat_ema[cid] \
+                + a * float(latency_s)
+        else:
+            self._lat_ema[cid] = float(latency_s)
+            self._lat_seen[cid] = 1.0
+        self.selection.note_latency(int(cid), float(latency_s))
+
+    # ------------------------------------------------------------------
+    def _pour_step(self, hyper: TrainHyper) -> Dict[str, Any]:
+        """One pour: absorb arrivals to K, aggregate them, re-dispatch the
+        freed clients — all device work in ONE program call."""
+        self._absorb_until(self.k)
+        entries = self.buffer.pour(self.version)
+        fn = self._staleness_fn()
+        stal = np.asarray([e.staleness(self.version) for e in entries],
+                          np.float64)
+        pad = self.k - len(entries)
+        if entries:
+            # the ONE staleness implementation: relative mix + absolute
+            # merge scale from core/async_rounds.pour_weights, fed to the
+            # program as data (padded rows carry weight 0)
+            norm_w, merge_scale = pour_weights(
+                [e.weight for e in entries], stal, fn, self.merge_alpha)
+            buf_nw = np.concatenate([norm_w, np.zeros(pad, np.float32)])
+        else:  # bootstrap / drained heap: a no-op pour
+            buf_nw = np.zeros(self.k, np.float32)
+            merge_scale = 0.0
+        vecs = [e.update for e in entries] + [self._zero_row] * pad
+        # pin the stacked buffer to the replicated sharding: the bootstrap
+        # rows (fresh zeros, single-device sharding) and steady-state rows
+        # (slices of the shard_map output, named sharding) must present
+        # the SAME input sharding or pjit recompiles the pour program on
+        # the bootstrap->steady-state transition
+        buf_mat = jax.device_put(self._stack_fn(vecs), self.repl_sharding)
+
+        target = max(0, self.concurrency - self._inflight()
+                     - len(self.buffer))
+        cohort = self._draw_cohort(target)
+        idx, active, work, plan = self._dispatch_plan(cohort)
+        idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
+        active = jax.device_put(jnp.asarray(active), self.client_sharding)
+        work = jax.device_put(jnp.asarray(work), self.client_sharding)
+        round_key = jax.random.fold_in(self.rng, self._dispatch_seq)
+        hyper_r = hyper.replace(round_idx=jnp.int32(self.version))
+        (self.params, self.server_state, self.client_states, rows_mat,
+         metrics, slot_mets) = self._traced(
+            "async_pour", 1, self._pour_fn,
+            self.params, self.server_state, self.train_data,
+            self.client_states, idx, active, work, buf_mat,
+            jnp.asarray(buf_nw), jnp.float32(merge_scale),
+            jnp.float32(len(entries)), round_key, hyper_r)
+        self._push_events(plan, rows_mat)
+        if self.selection.track:
+            self.selection.note_results(
+                self.version, cohort,
+                slot_placement(cohort, self.n_devices, self.cpd),
+                slot_metrics=slot_mets)
+
+        poured = len(entries)
+        self.updates_aggregated += poured
+        if poured:
+            # pour-interval EMA: the clock the adaptive staleness cap
+            # converts arrival latencies into version lag with
+            dt = self.virtual_t - self._last_pour_t
+            self._last_pour_t = self.virtual_t
+            self._pour_interval_ema = (dt if self._pour_interval_ema is None
+                                       else 0.8 * self._pour_interval_ema
+                                       + 0.2 * dt)
+            self.chaos_ledger.record_pour(
+                self.version,
+                arrivals=[{"client": e.client_id,
+                           "staleness": e.staleness(self.version),
+                           "arrival_t": e.arrival_t,
+                           "dispatch_version": e.version}
+                          for e in entries],
+                observed={"poured": poured, "buffered": len(self.buffer),
+                          "staleness_cap": self.staleness_cap,
+                          "virtual_t": self.virtual_t})
+            self.version += 1
+        return {"metrics": metrics, "poured": poured,
+                "staleness_mean": float(np.mean(stal)) if poured else 0.0,
+                "staleness_max": int(np.max(stal)) if poured else 0}
+
+    def _bootstrap(self, hyper: TrainHyper) -> None:
+        """Dispatch the initial in-flight cohort (empty buffer — the
+        program's zero-masked pour is a no-op on the model)."""
+        if self._bootstrapped:
+            return
+        self._bootstrapped = True
+        self._pour_step(hyper)  # buffer empty: trains, pours nothing
+
+    # ------------------------------------------------------------------
+    # sync-engine entry points that make no sense without a barrier
+    def run_round(self, round_idx, hyper):  # pragma: no cover - guard
+        raise NotImplementedError(
+            "async_buffered has no per-round barrier; use run()")
+
+    def run_rounds_fused(self, start_round, n_rounds, hyper):
+        raise NotImplementedError(
+            "async_buffered has no per-round barrier; use run()")
+
+    def run(self, comm_round: Optional[int] = None) -> Dict[str, Any]:
+        args = self.args
+        pours = comm_round if comm_round is not None \
+            else int(args.comm_round)
+        hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                           epochs=int(args.epochs))
+        t0 = time.time()
+        restored = self._ckpt_latest()
+        if restored is not None:
+            step, st = restored
+            self._load_ckpt_state(st)
+            logger.info("resumed async state from checkpoint at pour %d "
+                        "(version %d)", step, self.version)
+        freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
+        self._bootstrap(hyper)
+        stalls = 0
+        while self.version < pours:
+            rec_in = self._pour_step(hyper)
+            if rec_in["poured"] == 0:
+                # nothing buffered AND nothing in flight produced an
+                # arrival — one redispatch retry, then refuse to spin
+                stalls += 1
+                if stalls > 2:
+                    raise RuntimeError(
+                        "async pour stalled: no updates in flight "
+                        f"(concurrency={self.concurrency}, k={self.k})")
+                continue
+            stalls = 0
+            v = self.version - 1  # the pour that just completed
+            metrics = jax.device_get(rec_in["metrics"])
+            rec: Dict[str, Any] = {"round": v,
+                                   "virtual_t": self.virtual_t,
+                                   "poured": rec_in["poured"],
+                                   "staleness_mean": rec_in["staleness_mean"],
+                                   "staleness_max": rec_in["staleness_max"]}
+            cnt = max(float(metrics["count"]), 1.0)
+            rec["train_loss"] = float(metrics["loss_sum"]) / cnt
+            rec["train_acc"] = float(metrics["correct"]) / cnt
+            if freq > 0 and (v % freq == 0 or v == pours - 1):
+                stats = self._evaluate(self.params, self.fed.test["x"],
+                                       self.fed.test["y"],
+                                       self.fed.test["mask"])
+                n = max(float(stats["count"]), 1.0)
+                rec["test_acc"] = float(stats["correct"]) / n
+                rec["test_loss"] = float(stats["loss_sum"]) / n
+                logger.info("pour %d (staleness mean %.2f): test_acc=%.4f",
+                            v, rec["staleness_mean"], rec["test_acc"])
+            self.history.append(rec)
+            if self.ckpt.enabled:
+                self.ckpt.maybe_save(v, self._ckpt_state())
+            mlops.log_round_info(pours, v)
+            mlops.log({k: val for k, val in rec.items() if k != "round"},
+                      step=v)
+            if self.chaos.crash_due(v):
+                self.ckpt.flush()
+                raise ChaosCrash(v)
+        self.ckpt.flush()
+        wall = time.time() - t0
+        last_eval = next((r for r in reversed(self.history)
+                          if "test_acc" in r), None)
+        if last_eval is None:
+            if freq <= 0:
+                last_eval = {"test_acc": None}
+            else:
+                stats = self._evaluate(self.params, self.fed.test["x"],
+                                       self.fed.test["y"],
+                                       self.fed.test["mask"])
+                n = max(float(stats["count"]), 1.0)
+                last_eval = {"test_acc": float(stats["correct"]) / n,
+                             "test_loss": float(stats["loss_sum"]) / n}
+        return {"params": self.params, "history": self.history,
+                "wall_time_s": wall,
+                "final_test_acc": last_eval["test_acc"],
+                "final_test_loss": last_eval.get("test_loss"),
+                "rounds": self.version,
+                "virtual_time_s": self.virtual_t,
+                "updates_aggregated": self.updates_aggregated}
+
+    # ------------------------------------------------------------------
+    # checkpointing: the async control state rides RoundCheckpointer next
+    # to params/server_state/client_states — fixed shapes (buffer padded
+    # to its hard bound, events to the concurrency) so the orbax template
+    # never depends on how full the buffer was at the save
+    _OPTIONAL_CKPT_KEYS = TPUSimulator._OPTIONAL_CKPT_KEYS + (
+        "async_rounds",)
+
+    def _ckpt_state(self):
+        st = super()._ckpt_state()
+        st["async_rounds"] = self._async_state_dict()
+        return st
+
+    def _load_ckpt_state(self, st):
+        super()._load_ckpt_state(st)
+        if "async_rounds" in st:
+            self._async_load_state(st["async_rounds"])
+        else:
+            logger.warning(
+                "checkpoint has no async_rounds leaf — async control "
+                "state (buffer, in-flight cohort, virtual clock) resumes "
+                "cold from the restored model")
+
+    def _async_state_dict(self) -> Dict[str, np.ndarray]:
+        n = self.fed.num_clients
+        ev = sorted(self._events, key=lambda e: e[:2])
+        e_rows = self.concurrency
+        if len(ev) > e_rows:  # cannot happen by construction; be loud
+            raise RuntimeError(f"{len(ev)} in-flight events > concurrency")
+        ev_meta = np.zeros((e_rows, 7), np.float64)  # t,seq,kind,cid,ver,w,dur
+        ev_vecs = np.zeros((e_rows, self._row_d), np.float32)
+        ev_mask = np.zeros((e_rows,), np.float32)
+        for i, (t, seq, kind, cid, ver, w, dur, vec) in enumerate(ev):
+            ev_meta[i] = (t, seq, kind, cid, ver, w, dur)
+            if vec is not None:
+                ev_vecs[i] = np.asarray(vec, np.float32)
+            ev_mask[i] = 1.0
+        idle = np.full((n,), -1, np.int64)
+        for i, cid in enumerate(self._idle):
+            idle[i] = cid
+        return {
+            "scalars": np.asarray(
+                [self.version, self.virtual_t, self._dispatch_seq,
+                 self._evseq,
+                 -1.0 if self._pour_interval_ema is None
+                 else self._pour_interval_ema,
+                 self._last_pour_t, self.updates_aggregated,
+                 1.0 if self._bootstrapped else 0.0,
+                 self.staleness_cap], np.float64),
+            "buffer": self.buffer.state_dict(
+                encode=lambda v: np.asarray(v, np.float32),
+                pad_rows=2 * self.k, vec_dim=self._row_d),
+            "ev_meta": ev_meta, "ev_vecs": ev_vecs, "ev_mask": ev_mask,
+            "idle": idle,
+            "lat_ema": self._lat_ema.copy(),
+            "lat_seen": self._lat_seen.copy(),
+            "last_arrival_t": self._last_arrival_t.copy(),
+        }
+
+    def _async_load_state(self, st: Dict[str, np.ndarray]) -> None:
+        sc = np.asarray(st["scalars"], np.float64)
+        (self.version, self.virtual_t, self._dispatch_seq, self._evseq,
+         pie, self._last_pour_t, self.updates_aggregated) = (
+            int(sc[0]), float(sc[1]), int(sc[2]), int(sc[3]), float(sc[4]),
+            float(sc[5]), int(sc[6]))
+        self._bootstrapped = sc[7] > 0.0
+        self.staleness_cap = int(sc[8])
+        self._pour_interval_ema = None if pie < 0 else pie
+        self.buffer.load_state_dict(dict(st["buffer"]),
+                                    decode=lambda a: jnp.asarray(a))
+        self._events = []
+        mask = np.asarray(st["ev_mask"], np.float32)
+        meta = np.asarray(st["ev_meta"], np.float64)
+        vecs = np.asarray(st["ev_vecs"], np.float32)
+        for i in range(mask.shape[0]):
+            if mask[i] <= 0.0:
+                continue
+            t, seq, kind, cid, ver, w, dur = meta[i]
+            vec = jnp.asarray(vecs[i]) if int(kind) == _ARRIVE else None
+            heapq.heappush(self._events, (float(t), int(seq), int(kind),
+                                          int(cid), int(ver), float(w),
+                                          float(dur), vec))
+        self._idle = deque(int(c) for c in np.asarray(st["idle"], np.int64)
+                           if c >= 0)
+        self._lat_ema = np.asarray(st["lat_ema"], np.float64).copy()
+        self._lat_seen = np.asarray(st["lat_seen"], np.float64).copy()
+        self._last_arrival_t = np.asarray(st["last_arrival_t"],
+                                          np.float64).copy()

@@ -160,6 +160,17 @@ class TPUSimulator:
     def __init__(self, args, fed_dataset, bundle, optimizer, spec,
                  mesh: Optional[Mesh] = None, server_aggregator=None):
         self.args = args
+        # `round_mode: async_buffered` lives in the AsyncBufferedSimulator
+        # subclass (simulation/tpu/async_engine.py); constructing the base
+        # engine with it would silently run the sync barrier — refuse.
+        from ...core.async_rounds import round_mode_from_args
+        if (round_mode_from_args(args) == "async_buffered"
+                and type(self) is TPUSimulator):
+            raise ValueError(
+                "round_mode: async_buffered needs the "
+                "AsyncBufferedSimulator — build via FedMLRunner / "
+                "run_simulation (they dispatch on round_mode), or import "
+                "fedml_tpu.simulation.tpu.async_engine directly")
         self.server_aggregator = server_aggregator
         self.fed = fed_dataset
         self.bundle = bundle
@@ -620,12 +631,18 @@ class TPUSimulator:
         return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
 
     # ------------------------------------------------------------------
-    def _make_collect_core(self):
+    def _make_collect_core(self, emit_extras_stack: bool = False):
         """Per-shard slot scan on SQUEEZED local blocks that keeps every
         scheduled client's raw update as a [S, ...] stack (plus the psum-
         ready extras/weight/metrics accumulators). Shared by the host-
-        dispatch collect program and the fused robust program — one
-        training implementation, or their parity would silently drift."""
+        dispatch collect program, the fused robust program, and the async
+        pour program — one training implementation, or their parity would
+        silently drift.
+
+        ``emit_extras_stack`` additionally returns the PER-SLOT extras
+        stack (async buffering needs each client's own extras — SCAFFOLD
+        delta_c — not the weighted sum; the flag is off for every sync
+        path, so their scan ys are byte-identical to before)."""
         opt = self.opt
         cpd = self.cpd
         dp = self.dp
@@ -675,14 +692,18 @@ class TPUSimulator:
                 # _make_round_core) — masked like acc_m, device-local
                 slot_m = jax.tree_util.tree_map(
                     lambda m: m * report, out.metrics)
-                return (states, acc_ex, acc_w, acc_m), (upd, w, slot_m)
+                ys = (upd, w, slot_m)
+                if emit_extras_stack:
+                    ys = ys + (out.extras,)
+                return (states, acc_ex, acc_w, acc_m), ys
 
             init = (local_states, zero_extras, jnp.float32(0), zero_metrics)
-            ((states, acc_ex, acc_w, acc_m),
-             (upd_stack, w_stack, slot_mets)) = jax.lax.scan(
+            (states, acc_ex, acc_w, acc_m), ys = jax.lax.scan(
                 slot, init, jnp.arange(sched_idx.shape[0]))
-            return (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
-                    slot_mets)
+            upd_stack, w_stack, slot_mets = ys[:3]
+            out = (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
+                   slot_mets)
+            return out + (ys[3],) if emit_extras_stack else out
 
         return core
 

@@ -1,0 +1,640 @@
+"""Buffered-async federated rounds (ISSUE 6, ``core/async_rounds``).
+
+Covers the staleness math (weighting monotonicity, caps, the relative-mix
+vs absolute-merge-scale split), buffer pour determinism under a seeded
+arrival order, the TPU engine's ``round_mode: async_buffered`` (learning,
+compile-once double-buffered dispatch, crash-resume through
+RoundCheckpointer, loud config refusals), the ``round_mode: sync``
+bit-identity regression, the cross-silo async aggregator's staleness-
+weighted pour + base ring, and the retry-budget deadline satellite.
+The in-proc async WAN session and the 200-pour chaos soak are slow-marked.
+"""
+
+import heapq
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.async_rounds import (UpdateBuffer, adaptive_staleness_cap,
+                                         buffer_k_from_args, client_durations,
+                                         make_staleness_fn, pour_weights,
+                                         round_mode_from_args)
+
+pytestmark = pytest.mark.async_rounds
+
+
+def sim_args(**kw):
+    base = dict(dataset="synthetic_mnist", model="lr",
+                client_num_in_total=8, client_num_per_round=8,
+                comm_round=6, epochs=1, batch_size=32, learning_rate=0.1,
+                frequency_of_the_test=0, random_seed=3)
+    base.update(kw)
+    return Arguments(**base)
+
+
+def build_async_sim(args):
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.async_engine import AsyncBufferedSimulator
+
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    spec = ClassificationTrainer(bundle.apply)
+    return AsyncBufferedSimulator(args, fed, bundle,
+                                  create_optimizer(args, spec), spec)
+
+
+def hyper_for(args):
+    from fedml_tpu.core.algframe.types import TrainHyper
+    return TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                      epochs=int(args.epochs))
+
+
+# --- staleness weighting ------------------------------------------------------
+
+class TestWeighting:
+    def test_constant_is_one_everywhere(self):
+        fn = make_staleness_fn("constant", cap=8)
+        assert np.all(fn(np.arange(50)) == 1.0)
+
+    def test_polynomial_monotone_decreasing_in_unit_interval(self):
+        fn = make_staleness_fn("polynomial", poly_a=0.5, cap=32)
+        w = fn(np.arange(0, 33))
+        assert w[0] == 1.0
+        assert np.all(np.diff(w) < 0)
+        assert np.all((w > 0) & (w <= 1.0))
+
+    def test_hinge_free_until_b_then_decays(self):
+        fn = make_staleness_fn("hinge", poly_a=0.5, hinge_b=4, cap=32)
+        w = fn(np.arange(0, 33))
+        assert np.all(w[:5] == 1.0)          # s <= b: no penalty
+        assert np.all(np.diff(w[4:]) < 0)    # past b: strict decay
+        assert np.all(w > 0)
+
+    def test_cap_saturates_instead_of_dropping(self):
+        fn = make_staleness_fn("polynomial", poly_a=1.0, cap=8)
+        assert fn(8) == fn(100) == fn(10**6)
+        assert fn(100) > 0.0  # down-weighted, never zeroed
+
+    def test_bad_knobs_refused(self):
+        with pytest.raises(ValueError):
+            make_staleness_fn("exponential")
+        with pytest.raises(ValueError):
+            make_staleness_fn("polynomial", poly_a=-1.0)
+
+    def test_pour_weights_split(self):
+        fn = make_staleness_fn("polynomial", poly_a=0.5, cap=16)
+        w = np.asarray([2.0, 1.0, 1.0])
+        # all fresh: relative mix is the plain weighted mean, merge scale
+        # is exactly alpha
+        nw, ms = pour_weights(w, np.zeros(3), fn, alpha=0.6)
+        np.testing.assert_allclose(nw, w / w.sum(), rtol=1e-6)
+        assert ms == pytest.approx(0.6)
+        # staler pour: same relative shape question, SMALLER merge scale
+        nw2, ms2 = pour_weights(w, np.asarray([4, 4, 4]), fn, alpha=0.6)
+        np.testing.assert_allclose(nw2, w / w.sum(), rtol=1e-6)
+        assert ms2 < ms
+        # mixed staleness: the stale update loses relative weight too
+        nw3, _ = pour_weights(np.ones(2), np.asarray([0, 9]), fn, 0.6)
+        assert nw3[0] > nw3[1]
+        assert nw3.sum() == pytest.approx(1.0)
+
+    def test_zero_valued_knobs_are_honored(self):
+        # 0 is legitimate for these knobs (no decay / frozen control /
+        # homogeneous speeds) — a falsy-`or` default must not revert it
+        from fedml_tpu.core.async_rounds import (client_durations,
+                                                 durations_from_args,
+                                                 merge_alpha_from_args,
+                                                 staleness_fn_from_args)
+        assert merge_alpha_from_args(Arguments(async_alpha=0.0)) == 0.0
+        fn = staleness_fn_from_args(Arguments(async_staleness_poly=0.0))
+        assert np.all(fn(np.arange(10)) == 1.0)  # a=0: no decay
+        hinge = staleness_fn_from_args(Arguments(
+            async_staleness_weighting="hinge", async_hinge_b=0))
+        assert hinge(1) < 1.0  # b=0: decay from the first stale version
+        np.testing.assert_array_equal(
+            durations_from_args(4, Arguments(async_duration_sigma=0.0)),
+            client_durations(4, random_seed=0, sigma=0.0))
+
+    def test_adaptive_cap_tracks_latency_over_pour_interval(self):
+        assert adaptive_staleness_cap([10.0], 1.0) == 11
+        assert adaptive_staleness_cap([3.0, 30.0], 2.0) == 16
+        # clipped to [lo, hi]; unobserved -> hi (no evidence, no clamp)
+        assert adaptive_staleness_cap([0.1], 10.0) == 2
+        assert adaptive_staleness_cap([1e9], 0.001) == 64
+        assert adaptive_staleness_cap([], 1.0) == 64
+        assert adaptive_staleness_cap([5.0], 0.0) == 64
+
+
+# --- the update buffer --------------------------------------------------------
+
+class TestUpdateBuffer:
+    def test_pour_order_is_arrival_order_with_seq_tiebreak(self):
+        buf = UpdateBuffer(3)
+        buf.add(0, "a", 1.0, version=0, arrival_t=5.0)
+        buf.add(1, "b", 1.0, version=0, arrival_t=1.0)
+        buf.add(2, "c", 1.0, version=0, arrival_t=5.0)  # same t as "a"
+        assert buf.ready()
+        got = buf.pour(current_version=2)
+        assert [e.update for e in got] == ["b", "a", "c"]
+        assert [e.staleness(2) for e in got] == [2, 2, 2]
+
+    def test_seeded_arrival_order_pours_deterministically(self):
+        def run_once():
+            rng = np.random.default_rng(42)
+            events = [(float(t), i) for i, t in
+                      enumerate(rng.exponential(1.0, size=20))]
+            heapq.heapify(events)
+            buf = UpdateBuffer(4)
+            poured = []
+            v = 0
+            while events:
+                t, cid = heapq.heappop(events)
+                buf.add(cid, cid, 1.0, version=v, arrival_t=t)
+                if buf.ready():
+                    poured.append([e.client_id for e in buf.pour(v)])
+                    v += 1
+            return poured
+
+        assert run_once() == run_once()
+
+    def test_counters_balance(self):
+        buf = UpdateBuffer(2)
+        for i in range(5):
+            buf.add(i, i, 1.0, version=0, arrival_t=float(i))
+        buf.pour(1)
+        c = buf.counters
+        assert c["added"] == 5 and c["poured"] == 2 and c["buffered"] == 3
+        assert c["added"] == c["poured"] + c["buffered"]
+
+    def test_state_roundtrip_including_empty(self):
+        buf = UpdateBuffer(2)
+        buf.add(3, np.asarray([1.0, 2.0], np.float32), 2.5, version=1,
+                arrival_t=0.7)
+        st = buf.state_dict(encode=np.asarray, vec_dim=2)
+        buf2 = UpdateBuffer(2)
+        buf2.load_state_dict(st, decode=np.asarray)
+        (e,) = buf2.pour(3, max_n=1)
+        assert (e.client_id, e.weight, e.version) == (3, 2.5, 1)
+        assert e.staleness(3) == 2
+        np.testing.assert_array_equal(e.update, [1.0, 2.0])
+        # empty buffer still snapshots at the template shape
+        empty = UpdateBuffer(2).state_dict(encode=np.asarray, vec_dim=2)
+        assert empty["mat"].shape == st["mat"].shape == (4, 2)
+
+    def test_durations_are_seed_deterministic_and_heterogeneous(self):
+        d1 = client_durations(16, random_seed=5)
+        d2 = client_durations(16, random_seed=5)
+        d3 = client_durations(16, random_seed=6)
+        np.testing.assert_array_equal(d1, d2)
+        assert not np.array_equal(d1, d3)
+        assert np.all(d1 > 1.0) and np.std(d1) > 0
+
+    def test_buffer_k_validation(self):
+        args = Arguments(async_buffer_k=0, client_num_per_round=8)
+        assert buffer_k_from_args(args, 8) == 4
+        with pytest.raises(ValueError):
+            buffer_k_from_args(Arguments(async_buffer_k=9), 8)
+
+
+# --- the async TPU engine -----------------------------------------------------
+
+class TestAsyncEngine:
+    def test_learns_and_reports_staleness(self):
+        args = sim_args(round_mode="async_buffered", comm_round=20,
+                        frequency_of_the_test=20)
+        sim = build_async_sim(args)
+        r = sim.run()
+        assert r["rounds"] == 20
+        assert r["final_test_acc"] > 0.5, r["history"][-1]
+        assert r["virtual_time_s"] > 0
+        assert r["updates_aggregated"] == 20 * sim.k
+        # heterogeneous durations guarantee genuine staleness occurred
+        assert any(h["staleness_mean"] > 0 for h in sim.history)
+        pours = sim.chaos_ledger.pours()
+        assert len(pours) == 20
+        arr = pours[-1]["injected"]["arrivals"]
+        assert {"client", "staleness", "arrival_t",
+                "dispatch_version"} <= set(arr[0])
+
+    def test_pour_program_compiles_exactly_once(self, xla_compile_counter):
+        args = sim_args(round_mode="async_buffered")
+        sim = build_async_sim(args)
+        hyper = hyper_for(args)
+        sim._bootstrap(hyper)
+        for _ in range(3):
+            sim._pour_step(hyper)
+        assert sim.dispatch_stats["compiles"] == 1  # ONE async program
+        xla_compile_counter.reset()
+        for _ in range(5):
+            sim._pour_step(hyper)
+        assert xla_compile_counter.delta() == 0
+        assert sim.dispatch_stats["compiles"] == 1
+
+    def test_sync_round_mode_is_bit_identical(self):
+        from tests.test_robust_fused import build_sim  # the sync engine
+        r_default = build_sim(sim_args())
+        r_explicit = build_sim(sim_args(round_mode="sync"))
+        hyper = hyper_for(sim_args())
+        r_default.run_rounds_fused(0, 4, hyper)
+        r_explicit.run_rounds_fused(0, 4, hyper)
+        for a, b in zip(jax.tree_util.tree_leaves(r_default.params),
+                        jax.tree_util.tree_leaves(r_explicit.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_refuses_unsupported_configs_loudly(self):
+        with pytest.raises(ValueError, match="async_buffered"):
+            build_async_sim(sim_args(round_mode="async_buffered",
+                                     enable_defense=True,
+                                     defense_type="krum",
+                                     byzantine_client_num=1))
+        with pytest.raises(ValueError, match="async_buffered"):
+            build_async_sim(sim_args(round_mode="async_buffered",
+                                     enable_dp=True, dp_epsilon=1.0,
+                                     dp_delta=1e-5, dp_clip=1.0))
+        with pytest.raises(ValueError, match="uniform"):
+            build_async_sim(sim_args(round_mode="async_buffered",
+                                     client_selection="oort"))
+        # the base engine refuses to silently run sync under the knob
+        from tests.test_robust_fused import build_sim
+        with pytest.raises(ValueError, match="AsyncBufferedSimulator"):
+            build_sim(sim_args(round_mode="async_buffered"))
+        with pytest.raises(ValueError, match="round_mode"):
+            round_mode_from_args(Arguments(round_mode="asynch"))
+
+    def test_runner_dispatches_on_round_mode(self):
+        import fedml_tpu
+        from fedml_tpu.simulation.tpu.async_engine import \
+            AsyncBufferedSimulator
+        from fedml_tpu.runner import FedMLRunner
+        args = sim_args(round_mode="async_buffered", comm_round=2)
+        from fedml_tpu import data as data_mod, model as model_mod
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        runner = FedMLRunner(args, dataset=fed, model=bundle)
+        assert isinstance(runner.runner, AsyncBufferedSimulator)
+        with pytest.raises(ValueError, match="Async_FedAvg"):
+            FedMLRunner(sim_args(round_mode="async_buffered", backend="sp"),
+                        dataset=fed, model=bundle)
+
+    def test_chaos_rides_arrivals(self):
+        args = sim_args(round_mode="async_buffered", comm_round=12,
+                        chaos_dropout_prob=0.2, chaos_straggler_prob=0.3,
+                        chaos_straggler_work=0.5, chaos_seed=11)
+        sim = build_async_sim(args)
+        r = sim.run()
+        assert r["rounds"] == 12
+        # stragglers take longer, so the virtual clock outruns the
+        # fault-free run's
+        base = build_async_sim(sim_args(round_mode="async_buffered",
+                                        comm_round=12))
+        rb = base.run()
+        assert r["virtual_time_s"] > rb["virtual_time_s"]
+
+    def test_adaptive_staleness_cap_engages(self):
+        args = sim_args(round_mode="async_buffered", comm_round=10,
+                        async_staleness_cap=0)
+        sim = build_async_sim(args)
+        assert sim._cap_adaptive
+        sim.run()
+        assert 2 <= sim.staleness_cap <= 64
+
+    def test_bootstrap_pour_leaves_server_state_untouched(self):
+        # the bootstrap dispatch pours nothing: params AND server state
+        # must be bit-identical after it — FedOpt's adam would otherwise
+        # advance its step count / decay moments on a zero pseudo-gradient
+        args = sim_args(round_mode="async_buffered",
+                        federated_optimizer="FedOpt",
+                        server_optimizer="adam", server_lr=0.05)
+        sim = build_async_sim(args)
+        before_p = jax.device_get(sim.params)
+        before_s = jax.device_get(sim.server_state)
+        sim._bootstrap(hyper_for(args))
+        for a, b in zip(jax.tree_util.tree_leaves(before_p),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(sim.params))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(before_s),
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(sim.server_state))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_crash_resume_matches_uninterrupted(self, tmp_path):
+        from fedml_tpu.core.chaos import ChaosCrash
+        kw = dict(round_mode="async_buffered", comm_round=12,
+                  chaos_straggler_prob=0.2, chaos_straggler_work=0.5,
+                  chaos_seed=13)
+        # uninterrupted reference
+        ref = build_async_sim(sim_args(**kw))
+        r_ref = ref.run()
+        # crashed run: checkpoint every 5 pours, crash after pour 7
+        ck = dict(kw, checkpoint_dir=str(tmp_path / "ck"),
+                  checkpoint_every_rounds=5, chaos_crash_at_round=7)
+        crash = build_async_sim(sim_args(**ck))
+        with pytest.raises(ChaosCrash):
+            crash.run()
+        # resume: a FRESH engine restores pour 4's state (buffer,
+        # in-flight events, virtual clock) and must replay pours 5..11
+        # exactly as the uninterrupted run did
+        resumed = build_async_sim(sim_args(**dict(
+            ck, chaos_crash_at_round=None)))
+        r_res = resumed.run()
+        assert resumed.version == 12
+        assert r_res["rounds"] == r_ref["rounds"]
+        assert r_res["virtual_time_s"] == pytest.approx(
+            r_ref["virtual_time_s"])
+        for a, b in zip(jax.tree_util.tree_leaves(r_ref["params"]),
+                        jax.tree_util.tree_leaves(r_res["params"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+# --- async optimizers: staleness corrections ---------------------------------
+
+class TestAsyncServerTransforms:
+    def test_fedopt_damps_the_step_not_the_gradient(self):
+        args = sim_args(federated_optimizer="FedOpt",
+                        server_optimizer="adam", server_lr=0.1)
+        from fedml_tpu.core.algframe.client_trainer import \
+            ClassificationTrainer
+        from fedml_tpu.optimizers.registry import create_optimizer
+        opt = create_optimizer(args, ClassificationTrainer(lambda p, x: x))
+        params = {"w": jnp.ones((4,))}
+        state = opt.server_init(params)
+        upd = {"w": jnp.full((4,), 0.5)}
+        full, _ = opt.server_update_async(params, state, upd, {},
+                                          jnp.int32(0), jnp.float32(1.0),
+                                          jnp.float32(0.5))
+        damped, _ = opt.server_update_async(params, state, upd, {},
+                                            jnp.int32(0), jnp.float32(0.25),
+                                            jnp.float32(0.5))
+        step_full = np.asarray(full["w"]) - 1.0
+        step_damped = np.asarray(damped["w"]) - 1.0
+        # adam normalizes gradient scale away: the damped pour must move
+        # the params by ~merge_scale times the full step
+        np.testing.assert_allclose(step_damped, 0.25 * step_full,
+                                   rtol=1e-5)
+
+    def test_scaffold_control_variate_uses_pour_fraction(self):
+        args = sim_args(federated_optimizer="SCAFFOLD")
+        from fedml_tpu.core.algframe.client_trainer import \
+            ClassificationTrainer
+        from fedml_tpu.optimizers.registry import create_optimizer
+        opt = create_optimizer(args, ClassificationTrainer(lambda p, x: x))
+        params = {"w": jnp.zeros((3,))}
+        state = opt.server_init(params)
+        upd = {"w": jnp.ones((3,))}
+        extras = {"delta_c": {"w": jnp.ones((3,))}}
+        new_p, new_s = opt.server_update_async(
+            params, state, upd, extras, jnp.int32(0), jnp.float32(0.5),
+            jnp.float32(0.25))
+        np.testing.assert_allclose(np.asarray(new_p["w"]), 0.5)
+        # c += pour_frac * merge_scale * delta_c = 0.25 * 0.5
+        np.testing.assert_allclose(np.asarray(new_s["c"]["w"]), 0.125)
+
+
+# --- cross-silo async aggregator (unit level) --------------------------------
+
+class TestAsyncAggregator:
+    def _agg(self, **kw):
+        from fedml_tpu.cross_silo.server.async_server import \
+            AsyncFedMLAggregator
+        args = Arguments(client_num_per_round=4, round_mode="async_buffered",
+                         async_buffer_k=2, async_alpha=1.0,
+                         async_staleness_weighting="polynomial",
+                         async_staleness_poly=1.0, async_staleness_cap=4,
+                         **kw)
+        return AsyncFedMLAggregator(args, {"w": np.zeros((2,), np.float32)})
+
+    def test_pour_is_staleness_weighted_delta_average(self):
+        agg = self._agg()
+        # two fresh uploads at version 0: plain weighted average, alpha=1
+        agg.add_async_upload(1, {"w": np.asarray([1.0, 0.0], np.float32)},
+                             1.0, up_version=0, arrival_t=0.0,
+                             compressed=False)
+        agg.add_async_upload(2, {"w": np.asarray([0.0, 1.0], np.float32)},
+                             3.0, up_version=0, arrival_t=1.0,
+                             compressed=False)
+        arrivals = agg.pour()
+        assert agg.version == 1
+        assert [a["staleness"] for a in arrivals] == [0, 0]
+        np.testing.assert_allclose(np.asarray(agg.global_params["w"]),
+                                   [0.25, 0.75])
+        # now a STALE upload from version 0 (staleness 1, weight 1/2)
+        # next to a fresh one: delta formed against the version-0 base
+        agg.add_async_upload(3, {"w": np.asarray([1.25, 0.75], np.float32)},
+                             1.0, up_version=0, arrival_t=2.0,
+                             compressed=False)  # delta vs v0 = (1.25, .75)
+        agg.add_async_upload(1, {"w": np.asarray([1.25, 0.75], np.float32)},
+                             1.0, up_version=1, arrival_t=3.0,
+                             compressed=False)  # delta vs v1 = (1.0, 0.0)
+        arrivals = agg.pour()
+        assert [a["staleness"] for a in arrivals] == [1, 0]
+        s = 0.5  # (1 + staleness)^-1
+        exp_mix = (s * np.asarray([1.25, 0.75]) + 1.0 * np.asarray(
+            [1.0, 0.0])) / (s + 1.0)
+        exp_scale = (s + 1.0) / 2.0  # alpha * sum(w s)/sum(w)
+        np.testing.assert_allclose(
+            np.asarray(agg.global_params["w"]),
+            np.asarray([0.25, 0.75]) + exp_scale * exp_mix, rtol=1e-6)
+
+    def test_base_ring_prunes_and_falls_back_to_oldest(self, caplog):
+        agg = self._agg()
+        for v in range(8):  # 8 pours; cap 4 bounds the ring
+            agg.add_async_upload(1, {"w": np.zeros(2, np.float32)}, 1.0,
+                                 up_version=v, arrival_t=float(v),
+                                 compressed=False)
+            agg.add_async_upload(2, {"w": np.zeros(2, np.float32)}, 1.0,
+                                 up_version=v, arrival_t=v + 0.5,
+                                 compressed=False)
+            agg.pour()
+        assert agg.version == 8
+        assert min(agg._base_ring) >= 8 - 4
+        with caplog.at_level("WARNING"):
+            base = agg.base_for(0)  # evicted: oldest retained, loudly
+        np.testing.assert_array_equal(base,
+                                      agg._base_ring[min(agg._base_ring)])
+        assert any("base ring" in r.message for r in caplog.records)
+
+    def test_refuses_defense_and_dp(self):
+        with pytest.raises(ValueError, match="async_buffered"):
+            self._agg(enable_defense=True, defense_type="krum",
+                      byzantine_client_num=1)
+
+    def test_pour_timeout_never_bottoms_out_at_zero(self):
+        """With neither timeout knob set the liveness valve must still
+        arm: K crashed silos would otherwise hang the session forever."""
+        import threading
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.core.distributed.communication.inproc import \
+            InProcBroker
+        from fedml_tpu.cross_silo.horizontal.runner import build_server
+        args = Arguments(dataset="synthetic_mnist", model="lr",
+                         client_num_in_total=4, client_num_per_round=4,
+                         comm_round=4, training_type="cross_silo",
+                         round_mode="async_buffered")
+        args.inproc_broker = InProcBroker()
+        fed, output_dim = data_mod.load(args)
+        server = build_server(args, fed,
+                              model_mod.create(args, output_dim),
+                              backend="INPROC")
+        assert server.pour_timeout_s == server.DEFAULT_POUR_TIMEOUT_S
+        args2 = Arguments(dataset="synthetic_mnist", model="lr",
+                          client_num_in_total=4, client_num_per_round=4,
+                          comm_round=4, training_type="cross_silo",
+                          round_mode="async_buffered", round_timeout_s=7.0)
+        args2.inproc_broker = InProcBroker()
+        server2 = build_server(args2, fed,
+                               model_mod.create(args2, output_dim),
+                               backend="INPROC")
+        assert server2.pour_timeout_s == 7.0
+
+
+# --- retry budget deadline (backoff satellite) -------------------------------
+
+class TestRetryDeadline:
+    def test_deadline_caps_total_elapsed_not_just_attempts(self):
+        from fedml_tpu.core.distributed.communication.backoff import \
+            retry_with_backoff
+        calls = []
+
+        def slow_fail():
+            calls.append(time.monotonic())
+            time.sleep(0.03)  # time spent INSIDE fn counts too
+            raise OSError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            retry_with_backoff(slow_fail, max_attempts=100, base_s=0.001,
+                               max_s=0.005, deadline_s=0.1, seed=0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0          # nowhere near 100 attempts' worth
+        assert 1 <= len(calls) <= 6   # the budget cut it off early
+
+    def test_policy_wires_the_deadline_knob(self):
+        from fedml_tpu.core.distributed.communication.backoff import \
+            retry_policy_from_args
+        assert retry_policy_from_args(Arguments())["deadline_s"] is None
+        pol = retry_policy_from_args(
+            Arguments(comm_retry_deadline_s=7.5))
+        assert pol["deadline_s"] == 7.5
+        # and the dict feeds retry_with_backoff verbatim
+        from fedml_tpu.core.distributed.communication.backoff import \
+            retry_with_backoff
+        with pytest.raises(OSError):
+            retry_with_backoff(lambda: (_ for _ in ()).throw(OSError()),
+                               retry_on=(OSError,), **dict(pol,
+                                                           max_attempts=0))
+
+
+# --- selection store: arrival-rate posterior ---------------------------------
+
+class TestArrivalPosterior:
+    def test_record_and_predict(self):
+        from fedml_tpu.core.selection import ClientStatsStore
+        st = ClientStatsStore(4)
+        for gap in (2.0, 2.0, 2.0):
+            st.record_arrival(1, gap)
+        st.record_arrival(2, 8.0)
+        rate = st.arrival_rate()
+        assert rate[1] == pytest.approx(0.5)
+        assert rate[0] == 0.0  # never observed: no rate, not infinite
+        pred = st.predicted_staleness(pour_interval_s=2.0)
+        assert pred[1] == pytest.approx(1.0)
+        assert pred[2] == pytest.approx(4.0)
+        assert np.isnan(pred[0])
+
+    def test_checkpoint_tolerates_pre_async_state(self):
+        from fedml_tpu.core.selection import ClientStatsStore
+        st = ClientStatsStore(4)
+        st.record_arrival(1, 2.0)
+        old = {k: v for k, v in st.state_dict().items()
+               if k not in ("ema_interarrival", "arr_obs")}
+        st2 = ClientStatsStore(4)
+        st2.load_state_dict(old)  # pre-async checkpoint: resumes cold
+        assert np.all(st2.arr_obs == 0)
+
+
+# --- in-proc async WAN session + chaos soak (slow) ---------------------------
+
+def _run_async_session(args, n_clients, timeout_s):
+    import threading
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.core.distributed.communication.inproc import InProcBroker
+    from fedml_tpu.cross_silo.horizontal.runner import (build_client,
+                                                        build_server)
+    broker = InProcBroker()
+    args.inproc_broker = broker
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    server = build_server(args, fed, bundle, backend="INPROC")
+    clients = [build_client(args, fed, bundle, rank=r, backend="INPROC")
+               for r in range(1, n_clients + 1)]
+    for c in clients:
+        threading.Thread(target=c.run, daemon=True).start()
+    done = {}
+
+    def run_server():
+        server.run()
+        done["ok"] = True
+
+    st = threading.Thread(target=run_server, daemon=True)
+    st.start()
+    st.join(timeout=timeout_s)
+    assert done.get("ok"), "async session stalled"
+    return server
+
+
+@pytest.mark.slow
+def test_async_inproc_session_learns():
+    from fedml_tpu.cross_silo.server.async_server import \
+        AsyncFedMLServerManager
+    args = Arguments(dataset="synthetic_mnist", model="lr",
+                     client_num_in_total=4, client_num_per_round=4,
+                     comm_round=12, epochs=1, batch_size=32,
+                     learning_rate=0.1, frequency_of_the_test=3,
+                     random_seed=9, training_type="cross_silo",
+                     round_mode="async_buffered", async_pour_timeout_s=20.0)
+    server = _run_async_session(args, 4, timeout_s=240.0)
+    assert isinstance(server, AsyncFedMLServerManager)
+    assert len(server.result["history"]) == 12
+    assert server.result["final_test_acc"] > 0.6
+    # staleness-tagged arrivals were recorded at aggregation time
+    pours = server.chaos_ledger.pours()
+    assert len(pours) == 12
+    assert all("arrivals" in p["injected"] for p in pours)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_async_chaos_soak_200_pours_no_deadlock():
+    """The async server under dropout + straggler + link faults for 200
+    pours: the pour loop (buffer trigger + partial-pour timeout + empty-
+    fire re-sync nudge) must never deadlock, and the buffer ledger must
+    balance — every arrival poured exactly once or still buffered."""
+    args = Arguments(dataset="synthetic_mnist", model="lr",
+                     client_num_in_total=4, client_num_per_round=4,
+                     comm_round=200, epochs=1, batch_size=32,
+                     learning_rate=0.05, frequency_of_the_test=50,
+                     random_seed=9, training_type="cross_silo",
+                     round_mode="async_buffered", async_buffer_k=2,
+                     async_pour_timeout_s=3.0,
+                     chaos_dropout_prob=0.2, chaos_straggler_prob=0.2,
+                     chaos_straggler_work=0.5, chaos_link_loss_prob=0.05,
+                     chaos_link_dup_prob=0.05, chaos_seed=23)
+    server = _run_async_session(args, 4, timeout_s=540.0)
+    assert len(server.result["history"]) == 200
+    c = server.aggregator.buffer.counters
+    assert c["added"] == c["poured"] + c["buffered"], c
+    pours = server.chaos_ledger.pours()
+    assert len(pours) == 200
+    assert sum(p["observed"]["poured"] for p in pours) == c["poured"]
+    # staleness genuinely spread under faults
+    stal = [a["staleness"] for p in pours
+            for a in p["injected"]["arrivals"]]
+    assert max(stal) >= 1
