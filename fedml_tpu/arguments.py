@@ -148,6 +148,11 @@ _SCHEMA: Dict[str, Any] = {
     # ceil(frac * expected) silos reported; below quorum the server keeps
     # waiting (another timeout interval) instead of averaging a sliver
     "round_quorum_frac": 0.0,
+    # cross-silo DATA-index assignment: legacy = the reference's
+    # round-robin (rank i gets sampled index i mod k, bit-identical);
+    # scored = the stats store ranks silos by availability/latency and
+    # the first-sampled indices go to the most deliverable silos
+    "silo_index_assignment": "legacy",
     # async_args — buffered-async rounds (core/async_rounds, FedBuff +
     # FedAsync staleness decay). Default `sync` keeps every path
     # bit-identical: the round barrier, FSM, and engine programs are
@@ -189,6 +194,10 @@ _SCHEMA: Dict[str, Any] = {
     "enable_defense": False,
     "defense_type": None,
     "rfa_iters": 8,              # Weiszfeld iterations for the RFA defense
+    # rfa_tol > 0: convergence-based early exit — rfa_iters becomes a
+    # budget, the loop stops once the estimate moves < tol. 0 (default)
+    # keeps the exact fixed trip count, bit-parity-tested host vs sharded
+    "rfa_tol": 0.0,
     "enable_dp": False,
     "dp_mechanism": "gaussian",
     "enable_dp_ldp": False,

@@ -41,6 +41,34 @@ def stack_to_matrix(stacked: PyTree) -> jnp.ndarray:
         [jnp.reshape(l, (k, -1)).astype(jnp.float32) for l in leaves], axis=1)
 
 
+def verdict_from_info(info, k: int) -> Optional[np.ndarray]:
+    """Map a host defense kernel's info dict to the [K] per-client verdict
+    the selection subsystem consumes (selection masks / keep flags /
+    continuous weights). None when the defense exposes no per-client
+    notion — reputation then simply sees no evidence this round.
+
+    Semantic guard: ``selected``/``kept`` must be BINARY masks — host
+    bulyan's ``selected`` carries top-theta row INDICES, which would pass
+    a shape-only check (theta == k when byzantine_count == 0) and brand
+    arbitrary clients. Continuous keys must already live in [0, 1]."""
+    if not isinstance(info, dict):
+        return None
+    for key, binary in (("selected", True), ("kept", True),
+                        ("fg_weights", False), ("confidence", False)):
+        v = info.get(key)
+        if v is None:
+            continue
+        v = np.asarray(v, np.float32)
+        if v.shape != (k,):
+            continue
+        if binary and not np.all((v == 0.0) | (v == 1.0)):
+            continue  # an index list, not an inclusion mask
+        if not binary and (np.min(v) < 0.0 or np.max(v) > 1.0):
+            continue
+        return v
+    return None
+
+
 class FedMLDefender:
     """Configured from args; applied by engines/aggregators when
     ``args.enable_defense`` (stage semantics of the reference's
@@ -62,6 +90,10 @@ class FedMLDefender:
         self.dp_stddev = get_float(args, "stddev", 0.002)
         self.alpha = get_float(args, "alpha", 1.0)
         self.rfa_iters = get_int(args, "rfa_iters", 8)
+        # rfa_tol > 0: convergence-based Weiszfeld early exit (rfa_iters
+        # becomes a budget, not a trip count); 0 keeps the fixed count —
+        # the bit-parity default vs the sharded kernel
+        self.rfa_tol = get_float(args, "rfa_tol", 0.0)
         # host-side cross-round state
         self._fg_history: Optional[np.ndarray] = None
         self._cclip_momentum = None
@@ -124,7 +156,8 @@ class FedMLDefender:
             return robust_agg.trimmed_mean(mat, weights, self.trim_fraction)
         if d in ("rfa", "geometric_median"):
             return robust_agg.geometric_median(mat, weights,
-                                               iters=self.rfa_iters)
+                                               iters=self.rfa_iters,
+                                               tol=self.rfa_tol)
         if d == "norm_clip":
             return robust_agg.norm_clip(mat, weights, self.norm_bound)
         if d == "cclip":

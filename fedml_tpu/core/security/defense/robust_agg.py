@@ -91,9 +91,19 @@ def trimmed_mean(updates: Arr, weights: Arr, trim_fraction: float = 0.1
 
 
 def geometric_median(updates: Arr, weights: Arr, iters: int = 8,
-                     eps: float = 1e-8) -> Tuple[Arr, Dict]:
+                     eps: float = 1e-8, tol: float = 0.0) -> Tuple[Arr, Dict]:
     """RFA — smoothed Weiszfeld iteration for the weighted geometric median
-    (Pillutla et al.; reference ``defense/RFA_defense.py``)."""
+    (Pillutla et al.; reference ``defense/RFA_defense.py``).
+
+    ``tol > 0`` (the ``rfa_tol`` knob) turns the fixed trip count into a
+    budget: iterate until the estimate moves less than ``tol`` (euclidean)
+    or ``iters`` is exhausted, and report the count in ``info``. At the
+    default ``tol = 0`` the loop is the exact fixed-trip-count kernel the
+    sharded ``lax.while_loop`` is bit-parity-tested against; with a
+    tolerance both kernels share the same movement rule but associate
+    their float reductions differently (flat sum here, psum of per-shard
+    partials there), so near the exit boundary they may differ by one
+    iteration — parity then holds to the tolerance, not the bit."""
     w = _normalize(weights)
 
     def body(_, v):
@@ -103,8 +113,22 @@ def geometric_median(updates: Arr, weights: Arr, iters: int = 8,
         return jnp.einsum("k,kd->d", beta, updates)
 
     v0 = weighted_mean(updates, w)
-    v = jax.lax.fori_loop(0, iters, body, v0)
-    return v, {}
+    if tol <= 0.0:
+        v = jax.lax.fori_loop(0, iters, body, v0)
+        return v, {"iters_run": jnp.int32(iters)}
+
+    def step(carry):
+        i, v, _ = carry
+        new = body(0, v)
+        return i + 1, new, jnp.linalg.norm(new - v)
+
+    def cond(carry):
+        i, _, moved = carry
+        return (i < iters) & (moved > tol)
+
+    i, v, _ = jax.lax.while_loop(
+        cond, step, (jnp.int32(0), v0, jnp.float32(jnp.inf)))
+    return v, {"iters_run": i}
 
 
 def bulyan(updates: Arr, weights: Arr, byzantine_count: int = 0

@@ -111,6 +111,7 @@ class DefenseHP:
     stddev: float = 0.002
     alpha: float = 1.0
     rfa_iters: int = 8
+    rfa_tol: float = 0.0
     cclip_iters: int = 3
     wbc_iters: int = 8
     soteria_frac: float = 0.5
@@ -131,6 +132,7 @@ class DefenseHP:
             stddev=float(dfd.dp_stddev),
             alpha=float(dfd.alpha),
             rfa_iters=int(getattr(dfd, "rfa_iters", 8)),
+            rfa_tol=float(getattr(dfd, "rfa_tol", 0.0)),
             soteria_frac=get_float(dfd.args, "soteria_frac", 0.5),
             cr_threshold=get_float(dfd.args, "cross_round_threshold", -0.5),
         )
@@ -216,6 +218,70 @@ def _apply_attack_shard(attack_type: str, mat_s, byz_mask, key, scale,
 # per-shard kernel helpers (pure SPMD bodies, run INSIDE a shard_map)
 # ---------------------------------------------------------------------------
 
+# Partial-pour row masking (buffered-async defended pours): the pour
+# program's [K] buffer shape is compiled once, so a partial pour (fewer
+# than K arrivals — drained event heap, pour-timeout valve) pads with
+# zero rows and hands the kernels a [K] validity mask. Masking semantics
+# per kernel family, all reducing to the unmasked code at mask=None
+# (the sync paths never pass a mask — their behavior is bit-identical):
+#
+# * weight-folded kernels (mean, norm_clip, rfa, cclip, soteria, rlr)
+#   are mask-exact already: padded rows carry weight 0 (and sign(0) = 0
+#   for rlr's votes), so they vanish from every weighted reduction.
+# * coordinate sorts (median, trimmed_mean, slsgd) sort padded rows to
+#   +inf and index the valid prefix dynamically (_masked_median /
+#   _masked_sorted_window_mean).
+# * robust statistics (three_sigma, outlier_detection,
+#   residual_reweight) take their median/MAD over valid rows only.
+# * Gram selections (krum, multi_krum, bulyan, wbc) add +1e30 to any
+#   pair involving a padded row: every valid row's score gains the SAME
+#   inflated tail, so the relative order among valid rows is preserved
+#   and padded rows are never selected (while a selection size larger
+#   than the valid count degrades toward the zero rows — a conservative,
+#   smaller step — documented rather than hidden).
+# * stateful scatters (foolsgold, cross_round) must not write padded
+#   rows into per-client history; callers pad ``ids`` with ids DISJOINT
+#   from the valid rows so the masked writes are exact no-ops.
+
+def _masked_median(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Median over rows with ``mask > 0`` (axis 0; works for [K] vectors
+    and [K, D] matrices). Invalid rows sort to +inf; the two middle
+    elements of the valid prefix are indexed dynamically."""
+    key = mask if x.ndim == 1 else mask[:, None]
+    big = jnp.where(key > 0, x, jnp.inf)
+    s = jnp.sort(big, axis=0)
+    n = jnp.maximum(jnp.sum(mask).astype(jnp.int32), 1)
+    return 0.5 * (s[(n - 1) // 2] + s[n // 2])
+
+
+def _masked_sorted_window_mean(mat_s: jnp.ndarray, mask: jnp.ndarray,
+                               b) -> jnp.ndarray:
+    """Per-coordinate mean of the sorted valid rows with ``b`` trimmed
+    from each side (the masked trimmed-mean / SLSGD core). ``b`` may be
+    traced; it is clamped to the valid count."""
+    k = mat_s.shape[0]
+    big = jnp.where(mask[:, None] > 0, mat_s, jnp.inf)
+    s = jnp.sort(big, axis=0)
+    n = jnp.maximum(jnp.sum(mask).astype(jnp.int32), 1)
+    b = jnp.clip(jnp.asarray(b, jnp.int32), 0, (n - 1) // 2)
+    idx = jnp.arange(k)[:, None]
+    keep = ((idx >= b) & (idx < n - b)).astype(mat_s.dtype)
+    s = jnp.where(jnp.isfinite(s), s, 0.0)
+    return (jnp.sum(s * keep, axis=0)
+            / jnp.maximum(jnp.sum(keep, axis=0), 1.0))
+
+
+def _mask_dists(dists: jnp.ndarray,
+                mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """+1e30 on every pair involving an invalid row: valid rows' score
+    tails inflate identically (order preserved), invalid rows score off
+    the chart and are never selected."""
+    if mask is None:
+        return dists
+    valid = mask[:, None] * mask[None, :]
+    return dists + (1.0 - valid) * 1e30
+
+
 def _psum_dists(mat_s: jnp.ndarray, axis: str) -> jnp.ndarray:
     """Replicated [K, K] squared-distance Gram from per-shard partials."""
     return jax.lax.psum(robust_agg.pairwise_sq_dists(mat_s), axis)
@@ -243,15 +309,19 @@ def _selection_weights(defense_type: str, dists: jnp.ndarray,
     return weights, jnp.ones(k, weights.dtype)  # mean
 
 
-def _bulyan_shard(mat_s, weights, axis, hp: DefenseHP):
+def _bulyan_shard(mat_s, weights, axis, hp: DefenseHP, mask=None):
     """Bulyan (El Mhamdi et al.) on a feature shard: iterated Multi-Krum
     selection from the psum'd [K, K] Gram (theta = K - 2f rows), then the
     per-coordinate nearest-to-median trimmed mean — purely local once the
-    replicated selection is known. Mirrors robust_agg.bulyan row for row."""
+    replicated selection is known. Mirrors robust_agg.bulyan row for row.
+    Under a partial-pour ``mask``, padded rows are never preferred; a
+    theta larger than the valid count pulls the trimmed mean toward the
+    zero padding (a conservative, smaller step — see the mask notes)."""
     k = mat_s.shape[0]
     f = hp.byzantine_count
     theta = max(k - 2 * f, 1)
-    scores = robust_agg.krum_scores_from_dists(_psum_dists(mat_s, axis), f)
+    scores = robust_agg.krum_scores_from_dists(
+        _mask_dists(_psum_dists(mat_s, axis), mask), f)
     _, sel = jax.lax.top_k(-scores, theta)
     chosen = mat_s[sel]
     beta = max(theta - 2 * f, 1)
@@ -266,32 +336,73 @@ def _rfa_shard(mat_s, weights, axis, hp: DefenseHP, eps: float = 1e-8):
     """RFA / geometric median (Pillutla et al.): smoothed Weiszfeld as a
     ``lax.while_loop`` whose [D]-sized estimate stays feature-sharded —
     each iteration exchanges only the [K] squared-distance fragments
-    (psum of per-shard partial sums); the estimate never gathers."""
+    (psum of per-shard partial sums); the estimate never gathers.
+    Mask-exact under partial pours: padded rows carry weight 0.
+
+    ``rfa_tol > 0`` adds a convergence early exit: stop once the
+    iterate's global movement (psum'd across shards, so every shard
+    agrees on the verdict) drops below the tolerance. Parity story vs
+    the host kernel (:func:`robust_agg.geometric_median`): at the
+    default ``rfa_tol: 0`` both run the exact fixed trip count and are
+    bit-parity-tested; with a tolerance both kernels share the SAME
+    movement rule, but the sharded psum associates float sums
+    differently than the host's flat reduction, so near the exit
+    boundary the two may differ by one iteration — parity then holds to
+    the tolerance, not to the bit (documented, regression-tested)."""
     w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
     v0 = jnp.einsum("k,kd->d", w, mat_s)
 
-    def step(carry):
-        i, v = carry
+    def iterate(v):
         part = jnp.sum((mat_s - v[None]) ** 2, axis=1)
         dist = jnp.sqrt(jax.lax.psum(part, axis) + eps)
         beta = w / jnp.maximum(dist, eps)
         beta = beta / jnp.maximum(jnp.sum(beta), 1e-12)
-        return i + 1, jnp.einsum("k,kd->d", beta, mat_s)
+        return jnp.einsum("k,kd->d", beta, mat_s)
 
-    _, v = jax.lax.while_loop(lambda c: c[0] < hp.rfa_iters, step,
-                              (jnp.int32(0), v0))
+    if hp.rfa_tol <= 0.0:  # fixed trip count: the bit-parity default
+        def step(carry):
+            i, v = carry
+            return i + 1, iterate(v)
+
+        _, v = jax.lax.while_loop(lambda c: c[0] < hp.rfa_iters, step,
+                                  (jnp.int32(0), v0))
+        return v
+
+    def step_tol(carry):
+        i, v, _ = carry
+        new = iterate(v)
+        moved = jnp.sqrt(jax.lax.psum(jnp.sum((new - v) ** 2), axis))
+        return i + 1, new, moved
+
+    def cond_tol(carry):
+        i, _, moved = carry
+        return (i < hp.rfa_iters) & (moved > hp.rfa_tol)
+
+    _, v, _ = jax.lax.while_loop(
+        cond_tol, step_tol, (jnp.int32(0), v0, jnp.float32(jnp.inf)))
     return v
 
 
-def _three_sigma_shard(mat_s, weights, axis):
+def _three_sigma_shard(mat_s, weights, axis, mask=None):
     """host parity: score_i = ||u_i - coord_median||; keep within
-    median(score) + 3 * 1.4826 * MAD(score)."""
-    med = jnp.median(mat_s, axis=0)
+    median(score) + 3 * 1.4826 * MAD(score). Masked: the median/MAD
+    statistics run over valid rows only (zero padding would drag the
+    coordinate median and shrink the band)."""
+    if mask is None:
+        med = jnp.median(mat_s, axis=0)
+    else:
+        med = _masked_median(mat_s, mask)
     part = jnp.sum((mat_s - med[None]) ** 2, axis=1)
     scores = jnp.sqrt(jax.lax.psum(part, axis))
-    mu = jnp.median(scores)
-    sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
-    keep = (scores <= mu + 3.0 * sd).astype(weights.dtype)
+    if mask is None:
+        mu = jnp.median(scores)
+        sd = 1.4826 * jnp.median(jnp.abs(scores - mu)) + 1e-12
+        keep = (scores <= mu + 3.0 * sd).astype(weights.dtype)
+    else:
+        mu = _masked_median(scores, mask)
+        sd = 1.4826 * _masked_median(jnp.abs(scores - mu), mask) + 1e-12
+        keep = ((scores <= mu + 3.0 * sd)
+                & (mask > 0)).astype(weights.dtype)
     return robust_agg.weighted_mean(mat_s, weights * keep), keep
 
 
@@ -301,20 +412,36 @@ def _norm_clip_shard(mat_s, weights, axis, hp: DefenseHP):
     return robust_agg.weighted_mean(mat_s * scale[:, None], weights)
 
 
-def _outlier_shard(mat_s, weights, axis, hp: DefenseHP):
+def _outlier_shard(mat_s, weights, axis, hp: DefenseHP, mask=None):
     norms = _psum_row_norms(mat_s, axis)
-    mu = jnp.median(norms)
-    sd = 1.4826 * jnp.median(jnp.abs(norms - mu)) + 1e-12
-    keep = (jnp.abs(norms - mu) <= hp.z_threshold * sd).astype(mat_s.dtype)
+    if mask is None:
+        mu = jnp.median(norms)
+        sd = 1.4826 * jnp.median(jnp.abs(norms - mu)) + 1e-12
+        keep = (jnp.abs(norms - mu)
+                <= hp.z_threshold * sd).astype(mat_s.dtype)
+    else:
+        mu = _masked_median(norms, mask)
+        sd = 1.4826 * _masked_median(jnp.abs(norms - mu), mask) + 1e-12
+        keep = ((jnp.abs(norms - mu) <= hp.z_threshold * sd)
+                & (mask > 0)).astype(mat_s.dtype)
     return robust_agg.weighted_mean(mat_s, weights * keep), keep
 
 
-def _residual_shard(mat_s, weights, axis, hp: DefenseHP):
-    med = jnp.median(mat_s, axis=0)
+def _residual_shard(mat_s, weights, axis, hp: DefenseHP, mask=None):
+    if mask is None:
+        med = jnp.median(mat_s, axis=0)
+    else:
+        med = _masked_median(mat_s, mask)
     part = jnp.sum((mat_s - med[None]) ** 2, axis=1)
     resid = jnp.sqrt(jax.lax.psum(part, axis))
-    mad = jnp.median(jnp.abs(resid - jnp.median(resid))) + 1e-12
+    if mask is None:
+        mad = jnp.median(jnp.abs(resid - jnp.median(resid))) + 1e-12
+    else:
+        mad = _masked_median(jnp.abs(resid - _masked_median(resid, mask)),
+                             mask) + 1e-12
     conf = jnp.clip(hp.resid_lam * mad / jnp.maximum(resid, 1e-12), 0.0, 1.0)
+    if mask is not None:
+        conf = conf * mask
     return robust_agg.weighted_mean(mat_s, weights * conf), conf
 
 
@@ -326,12 +453,17 @@ def _rlr_shard(mat_s, weights, axis, hp: DefenseHP):
     return robust_agg.weighted_mean(mat_s, weights) * lr_sign
 
 
-def _wbc_shard(mat_s, weights, axis, hp: DefenseHP):
+def _wbc_shard(mat_s, weights, axis, hp: DefenseHP, mask=None):
     """2-means over the rows with feature-sharded [2, D/n] centroids;
     assignments come from psum'd squared distances each iteration, the
-    centroid update is a local per-coordinate mean."""
+    centroid update is a local per-coordinate mean. Masked: padded rows
+    join neither the centroid seeding (their pairs score -1) nor the
+    centroid means nor the majority vote."""
     k = mat_s.shape[0]
+    valid = jnp.ones(k, mat_s.dtype) if mask is None else mask
     dists = _psum_dists(mat_s, axis)
+    if mask is not None:
+        dists = jnp.where(valid[:, None] * valid[None, :] > 0, dists, -1.0)
     flat_idx = jnp.argmax(dists)
     c = jnp.stack([mat_s[flat_idx // k], mat_s[flat_idx % k]])
 
@@ -341,16 +473,18 @@ def _wbc_shard(mat_s, weights, axis, hp: DefenseHP):
         return jnp.argmin(jnp.stack([d0, d1]), axis=0)
 
     def body(_, c):
-        one = (assign_to(c) == 1).astype(mat_s.dtype)[:, None]
+        one = ((assign_to(c) == 1).astype(mat_s.dtype) * valid)[:, None]
+        zero = ((valid - one[:, 0]))[:, None]
         n1 = jnp.maximum(jnp.sum(one), 1.0)
-        n0 = jnp.maximum(jnp.sum(1.0 - one), 1.0)
-        return jnp.stack([jnp.sum(mat_s * (1 - one), axis=0) / n0,
+        n0 = jnp.maximum(jnp.sum(zero), 1.0)
+        return jnp.stack([jnp.sum(mat_s * zero, axis=0) / n0,
                           jnp.sum(mat_s * one, axis=0) / n1])
 
     c = jax.lax.fori_loop(0, hp.wbc_iters, body, c)
     assign = assign_to(c)
-    majority = (jnp.sum(assign) > k / 2).astype(jnp.int32)
-    keep = (assign == majority).astype(mat_s.dtype)
+    majority = (jnp.sum(assign * valid)
+                > jnp.sum(valid) / 2).astype(jnp.int32)
+    keep = (assign == majority).astype(mat_s.dtype) * valid
     return robust_agg.weighted_mean(mat_s, weights * keep), keep
 
 
@@ -425,24 +559,33 @@ def _cclip_shard(mat_s, weights, axis, hp: DefenseHP, state):
     return v, {"momentum": v}
 
 
-def _slsgd_shard(mat_s, weights, axis, hp: DefenseHP, state):
+def _slsgd_shard(mat_s, weights, axis, hp: DefenseHP, state, mask=None):
     """SLSGD trimmed mean (per-coordinate, local) mixed with the previous
     global — a feature-sharded state leaf; round 0 (has == 0) skips the
-    mix exactly like the host kernel's ``prev_global is None``."""
+    mix exactly like the host kernel's ``prev_global is None``. Masked:
+    the trim window covers the sorted VALID rows only."""
     k = mat_s.shape[0]
-    b = min(max(hp.byzantine_count, 1), (k - 1) // 2)
-    s = jnp.sort(mat_s, axis=0)
-    agg = jnp.mean(s[b:k - b] if b > 0 else s, axis=0)
+    if mask is None:
+        b = min(max(hp.byzantine_count, 1), (k - 1) // 2)
+        s = jnp.sort(mat_s, axis=0)
+        agg = jnp.mean(s[b:k - b] if b > 0 else s, axis=0)
+    else:
+        agg = _masked_sorted_window_mean(mat_s, mask,
+                                         max(hp.byzantine_count, 1))
     mixed = jnp.where(state["has"] > 0,
                       (1.0 - hp.alpha) * state["prev"] + hp.alpha * agg, agg)
     return mixed, {"prev": mixed, "has": jnp.float32(1)}
 
 
-def _cross_round_shard(mat_s, weights, axis, hp: DefenseHP, state, ids):
+def _cross_round_shard(mat_s, weights, axis, hp: DefenseHP, state, ids,
+                       mask=None):
     """Cross-round consistency: per-client previous updates live in a
     feature-sharded [N, D/n] state matrix keyed by TRUE client id; cosines
     come from psum'd per-shard dot/norm fragments. Clients without history
-    pass through, as on the host path."""
+    pass through, as on the host path. Masked rows neither write their
+    (zero) row into the state nor mark history as present — callers pad
+    ``ids`` disjoint from the valid rows, so the guarded writes are
+    no-ops."""
     prev = state["prev"][ids]
     has = state["has"][ids]
     dot = jax.lax.psum(jnp.sum(mat_s * prev, axis=1), axis)
@@ -451,17 +594,27 @@ def _cross_round_shard(mat_s, weights, axis, hp: DefenseHP, state, ids):
     cos = dot / (n_cur * n_prev + 1e-12)
     keep = jnp.where(has > 0,
                      (cos >= hp.cr_threshold).astype(mat_s.dtype), 1.0)
-    new_state = {"prev": state["prev"].at[ids].set(mat_s),
-                 "has": state["has"].at[ids].set(1.0)}
+    if mask is None:
+        new_state = {"prev": state["prev"].at[ids].set(mat_s),
+                     "has": state["has"].at[ids].set(1.0)}
+    else:
+        keep = keep * mask
+        new_state = {
+            "prev": state["prev"].at[ids].set(
+                jnp.where(mask[:, None] > 0, mat_s, prev)),
+            "has": state["has"].at[ids].set(jnp.maximum(mask, has)),
+        }
     return robust_agg.weighted_mean(mat_s, weights * keep), new_state, keep
 
 
-def _foolsgold_shard(mat_s, weights, axis, state, ids):
+def _foolsgold_shard(mat_s, weights, axis, state, ids, mask=None):
     """FoolsGold with the accumulated history as feature-sharded [N, D/n]
     state: add this round's (post-attack) rows into the clients' history
     FIRST — the host kernel scores similarities on the updated history —
-    then down-weight mutually-similar clients."""
-    hist_rows = state["history"][ids] + mat_s
+    then down-weight mutually-similar clients. Masked rows add nothing to
+    history (ids are padded disjoint, see the mask notes)."""
+    add = mat_s if mask is None else mask[:, None] * mat_s
+    hist_rows = state["history"][ids] + add
     new_state = {"history": state["history"].at[ids].set(hist_rows)}
     wv = _foolsgold_weights_shard(hist_rows, axis)
     return robust_agg.weighted_mean(mat_s, weights * wv), new_state, wv
@@ -481,6 +634,7 @@ def defend_shard_stateful(
     ids: Optional[jnp.ndarray] = None,
     key: Optional[jax.Array] = None,
     true_d: Optional[int] = None,
+    row_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], jnp.ndarray]:
     """The per-shard defense kernel: [K, D/n] feature shard + replicated
     [K] weights (+ optional cross-round ``state``, sampled client ``ids``,
@@ -498,38 +652,52 @@ def defend_shard_stateful(
     trimmed_mean, rfa, norm_clip, soteria, weak_dp, crfl, cclip, slsgd)
     have no per-client exclusion notion and report all-ones. It is
     replicated and [K]-sized — free to emit — and feeds the selection
-    subsystem's reputation scores with zero extra dispatches."""
+    subsystem's reputation scores with zero extra dispatches.
+
+    ``row_mask`` (optional [K], 1 = real row) marks partial-pour padding
+    (buffered-async defended pours); ``None`` — every sync path — runs
+    the exact unmasked code, bit-identical to before. See the mask notes
+    above the helpers for the per-family semantics."""
     hp = hp or DefenseHP()
     state = state if state is not None else {}
     ones = jnp.ones(mat_s.shape[0], jnp.float32)
+    mask = row_mask
     d = _canon(defense_type)
     if d == "mean":
         return robust_agg.weighted_mean(mat_s, weights), state, ones
     if d == "coordinate_median":
-        return robust_agg.coordinate_median(mat_s, weights)[0], state, ones
+        if mask is None:
+            return (robust_agg.coordinate_median(mat_s, weights)[0], state,
+                    ones)
+        return _masked_median(mat_s, mask), state, ones
     if d == "trimmed_mean":
-        return (robust_agg.trimmed_mean(mat_s, weights,
-                                        hp.trim_fraction)[0], state, ones)
+        if mask is None:
+            return (robust_agg.trimmed_mean(mat_s, weights,
+                                            hp.trim_fraction)[0], state,
+                    ones)
+        n = jnp.sum(mask).astype(jnp.float32)
+        b = jnp.floor(n * jnp.float32(hp.trim_fraction) + 1e-6)
+        return _masked_sorted_window_mean(mat_s, mask, b), state, ones
     if d == "three_sigma":
-        vec, keep = _three_sigma_shard(mat_s, weights, axis)
+        vec, keep = _three_sigma_shard(mat_s, weights, axis, mask=mask)
         return vec, state, keep
     if d == "bulyan":
-        vec, sel = _bulyan_shard(mat_s, weights, axis, hp)
+        vec, sel = _bulyan_shard(mat_s, weights, axis, hp, mask=mask)
         return vec, state, sel
     if d == "rfa":
         return _rfa_shard(mat_s, weights, axis, hp), state, ones
     if d == "norm_clip":
         return _norm_clip_shard(mat_s, weights, axis, hp), state, ones
     if d == "outlier_detection":
-        vec, keep = _outlier_shard(mat_s, weights, axis, hp)
+        vec, keep = _outlier_shard(mat_s, weights, axis, hp, mask=mask)
         return vec, state, keep
     if d == "residual_reweight":
-        vec, conf = _residual_shard(mat_s, weights, axis, hp)
+        vec, conf = _residual_shard(mat_s, weights, axis, hp, mask=mask)
         return vec, state, conf
     if d == "rlr":
         return _rlr_shard(mat_s, weights, axis, hp), state, ones
     if d == "wbc":
-        vec, keep = _wbc_shard(mat_s, weights, axis, hp)
+        vec, keep = _wbc_shard(mat_s, weights, axis, hp, mask=mask)
         return vec, state, keep
     if d == "soteria":
         if true_d is None:
@@ -543,20 +711,21 @@ def defend_shard_stateful(
         return _crfl_shard(mat_s, weights, axis, hp, key), state, ones
     if d == "foolsgold":
         vec, new_state, wv = _foolsgold_shard(mat_s, weights, axis, state,
-                                              ids)
+                                              ids, mask=mask)
         return vec, new_state, wv
     if d == "cclip":
         vec, new_state = _cclip_shard(mat_s, weights, axis, hp, state)
         return vec, new_state, ones
     if d == "slsgd":
-        vec, new_state = _slsgd_shard(mat_s, weights, axis, hp, state)
+        vec, new_state = _slsgd_shard(mat_s, weights, axis, hp, state,
+                                      mask=mask)
         return vec, new_state, ones
     if d == "cross_round":
         vec, new_state, keep = _cross_round_shard(mat_s, weights, axis, hp,
-                                                  state, ids)
+                                                  state, ids, mask=mask)
         return vec, new_state, keep
-    # krum / multi_krum: selection weights from the psum'd Gram
-    dists = _psum_dists(mat_s, axis)
+    # krum / multi_krum: selection weights from the psum'd (masked) Gram
+    dists = _mask_dists(_psum_dists(mat_s, axis), mask)
     sel_w, sel = _selection_weights(d, dists, weights,
                                     hp.byzantine_count, hp.multi_k)
     return robust_agg.weighted_mean(mat_s, sel_w), state, sel
@@ -588,7 +757,8 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
                       hp: DefenseHP, has_state: bool, true_d: int,
                       return_matrix: bool,
                       attack_type: Optional[str] = None,
-                      attack_scale: float = 1.0):
+                      attack_scale: float = 1.0,
+                      has_mask: bool = False):
     """One compiled kernel per (mesh, defense, params); jit re-traces only
     on new shapes — without this cache every round would recompile. NOTE:
     inputs are NOT donated here — the cached kernel is shared by engines
@@ -596,14 +766,15 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
     backs; the fused engine path (which owns its buffers) donates."""
     state_spec = defense_state_spec(defense_type, axis) if has_state else {}
 
-    def body(mat_s, weights, byz_mask, akey, dkey, state, ids):
+    def body(mat_s, weights, byz_mask, akey, dkey, state, ids, row_mask):
         # mat_s: [K, D/n] local shard
         if attack_type is not None:
             mat_s = _apply_attack_shard(attack_type, mat_s, byz_mask, akey,
                                         attack_scale, axis)
         vec, new_state, verdict = defend_shard_stateful(
             mat_s, weights, axis, defense_type, hp, state=state, ids=ids,
-            key=dkey, true_d=true_d)
+            key=dkey, true_d=true_d,
+            row_mask=row_mask if has_mask else None)
         out = (vec, new_state, verdict)
         return out + (mat_s,) if return_matrix else out
 
@@ -612,7 +783,7 @@ def _build_sharded_fn(mesh: Mesh, axis: str, defense_type: str,
         out_specs = out_specs + (P(None, axis),)
     return jax.jit(shard_map(
         body, mesh=mesh,
-        in_specs=(P(None, axis), P(), P(), P(), P(), state_spec, P()),
+        in_specs=(P(None, axis), P(), P(), P(), P(), state_spec, P(), P()),
         out_specs=out_specs,
         check_vma=False,
     ))
@@ -637,6 +808,7 @@ def defend_matrix_sharded(
     defense_key: Optional[jax.Array] = None,
     return_matrix: bool = False,
     return_verdict: bool = False,
+    row_mask: Optional[jnp.ndarray] = None,
 ):
     """[K, D] (feature-sharded over ``axis``) -> defended aggregate [D]
     (feature-sharded). The caller owns placement; this never gathers D
@@ -652,7 +824,8 @@ def defend_matrix_sharded(
     (the contribution assessor's input — it must see what the defense
     saw); with ``return_verdict=True`` the [K] per-client verdict (see
     :func:`defend_shard_stateful`) is appended LAST — the selection
-    subsystem's reputation input."""
+    subsystem's reputation input; ``row_mask`` marks partial-pour padding
+    rows (see :func:`defend_shard_stateful`)."""
     if not supports_sharded(defense_type):
         raise ValueError(
             f"defense_type {defense_type!r} has no sharded kernel; host "
@@ -668,7 +841,8 @@ def defend_matrix_sharded(
     stateful = is_stateful(defense_type)
     fn = _build_sharded_fn(mesh, axis, defense_type, hp, stateful, d,
                            bool(return_matrix),
-                           attack_type, float(attack_scale))
+                           attack_type, float(attack_scale),
+                           has_mask=row_mask is not None)
     if pad:
         mat = jnp.pad(mat, ((0, 0), (0, pad)))
     mat = jax.device_put(mat, NamedSharding(mesh, P(None, axis)))
@@ -690,9 +864,12 @@ def defend_matrix_sharded(
             lambda z, s: jax.device_put(z, NamedSharding(mesh, s)),
             defense_state_init(defense_type, n_total, d + pad),
             defense_state_spec(defense_type, axis))
+    if row_mask is None:
+        row_mask = jnp.ones(k, jnp.float32)
     out = fn(mat, jnp.asarray(weights, jnp.float32),
              jnp.asarray(byz_mask, jnp.float32), attack_key, defense_key,
-             state if stateful else {}, jnp.asarray(ids, jnp.int32))
+             state if stateful else {}, jnp.asarray(ids, jnp.int32),
+             jnp.asarray(row_mask, jnp.float32))
     vec, new_state, verdict = out[0], out[1], out[2]
     result = (vec[:d],)
     if stateful:

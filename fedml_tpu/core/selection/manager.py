@@ -166,6 +166,12 @@ class SelectionManager:
         if self.track:
             self.store.record_arrival(client_id, interarrival_s)
 
+    def flush(self) -> None:
+        """Materialize queued device-side observations NOW — the async
+        engine's dispatch ranking reads the store between pours, outside
+        any selection query."""
+        self._flush()
+
     def _flush(self) -> None:
         pending, self._pending = self._pending, []
         for rec in pending:

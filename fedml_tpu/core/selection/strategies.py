@@ -56,6 +56,16 @@ SELECTION_STRATEGIES = ("uniform", "power_of_choice", "oort", "reputation")
 Selection = Tuple[List[int], List[int]]  # (sampled ids, benched subset)
 
 
+def rep_bench_knobs(args) -> Tuple[float, float]:
+    """(reputation threshold, min-keep fraction) — the ONE reading shared
+    by the simulator's reputation strategy, the cross-silo silo
+    selection, and the async engine's rotation benching; three
+    independent ``getattr`` chains would let the default (or the
+    None-falls-back-to-0 handling) drift per surface."""
+    return (float(getattr(args, "selection_rep_threshold", 0.3) or 0.0),
+            float(getattr(args, "selection_min_keep_frac", 0.5) or 0.5))
+
+
 def cap_bench(cohort_n: int, flagged, badness, keep_frac: float,
               quorum: int = 1) -> List[int]:
     """The ONE bench-floor policy, shared by the simulator's reputation
@@ -179,14 +189,11 @@ class ReputationSelection(SelectionStrategy):
 
     def select(self, round_idx: int, n: int) -> Selection:
         sampled = self._uniform(round_idx, n)
-        thresh = float(getattr(self.args, "selection_rep_threshold", 0.3)
-                       or 0.0)
+        thresh, keep_frac = rep_bench_knobs(self.args)
         rep = self.store.reputation
         benched = cap_bench(
             len(sampled), [c for c in sampled if rep[c] < thresh],
-            badness=lambda c: -rep[c],
-            keep_frac=float(getattr(self.args, "selection_min_keep_frac",
-                                    0.5) or 0.5))
+            badness=lambda c: -rep[c], keep_frac=keep_frac)
         return sampled, benched
 
 

@@ -30,6 +30,20 @@ deadline) no longer exist:
   ``async_staleness_cap: 0`` the cap tracks observed latency / pour
   interval instead of a constant.
 
+* **Defended pours (ISSUE 7).** Robust defenses compose with the buffer:
+  at pour time every buffered delta is RE-BASED onto the current version
+  (subtracting the server movement it missed, read straight off the base
+  ring the server already owns), the staleness decay folds into the
+  defense's row weights, and ``defend_matrix`` aggregates the re-based
+  rows — at staleness 0 this is exactly the sync defended round's math.
+  The defense's per-silo verdict feeds the stats store's reputation
+  posterior, and non-uniform ``client_selection`` benches silos the
+  defenses keep excluding out of the post-pour re-sync (the empty-fire
+  nudge remains their probation path). ``weak_dp``/``crfl`` stay refused:
+  noise-adding defenses are DP by another name, and per-pour noise
+  accounting over a mixed-staleness buffer is the same open design that
+  keeps async+DP refused.
+
 Per-update arrival timestamps and staleness are recorded in the
 FaultLedger (``record_pour``) and mirrored to ``mlops.log_chaos`` so the
 bench and post-mortems can reconstruct the arrival distribution.
@@ -43,9 +57,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ...core import mlops
+from ...core.security.defense import verdict_from_info
 from ...core.async_rounds import (UpdateBuffer, adaptive_staleness_cap,
                                   buffer_k_from_args, make_staleness_fn,
                                   merge_alpha_from_args, pour_weights,
@@ -69,11 +85,24 @@ class AsyncFedMLAggregator(FedMLAggregator):
 
     def __init__(self, args, global_params, eval_fn=None):
         super().__init__(args, global_params, eval_fn=eval_fn)
-        if self.defender.is_defense_enabled() or self.dp.is_dp_enabled():
+        if self.dp.is_dp_enabled():
             raise ValueError(
-                "round_mode: async_buffered does not yet compose with "
-                "defenses or DP on the cross-silo server (both assume a "
-                "same-version cohort); use round_mode: sync")
+                "round_mode: async_buffered does not yet compose with DP "
+                "on the cross-silo server (per-pour accounting under "
+                "stale mixed cohorts is an open design); use "
+                "round_mode: sync")
+        if (self.defender.is_defense_enabled()
+                and self.defender.defense_type in ("weak_dp", "crfl")):
+            raise ValueError(
+                "round_mode: async_buffered refuses defense_type "
+                f"{self.defender.defense_type!r}: noise-adding defenses "
+                "are DP by another name, and per-pour noise accounting "
+                "over a mixed-staleness buffer is the same open design "
+                "that keeps async+DP refused; use round_mode: sync")
+        # defended pours draw their defense keys from a dedicated seeded
+        # stream (one fold per pour — deterministic for a given trace)
+        self._defense_key = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0) or 0) + 71)
         self.version = 0
         self.k = buffer_k_from_args(args, self.client_num)
         self.merge_alpha = merge_alpha_from_args(args)
@@ -151,10 +180,45 @@ class AsyncFedMLAggregator(FedMLAggregator):
         w = np.asarray([e.weight for e in entries], np.float64)
         norm_w, merge_scale = pour_weights(w, stal, self.staleness_fn,
                                            self.merge_alpha)
-        agg = np.zeros(entries[0].update.shape, np.float32)
-        for nw, e in zip(norm_w, entries):
-            agg = agg + np.asarray(e.update, np.float32) * np.float32(nw)
         base = self._base_ring[self.version]
+        if self.defender.is_defense_enabled():
+            # DEFENDED pour: robust kernels compare update vectors, but
+            # each buffered delta was formed against the base its silo
+            # trained from — re-base every row onto the CURRENT version
+            # by subtracting the server movement it missed (the base ring
+            # the server already owns), fold the staleness decay into the
+            # defense's row weights, and let the defense aggregate. At
+            # staleness 0 the correction is zero and the pour is exactly
+            # the sync defended round's math. The poured K varies, which
+            # is fine host-side (the kernels retrace per shape).
+            rows = [np.asarray(e.update, np.float32)
+                    - (base - self.base_for(e.version)) for e in entries]
+            # norm_w IS the staleness-folded relative mix (pour_weights,
+            # the one staleness implementation); the kernels normalize
+            # internally, so passing it is exactly the decayed weighting
+            ranks = np.asarray([e.client_id for e in entries], np.int32)
+            vec, info = self.defender.defend_matrix(
+                jnp.asarray(np.stack(rows)),
+                jnp.asarray(norm_w, jnp.float32),
+                rng=jax.random.fold_in(self._defense_key, self.version),
+                client_ids=ranks)
+            agg = np.asarray(jax.device_get(vec), np.float32)
+            verdict = verdict_from_info(info, len(entries))
+            if verdict is not None:
+                # defense verdicts are the silo reputation stream —
+                # select_silos benches silos the defenses keep excluding.
+                # Bounds-guarded like every other silo_stats write: an
+                # out-of-range rank must not kill the pour thread.
+                keep = [i for i, r in enumerate(ranks)
+                        if 0 <= int(r) < self.silo_stats.n]
+                if keep:
+                    self.silo_stats.record_verdict(
+                        [int(ranks[i]) for i in keep],
+                        np.asarray(verdict)[keep])
+        else:
+            agg = np.zeros(entries[0].update.shape, np.float32)
+            for nw, e in zip(norm_w, entries):
+                agg = agg + np.asarray(e.update, np.float32) * np.float32(nw)
         new_vec = base + np.float32(merge_scale) * agg
         self.global_params = jax.tree_util.tree_map(
             np.asarray,
@@ -246,12 +310,13 @@ class AsyncFedMLServerManager(FedMLServerManager):
         wire = tree_to_wire(self.aggregator.global_params)
         self._round_targets = sorted(self.client_online_status)
         now = time.time()
-        for i, rank in enumerate(self._round_targets):
+        assign = self.aggregator.assign_data_indices(self._round_targets,
+                                                     client_indexes)
+        for rank in self._round_targets:
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank,
                           rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
                            self.aggregator.version)
             self._sync_t[rank] = now
@@ -395,7 +460,18 @@ class AsyncFedMLServerManager(FedMLServerManager):
         if version >= self.round_num:
             self.finish_session()
             return
-        self._sync_ranks(contributors)
+        # non-uniform strategies bench flaky/byzantine silos here: a
+        # benched contributor gets no fresh sync (it idles instead of
+        # poisoning the next pour), but the empty-fire nudge still
+        # reaches every online silo — the probation/redemption path.
+        # uniform (default): select_silos returns everyone, unchanged.
+        survivors = self.aggregator.select_silos(contributors)
+        if len(survivors) < len(contributors):
+            logger.info(
+                "async server: benching silos %s after pour %d "
+                "(reputation/dropout posterior)",
+                sorted(set(contributors) - set(survivors)), version - 1)
+        self._sync_ranks(survivors)
         self._arm_pour_timer()
 
     def _sync_ranks(self, ranks: List[int]) -> None:
@@ -409,12 +485,12 @@ class AsyncFedMLServerManager(FedMLServerManager):
             version, int(self.args.client_num_in_total), self.client_num)
         wire = tree_to_wire(self.aggregator.global_params)
         now = time.time()
-        for i, rank in enumerate(ranks):
+        assign = self.aggregator.assign_data_indices(ranks, client_indexes)
+        for rank in ranks:
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                           self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
             if rank not in self._outstanding:
                 # first sync of this outstanding period wins the clock: a

@@ -138,19 +138,23 @@ class FedMLAggregator:
         ranks = sorted(int(r) for r in online_ranks)
         if self.selection_strategy == "uniform" or len(ranks) <= 1:
             return ranks
-        from ...core.selection.strategies import cap_bench
-        # benching is driven by the dropout POSTERIOR alone: silos have
-        # no defense-verdict stream feeding silo_stats, so a reputation
-        # condition here would be dead code implying a signal that does
-        # not exist
+        from ...core.selection.strategies import cap_bench, rep_bench_knobs
+        # two independent bench signals: the dropout POSTERIOR (silos
+        # that will only burn the round timeout) and — since ISSUE 7's
+        # defended async pours feed defense verdicts into silo_stats —
+        # the REPUTATION posterior (silos the defenses keep excluding).
+        # Reputation only bites where verdict evidence exists; undefended
+        # sessions see rep == 1 everywhere and behave exactly as before.
         post = self.silo_stats.dropout_posterior_mean()
+        rep = self.silo_stats.reputation
+        rep_thresh, keep_frac = rep_bench_knobs(self.args)
         flaky = [r for r in ranks
-                 if r < self.silo_stats.n and post[r] > 0.5]
+                 if r < self.silo_stats.n
+                 and (post[r] > 0.5 or rep[r] < rep_thresh)]
         benched = set(cap_bench(
-            len(ranks), flaky, badness=lambda r: post[r],
-            keep_frac=float(getattr(self.args, "selection_min_keep_frac",
-                                    0.5) or 0.5),
-            quorum=self.quorum))
+            len(ranks), flaky,
+            badness=lambda r: float(post[r]) + float(1.0 - rep[r]),
+            keep_frac=keep_frac, quorum=self.quorum))
         return [r for r in ranks if r not in benched]
 
     def add_local_trained_result(self, index: int, model_params,
@@ -273,3 +277,44 @@ class FedMLAggregator:
             round_idx, data_silo_num, client_num_in_total,
             random_seed=int(getattr(self.args, "random_seed", 0) or 0),
             stream=sampling_stream_from_args(self.args))]
+
+    def assign_data_indices(self, ranks, client_indexes) -> Dict[int, int]:
+        """rank -> DATA index for this round's broadcast.
+
+        ``silo_index_assignment: legacy`` (default) is the reference's
+        round-robin — the i-th rank in iteration order gets
+        ``client_indexes[i % len]``, bit-identical to before. ``scored``
+        closes the PR 5 leftover: ranks are scored by the stats store
+        (availability posterior over observed latency — the silo most
+        likely to actually deliver, fastest), and the FIRST-sampled data
+        indices go to the best-scoring silos: the partitions the sampler
+        put at the head of the round's list are the ones most likely to
+        make it into the aggregate, and soonest. Ties (and unobserved
+        silos, which score neutral) keep rank order, so a cold store
+        degrades to legacy exactly."""
+        mode = str(getattr(self.args, "silo_index_assignment", "legacy")
+                   or "legacy").lower()
+        ranks = [int(r) for r in ranks]
+        idx = list(client_indexes)
+        if mode == "legacy" or len(ranks) <= 1:
+            return {r: int(idx[i % len(idx)]) for i, r in enumerate(ranks)}
+        if mode != "scored":
+            raise ValueError(
+                f"silo_index_assignment {mode!r} unknown; choose from "
+                "('legacy', 'scored')")
+        st = self.silo_stats
+        post = st.dropout_posterior_mean()
+        lat = np.where(st.has_latency > 0, st.ema_latency, np.nan)
+        obs = lat[np.isfinite(lat)]
+        fill = float(np.median(obs)) if obs.size else 1.0
+        score = []
+        for r in ranks:
+            if 0 <= r < st.n:
+                avail = 1.0 - float(post[r])
+                speed = fill if not np.isfinite(lat[r]) else float(lat[r])
+            else:
+                avail, speed = 1.0 - float(np.mean(post)), fill
+            score.append(avail / max(speed, 1e-9))
+        order = np.argsort(-np.asarray(score), kind="stable")
+        return {ranks[int(r)]: int(idx[i % len(idx)])
+                for i, r in enumerate(order)}

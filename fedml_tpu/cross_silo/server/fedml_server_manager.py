@@ -145,11 +145,12 @@ class FedMLServerManager(FedMLCommManager):
         self._round_targets = sorted(self.client_online_status)
         self._round_selected = list(self._round_targets)
         self._bcast_t0 = time.time()
-        for i, rank in enumerate(self._round_targets):
+        assign = self.aggregator.assign_data_indices(self._round_targets,
+                                                     client_indexes)
+        for rank in self._round_targets:
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             self.send_message(msg)
         if self.chaos.enabled:
@@ -438,13 +439,16 @@ class FedMLServerManager(FedMLCommManager):
         self._round_selected = selected
         payload = self._sync_payload()
         self._bcast_t0 = time.time()
-        for i, rank in enumerate(online):
+        # DATA-index assignment: legacy round-robin by default; the
+        # `scored` knob routes the first-sampled indices to the silos the
+        # stats store scores most deliverable (see assign_data_indices)
+        assign = self.aggregator.assign_data_indices(online, client_indexes)
+        for rank in online:
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                           self.rank, rank)
             for key, value in payload:
                 msg.add_params(key, value)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
-                           int(client_indexes[i % len(client_indexes)]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
             self.send_message(msg)
         if self.chaos.enabled:

@@ -37,6 +37,26 @@ How the async world maps onto a synchronous mesh:
 Buffered rows are replicated ``[K, D]`` f32 vectors (update ‖ extras), so
 SCAFFOLD's control variates ride the buffer next to the model delta; for
 LLM-scale models a feature-sharded buffer is the known follow-up.
+
+**Defended pours** (ISSUE 7): attacks/defenses compose with the buffer.
+A robust defense compares update vectors, but buffered updates were
+trained from DIFFERENT model versions — their deltas are not comparable
+until every row is re-based onto the current version. The engine keeps a
+fixed-size per-version base-delta ring on device (slot ``v mod R`` holds
+the server movement ``params_{v+1} − params_v``; the async cross-silo
+server's base ring is the host-side template): at pour time each row is
+corrected by the accumulated movement it missed (``Δ − (params_v −
+params_{v−s})``, a DATA-driven masked sum over the ring — never a
+recompile), the chaos model-attack injects on the re-based shards as the
+in-program adversary, and the row flows through the same feature-sharded
+defense kernels as the sync fused path with the staleness decay folded
+into the defense's row weights and a ``[K]`` validity mask covering
+partial pours. At staleness 0 the correction is exactly zero, so a
+defended pour is bit-identical to the sync defended round — the parity
+anchor the tests pin. Stateful defenses keep their device-resident state
+pytree, which joins the async checkpoint so crash-resume replays
+identical verdicts; verdicts feed the PR 5 reputation store, and the
+arrival rotation stops re-dispatching benched byzantine clients.
 """
 
 from __future__ import annotations
@@ -63,9 +83,10 @@ from ...core.algframe.types import TrainHyper
 from ...core.chaos import ChaosCrash
 from ...core.collectives import psum_tree, vector_to_tree_like
 from ...core.jax_compat import shard_map
+from ...core.security.defense import sharded as sharded_defense
 from ...core.selection import slot_placement
 from ..sampling import build_schedule
-from .engine import TPUSimulator
+from .engine import ATTACK_FOLD, DEFENSE_FOLD, TPUSimulator
 
 logger = logging.getLogger(__name__)
 
@@ -86,23 +107,52 @@ class AsyncBufferedSimulator(TPUSimulator):
         super().__init__(args, fed_dataset, bundle, optimizer, spec,
                          mesh=mesh, server_aggregator=server_aggregator)
         # --- config guards: fail loudly, never silently degrade ----------
-        if self.robust_mode:
+        if self.contribution.enabled or self.server_aggregator is not None:
             raise ValueError(
-                "round_mode: async_buffered does not yet compose with "
-                "attacks/defenses/contribution/user ServerAggregators — "
-                "robust aggregation assumes a same-version cohort; use "
-                "round_mode: sync for defended runs")
+                "round_mode: async_buffered composes with attacks/defenses "
+                "(defended pours re-base the buffer onto the current "
+                "version), but not yet with contribution assessment or "
+                "user ServerAggregators — both consume a same-version "
+                "host-ordered update matrix; use round_mode: sync")
         if self.dp.is_dp_enabled():
             raise ValueError(
                 "round_mode: async_buffered does not yet compose with DP "
                 "(per-pour accounting under stale mixed cohorts is an open "
                 "design); use round_mode: sync with DP")
-        if self.selection.strategy_name != "uniform" or self.selection.adaptive:
-            raise ValueError(
-                "round_mode: async_buffered dispatches by arrival rotation "
-                "(no per-round cohort to strategize over yet); use "
-                "client_selection: uniform — arrival-rate posteriors still "
-                "feed the cross-silo silo selection")
+        # defended pours: attack/defense ride the compile-once pour
+        # program (re-base -> in-program attack -> sharded defense)
+        self._defended = (self.defender.is_defense_enabled()
+                          or self.attacker.is_model_attack())
+        if self.defender.is_defense_enabled():
+            if self.defender.defense_type in ("weak_dp", "crfl"):
+                raise ValueError(
+                    "round_mode: async_buffered refuses defense_type "
+                    f"{self.defender.defense_type!r}: noise-adding "
+                    "defenses are DP by another name, and per-pour noise "
+                    "accounting over a mixed-staleness buffer is the same "
+                    "open design that keeps async+DP refused; use "
+                    "round_mode: sync")
+            if not self._use_sharded_defense():
+                raise ValueError(
+                    "round_mode: async_buffered runs the defense INSIDE "
+                    "the compile-once pour program and needs the sharded "
+                    "defense path; sharded_defense: false configs must "
+                    "use round_mode: sync")
+            pref = str(getattr(args, "robust_fused", "auto")
+                       or "auto").lower()
+            if pref in ("false", "0", "no", "host"):
+                raise ValueError(
+                    "robust_fused: host has no meaning under round_mode: "
+                    "async_buffered — the defended pour is one fused "
+                    "program by construction; use robust_fused: auto")
+        if self.selection.adaptive:
+            # no per-round cohort to over-sample: the in-flight
+            # concurrency is fixed and dropped arrivals are redeemed by
+            # the rotation — pin rather than refuse, loudly
+            self.selection.pin_adaptive(
+                "async_buffered has no per-round cohort to over-sample "
+                "(fixed in-flight concurrency; drops redeem via the "
+                "rotation)")
         self.concurrency = min(int(args.client_num_per_round),
                                int(fed_dataset.num_clients))
         self.k = buffer_k_from_args(args, self.concurrency)
@@ -124,6 +174,24 @@ class AsyncBufferedSimulator(TPUSimulator):
         self._extras_d = int(sum(int(np.prod(l.shape)) for l in
                                  jax.tree_util.tree_leaves(extras_zero)))
         self._row_d = self._true_d + self._extras_d
+
+        if self._defended:
+            # _check_extras_compat (base __init__) already refuses
+            # extras-carrying optimizers in robust mode, so a defended
+            # buffer row is exactly the [true_d] model delta
+            # per-version base-delta ring: slot (v mod R) holds the
+            # server movement params_{v+1} - params_v as a replicated
+            # device row; R covers the staleness cap (the adaptive cap
+            # can grow to its 64 ceiling, so adaptive runs size for it).
+            # Staleness beyond the ring re-bases over the retained
+            # movement only — the weight is saturated anyway (logged
+            # once, mirroring the cross-silo base ring's fallback).
+            self._ring_r = int(np.clip(
+                64 if self._cap_adaptive else self.staleness_cap, 1, 64))
+            self._ring = jax.device_put(
+                jnp.zeros((self._ring_r, self._true_d), jnp.float32),
+                self.repl_sharding)
+            self._ring_fallback_logged = False
 
         # virtual clock + event heap: (t, seq, kind, cid, version, weight,
         # duration, vec) — vec is the client's device-resident [row_d]
@@ -162,7 +230,11 @@ class AsyncBufferedSimulator(TPUSimulator):
         """The ONE async program: pour the buffer through the staleness-
         corrected server transform while training the freshly-dispatched
         cohort on the pre-pour params (independent subgraphs — XLA
-        overlaps them; two donated model slots)."""
+        overlaps them; two donated model slots). In defended mode the
+        pour half additionally re-bases every buffered row onto the
+        current version (base-delta ring, DATA masks), injects the
+        on-device model attack, and runs the feature-sharded defense —
+        still one program, still compiled exactly once."""
         emit_extras = self._extras_d > 0
         collect = self._make_collect_core(emit_extras_stack=emit_extras)
         opt = self.opt
@@ -170,19 +242,19 @@ class AsyncBufferedSimulator(TPUSimulator):
         extras_zero = opt.server_extras_zero(self.params)
         n_total = float(max(self.fed.num_clients, 1))
 
-        def pour_body(params, server_state, local_data, local_states,
-                      sched_idx, sched_active, sched_work,
-                      buf_mat, buf_nw, merge_scale, pour_n,
-                      round_key, hyper):
+        def train_rows(params, server_state, local_data, local_states,
+                       sched_idx, sched_active, sched_work, round_key,
+                       hyper):
+            """The training half shared by both pour flavors: slot-scan
+            the dispatched cohort, then gather the [S, ...] local stacks
+            into the replicated [n_dev*S, row_d] dispatch matrix (row =
+            d*S+s, the _robust_rows convention)."""
             sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             res = collect(params, server_state, sq(local_data),
                           sq(local_states), sched_idx[0], sched_active[0],
                           sched_work[0], round_key, hyper)
             (upd_stack, w_stack, states, acc_ex, acc_w, acc_m,
              slot_mets) = res[:7]
-            # [S, ...] local stacks -> [S, row_d] local rows -> gather to
-            # the replicated [n_dev*S, row_d] dispatch matrix (row = d*S+s,
-            # the _robust_rows convention)
             leaves = jax.tree_util.tree_leaves(upd_stack)
             n_slots = leaves[0].shape[0]
             parts = [jnp.reshape(l, (n_slots, -1)).astype(jnp.float32)
@@ -193,6 +265,22 @@ class AsyncBufferedSimulator(TPUSimulator):
             rows_mat = jax.lax.all_gather(
                 jnp.concatenate(parts, axis=1), AXIS_CLIENT, axis=0,
                 tiled=True)
+            metrics = psum_tree(acc_m)
+            states = jax.tree_util.tree_map(lambda a: a[None], states)
+            slot_mets = jax.tree_util.tree_map(lambda a: a[None], slot_mets)
+            return rows_mat, states, metrics, slot_mets
+
+        if self._defended:
+            return self._build_defended_pour_fn(train_rows, opt, true_d,
+                                                n_total)
+
+        def pour_body(params, server_state, local_data, local_states,
+                      sched_idx, sched_active, sched_work,
+                      buf_mat, buf_nw, merge_scale, pour_n,
+                      round_key, hyper):
+            rows_mat, states, metrics, slot_mets = train_rows(
+                params, server_state, local_data, local_states,
+                sched_idx, sched_active, sched_work, round_key, hyper)
             # the pour: buf_nw is the padded [K] relative mix and
             # merge_scale the absolute damping, BOTH computed host-side by
             # core/async_rounds.pour_weights (the one staleness
@@ -217,9 +305,6 @@ class AsyncBufferedSimulator(TPUSimulator):
             new_sstate = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(poured, n, o), upd_sstate,
                 server_state)
-            metrics = psum_tree(acc_m)
-            states = jax.tree_util.tree_map(lambda a: a[None], states)
-            slot_mets = jax.tree_util.tree_map(lambda a: a[None], slot_mets)
             return (new_params, new_sstate, states, rows_mat, metrics,
                     slot_mets)
 
@@ -234,6 +319,113 @@ class AsyncBufferedSimulator(TPUSimulator):
         )
         return jax.jit(shard_fn, donate_argnums=self._donate_args(0, 1, 3))
 
+    def _build_defended_pour_fn(self, train_rows, opt, true_d,
+                                n_total: float):
+        """The defended pour flavor: re-base the buffer onto the current
+        version via the base-delta ring (DATA masks — staleness never
+        recompiles), inject the on-device model attack on the re-based
+        feature shards, run the sharded defense with the staleness decay
+        already folded into ``buf_nw`` and a [K] validity mask for
+        partial pours, then apply the defended aggregate through the
+        staleness-corrected server transform. Also maintains the ring
+        (this pour's server movement lands in slot ``version mod R``) and
+        emits the defense's [K] verdict for the reputation store."""
+        defense_type = (self.defender.defense_type
+                        if self.defender.is_defense_enabled() else "mean")
+        hp = sharded_defense.DefenseHP.from_defender(self.defender)
+        attack_type = (self.attacker.attack_type
+                       if self.attacker.is_model_attack() else None)
+        attack_scale = float(getattr(self.attacker, "attack_scale", 1.0))
+        n_dev = self.n_devices
+        d_pad = self._d_pad
+        k_buf = self.k
+        state_specs = self._defense_state_specs
+
+        def flat32(tree):
+            return jnp.concatenate(
+                [jnp.reshape(l, (-1,)).astype(jnp.float32)
+                 for l in jax.tree_util.tree_leaves(tree)])
+
+        def pour_body(params, server_state, local_data, local_states,
+                      sched_idx, sched_active, sched_work,
+                      buf_mat, buf_nw, merge_scale, pour_n,
+                      drift_mask, row_mask, pour_ids, byz_mask, ring,
+                      dstate, ring_slot, round_key, hyper):
+            rows_mat, states, metrics, slot_mets = train_rows(
+                params, server_state, local_data, local_states,
+                sched_idx, sched_active, sched_work, round_key, hyper)
+            # RE-BASE: a row trained from version v-s proposed the target
+            # model params_{v-s} + delta; comparable at version v it is
+            # delta - (params_v - params_{v-s}) — the accumulated server
+            # movement the client missed, summed from the ring by the
+            # per-row DATA mask. At staleness 0 the mask is all-zero and
+            # the row passes through untouched (the sync-parity anchor).
+            drift = jnp.einsum("kr,rd->kd", drift_mask, ring)
+            rebased = buf_mat - drift
+            pad = d_pad - true_d
+            mat_full = (jnp.pad(rebased, ((0, 0), (0, pad))) if pad
+                        else rebased)
+            shard_w = d_pad // n_dev
+            dev = jax.lax.axis_index(AXIS_CLIENT)
+            # replicated [K, D] -> this device's [K, D/n] feature shard:
+            # same column blocks as the P(None, axis) layout the sync
+            # sharded path lands via its all_to_all
+            mat_s = jax.lax.dynamic_slice(
+                mat_full, (jnp.int32(0), dev * shard_w), (k_buf, shard_w))
+            if attack_type is not None:
+                mat_s = sharded_defense._apply_attack_shard(
+                    attack_type, mat_s, byz_mask,
+                    jax.random.fold_in(round_key, ATTACK_FOLD),
+                    attack_scale, AXIS_CLIENT)
+            vec_s, new_dstate, verdict = \
+                sharded_defense.defend_shard_stateful(
+                    mat_s, buf_nw, AXIS_CLIENT, defense_type, hp,
+                    state=dstate, ids=pour_ids,
+                    key=jax.random.fold_in(round_key, DEFENSE_FOLD),
+                    true_d=true_d, row_mask=row_mask)
+            vec = jax.lax.all_gather(vec_s, AXIS_CLIENT,
+                                     tiled=True)[:true_d]
+            agg_update = vector_to_tree_like(vec, params)
+            upd_params, upd_sstate = opt.server_update_async(
+                params, server_state, agg_update, {}, hyper.round_idx,
+                merge_scale, pour_n / n_total)
+            # no-op pour (bootstrap, drained-heap retry): pin params,
+            # server state AND defense state — the kernels just ran on
+            # all-padding and must not advance cross-round history
+            poured = pour_n > 0
+            new_params = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(poured, n, o), upd_params, params)
+            new_sstate = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(poured, n, o), upd_sstate,
+                server_state)
+            new_dstate = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(poured, n, o), new_dstate, dstate)
+            # ring maintenance: this pour's server movement becomes the
+            # base delta of the version it just created; a no-op pour
+            # leaves the slot holding whatever version it still caches
+            delta = flat32(new_params) - flat32(params)
+            new_ring = ring.at[ring_slot].set(
+                jnp.where(poured, delta, ring[ring_slot]))
+            return (new_params, new_sstate, states, rows_mat, metrics,
+                    slot_mets, new_dstate, verdict, new_ring)
+
+        shard_fn = shard_map(
+            pour_body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(AXIS_CLIENT), P(AXIS_CLIENT), P(AXIS_CLIENT),
+                      P(), P(), P(), P(),
+                      P(), P(), P(), P(), P(),
+                      state_specs, P(), P(), P()),
+            out_specs=(P(), P(), P(AXIS_CLIENT), P(), P(), P(AXIS_CLIENT),
+                       state_specs, P(), P()),
+            check_vma=False,
+        )
+        # donate params / server_state / client_states / ring / defense
+        # state: each is replaced 1:1 by an output of identical shape+spec
+        return jax.jit(shard_fn,
+                       donate_argnums=self._donate_args(0, 1, 3, 15, 16))
+
     # ------------------------------------------------------------------
     def _staleness_fn(self):
         if self._cap_adaptive:
@@ -246,23 +438,120 @@ class AsyncBufferedSimulator(TPUSimulator):
     def _inflight(self) -> int:
         return len(self._events)
 
+    def _rank_idle(self) -> None:
+        """Async-aware dispatch (non-uniform ``client_selection``): there
+        is no per-round cohort to strategize over, so the strategy instead
+        decides WHO the freed capacity goes to next by reordering the idle
+        rotation before the draw.
+
+        * ``oort`` / ``power_of_choice``: rank by statistical utility ×
+          arrival-rate posterior — a high-loss client that also delivers
+          updates quickly buys the most model movement per unit of
+          simulated time. Clients with no observed arrivals score the
+          observed-mean rate (neutral), so exploration still happens.
+        ``reputation`` benches by EXCLUSION instead (see
+        :meth:`_benched_now`): with the buffer in steady state every
+        freed client is re-dispatched immediately, so reordering alone
+        could never keep a byzantine client out of the rotation.
+
+        ``uniform`` (the default) never calls this — the rotation is
+        bit-identical to the pre-defense engine."""
+        idle = list(self._idle)
+        if len(idle) <= 1:
+            return
+        self.selection.flush()
+        st = self.selection.store
+        name = self.selection.strategy_name
+        if name == "power_of_choice":
+            util = st.last_loss()  # +inf for unobserved: explore first
+        else:  # oort
+            util = self.selection.strategy._utility(self.version)
+        rate = st.arrival_rate()
+        seen = st.arr_obs > 0
+        fill = (float(np.mean(rate[seen])) if bool(np.any(seen)) else 1.0)
+        rate = np.where(seen, rate, max(fill, 1e-9))
+        score = np.asarray([float(util[c]) * float(rate[c])
+                            if np.isfinite(util[c]) else np.inf
+                            for c in idle])
+        order = np.argsort(-score, kind="stable")
+        self._idle = deque(idle[i] for i in order)
+
+    def _benched_now(self) -> set:
+        """The ``reputation`` strategy's benched set: clients whose
+        defense-verdict reputation fell below the threshold are excluded
+        from dispatch entirely — they sit idle (burning no compute,
+        poisoning no pour) until the relative posterior heals. The shared
+        ``cap_bench`` floor guarantees at least ``max(K, min_keep_frac ×
+        population)`` clients stay dispatchable, so a poisoned score
+        stream can neither empty the rotation nor starve the pour
+        trigger below its K."""
+        if self.selection.strategy_name != "reputation":
+            return set()
+        from ...core.selection.strategies import cap_bench, rep_bench_knobs
+        self.selection.flush()
+        rep = self.selection.store.reputation
+        thresh, keep_frac = rep_bench_knobs(self.args)
+        flagged = [c for c in range(self.fed.num_clients)
+                   if rep[c] < thresh]
+        return set(cap_bench(
+            self.fed.num_clients, flagged, badness=lambda c: -rep[c],
+            keep_frac=keep_frac, quorum=self.k))
+
     def _draw_cohort(self, target: int) -> List[int]:
         """Pop up to ``target`` idle clients, deferring any whose device
         already filled its canonical slot width this dispatch (the [D, S]
-        schedule shape must never grow, or the program recompiles)."""
+        schedule shape must never grow, or the program recompiles).
+        Reputation-benched clients are skipped (they stay idle);
+        non-uniform strategies rank the pool first."""
+        benched = self._benched_now()
+        if self.selection.strategy_name not in ("uniform", "reputation"):
+            self._rank_idle()
         counts = [0] * self.n_devices
         cohort: List[int] = []
         deferred: List[int] = []
         while self._idle and len(cohort) < target:
             cid = self._idle.popleft()
             d = cid // self.cpd
-            if counts[d] >= self._async_width:
+            if cid in benched or counts[d] >= self._async_width:
                 deferred.append(cid)
                 continue
             counts[d] += 1
             cohort.append(cid)
         self._idle.extendleft(reversed(deferred))
         return cohort
+
+    def _defended_pour_data(self, entries):
+        """Host-side DATA for one defended pour: per-update drift masks
+        over the base-delta ring, the [K] partial-pour validity mask,
+        pour client ids (padded with ids DISJOINT from the poured clients
+        so the stateful defenses' masked scatters are exact no-ops), and
+        the byzantine mask driving the in-program model attack."""
+        k, r, v = self.k, self._ring_r, self.version
+        dmask = np.zeros((k, r), np.float32)
+        row_mask = np.zeros((k,), np.float32)
+        for i, e in enumerate(entries):
+            row_mask[i] = 1.0
+            u = int(e.version)
+            if u < v - r and not self._ring_fallback_logged:
+                self._ring_fallback_logged = True
+                logger.warning(
+                    "defended pour: staleness %d exceeds the base-delta "
+                    "ring (%d slots) — re-basing over the retained server "
+                    "movement only; the update's staleness weight is "
+                    "saturated anyway", v - u, r)
+            for j in range(max(u, v - r), v):
+                dmask[i, j % r] = 1.0
+        poured = {int(e.client_id) for e in entries}
+        ids = [int(e.client_id) for e in entries]
+        ids += [c for c in range(self.fed.num_clients)
+                if c not in poured][:k - len(ids)]
+        ids = np.asarray(ids, np.int32)
+        if self.attacker.is_model_attack():
+            byz = np.asarray(self.attacker.byzantine_mask(ids),
+                             np.float32) * row_mask
+        else:
+            byz = np.zeros(k, np.float32)
+        return dmask, row_mask, ids, byz
 
     def _dispatch_plan(self, cohort: List[int]):
         """Chaos verdicts + schedule arrays for one dispatch. Returns
@@ -378,13 +667,40 @@ class AsyncBufferedSimulator(TPUSimulator):
         work = jax.device_put(jnp.asarray(work), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, self._dispatch_seq)
         hyper_r = hyper.replace(round_idx=jnp.int32(self.version))
-        (self.params, self.server_state, self.client_states, rows_mat,
-         metrics, slot_mets) = self._traced(
-            "async_pour", 1, self._pour_fn,
-            self.params, self.server_state, self.train_data,
-            self.client_states, idx, active, work, buf_mat,
-            jnp.asarray(buf_nw), jnp.float32(merge_scale),
-            jnp.float32(len(entries)), round_key, hyper_r)
+        if self._defended:
+            dmask, row_mask, pour_ids, byz = self._defended_pour_data(
+                entries)
+            dstate = (self._defense_state
+                      if self._defense_state is not None else {})
+            (self.params, self.server_state, self.client_states, rows_mat,
+             metrics, slot_mets, new_dstate, verdict,
+             self._ring) = self._traced(
+                "async_pour_defended", 1, self._pour_fn,
+                self.params, self.server_state, self.train_data,
+                self.client_states, idx, active, work, buf_mat,
+                jnp.asarray(buf_nw), jnp.float32(merge_scale),
+                jnp.float32(len(entries)), jnp.asarray(dmask),
+                jnp.asarray(row_mask), jnp.asarray(pour_ids),
+                jnp.asarray(byz), self._ring, dstate,
+                jnp.int32(self.version % self._ring_r), round_key, hyper_r)
+            if self._defense_state is not None:
+                self._defense_state = new_dstate
+            if self.selection.track and entries:
+                # the defense's verdict is about the POURED clients (not
+                # the freshly-dispatched cohort): reputation evidence, so
+                # the arrival rotation stops re-dispatching benched
+                # byzantine clients
+                self.selection.note_results(
+                    self.version, [e.client_id for e in entries], [],
+                    verdict=verdict[:len(entries)])
+        else:
+            (self.params, self.server_state, self.client_states, rows_mat,
+             metrics, slot_mets) = self._traced(
+                "async_pour", 1, self._pour_fn,
+                self.params, self.server_state, self.train_data,
+                self.client_states, idx, active, work, buf_mat,
+                jnp.asarray(buf_nw), jnp.float32(merge_scale),
+                jnp.float32(len(entries)), round_key, hyper_r)
         self._push_events(plan, rows_mat)
         if self.selection.track:
             self.selection.note_results(
@@ -553,7 +869,7 @@ class AsyncBufferedSimulator(TPUSimulator):
         idle = np.full((n,), -1, np.int64)
         for i, cid in enumerate(self._idle):
             idle[i] = cid
-        return {
+        out = {
             "scalars": np.asarray(
                 [self.version, self.virtual_t, self._dispatch_seq,
                  self._evseq,
@@ -571,6 +887,13 @@ class AsyncBufferedSimulator(TPUSimulator):
             "lat_seen": self._lat_seen.copy(),
             "last_arrival_t": self._last_arrival_t.copy(),
         }
+        if self._defended:
+            # the base-delta ring must survive a crash, or a resumed run
+            # would re-base the restored buffer's stale rows against a
+            # zeroed movement history and diverge from the uninterrupted
+            # pour trajectory (fixed [R, D] shape — template-stable)
+            out["ring"] = np.asarray(jax.device_get(self._ring), np.float32)
+        return out
 
     def _async_load_state(self, st: Dict[str, np.ndarray]) -> None:
         sc = np.asarray(st["scalars"], np.float64)
@@ -601,3 +924,7 @@ class AsyncBufferedSimulator(TPUSimulator):
         self._lat_seen = np.asarray(st["lat_seen"], np.float64).copy()
         self._last_arrival_t = np.asarray(st["last_arrival_t"],
                                           np.float64).copy()
+        if self._defended and "ring" in st:
+            self._ring = jax.device_put(
+                jnp.asarray(np.asarray(st["ring"], np.float32)),
+                self.repl_sharding)

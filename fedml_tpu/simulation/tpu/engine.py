@@ -103,32 +103,9 @@ def _maybe_enable_compile_cache(args) -> None:
     logger.info("persistent XLA compilation cache at %s", path)
 
 
-def _verdict_from_info(info, k: int) -> Optional[np.ndarray]:
-    """Map a host defense kernel's info dict to the [K] per-client verdict
-    the selection subsystem consumes (selection masks / keep flags /
-    continuous weights). None when the defense exposes no per-client
-    notion — reputation then simply sees no evidence this round.
-
-    Semantic guard: ``selected``/``kept`` must be BINARY masks — host
-    bulyan's ``selected`` carries top-theta row INDICES, which would pass
-    a shape-only check (theta == k when byzantine_count == 0) and brand
-    arbitrary clients. Continuous keys must already live in [0, 1]."""
-    if not isinstance(info, dict):
-        return None
-    for key, binary in (("selected", True), ("kept", True),
-                        ("fg_weights", False), ("confidence", False)):
-        v = info.get(key)
-        if v is None:
-            continue
-        v = np.asarray(v, np.float32)
-        if v.shape != (k,):
-            continue
-        if binary and not np.all((v == 0.0) | (v == 1.0)):
-            continue  # an index list, not an inclusion mask
-        if not binary and (np.min(v) < 0.0 or np.max(v) > 1.0):
-            continue
-        return v
-    return None
+# moved to core/security/defense (the cross-silo async server consumes it
+# too); the old private name stays importable for existing callers/tests
+from ...core.security.defense import verdict_from_info as _verdict_from_info
 
 
 def _check_extras_compat(opt, params, dp, robust_mode: bool) -> None:

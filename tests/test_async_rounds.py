@@ -248,18 +248,32 @@ class TestAsyncEngine:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_refuses_unsupported_configs_loudly(self):
-        with pytest.raises(ValueError, match="async_buffered"):
-            build_async_sim(sim_args(round_mode="async_buffered",
-                                     enable_defense=True,
-                                     defense_type="krum",
-                                     byzantine_client_num=1))
+        # ISSUE 7 lifted the defense refusal (defended pours) — what
+        # stays refused: DP, noise-adding defenses (DP by another name),
+        # contribution assessment, and the host defense kernels
         with pytest.raises(ValueError, match="async_buffered"):
             build_async_sim(sim_args(round_mode="async_buffered",
                                      enable_dp=True, dp_epsilon=1.0,
                                      dp_delta=1e-5, dp_clip=1.0))
-        with pytest.raises(ValueError, match="uniform"):
+        with pytest.raises(ValueError, match="weak_dp"):
             build_async_sim(sim_args(round_mode="async_buffered",
-                                     client_selection="oort"))
+                                     enable_defense=True,
+                                     defense_type="weak_dp"))
+        with pytest.raises(ValueError, match="contribution"):
+            build_async_sim(sim_args(round_mode="async_buffered",
+                                     contribution_method="loo"))
+        with pytest.raises(ValueError, match="sharded"):
+            build_async_sim(sim_args(round_mode="async_buffered",
+                                     enable_defense=True,
+                                     defense_type="krum",
+                                     byzantine_client_num=1,
+                                     sharded_defense="false"))
+        with pytest.raises(ValueError, match="robust_fused"):
+            build_async_sim(sim_args(round_mode="async_buffered",
+                                     enable_defense=True,
+                                     defense_type="krum",
+                                     byzantine_client_num=1,
+                                     robust_fused="host"))
         # the base engine refuses to silently run sync under the knob
         from tests.test_robust_fused import build_sim
         with pytest.raises(ValueError, match="AsyncBufferedSimulator"):
@@ -461,10 +475,17 @@ class TestAsyncAggregator:
                                       agg._base_ring[min(agg._base_ring)])
         assert any("base ring" in r.message for r in caplog.records)
 
-    def test_refuses_defense_and_dp(self):
+    def test_refuses_dp_but_composes_with_defenses(self):
+        # ISSUE 7: defenses now compose (defended pours) — only DP (and
+        # the noise-adding weak_dp/crfl defenses) stay refused
+        agg = self._agg(enable_defense=True, defense_type="krum",
+                        byzantine_client_num=1)
+        assert agg.defender.is_defense_enabled()
         with pytest.raises(ValueError, match="async_buffered"):
-            self._agg(enable_defense=True, defense_type="krum",
-                      byzantine_client_num=1)
+            self._agg(enable_dp=True, dp_epsilon=1.0, dp_delta=1e-5,
+                      dp_clip=1.0)
+        with pytest.raises(ValueError, match="noise-adding"):
+            self._agg(enable_defense=True, defense_type="weak_dp")
 
     def test_pour_timeout_never_bottoms_out_at_zero(self):
         """With neither timeout knob set the liveness valve must still
