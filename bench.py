@@ -26,19 +26,17 @@ import json
 import time
 
 
-# bf16 peak TFLOP/s per chip, by device-kind substring (public specs)
-_PEAK_TFLOPS = (
-    ("v6", 918.0), ("v5p", 459.0), ("v5e", 197.0), ("v5", 197.0),
-    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0), ("cpu", 0.5),
-)
+# MFU comes from the observability layer's profiling plane
+# (core/obs/profiler): the peak table and the MFU formula live there —
+# single source of truth, so the bench's MFU columns and the engine's
+# fed_round_mfu gauge can never disagree. The FLOPs model is unchanged
+# (engine.round_cost_flops), so the BENCH trajectory stays comparable.
+from fedml_tpu.core.obs import metrics as _obs_metrics
+from fedml_tpu.core.obs import profiler as _obs_profiler
 
 
 def _peak_tflops(device):
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, peak in _PEAK_TFLOPS:
-        if key in kind:
-            return peak
-    return None  # unknown accelerator: report mfu as null, not a guess
+    return _obs_profiler.peak_tflops(device)
 
 
 def _force(tree):
@@ -148,13 +146,17 @@ def bench_flagship():
         tpu_sim._donate = True
         tpu_sim._fused_fn = tpu_sim._build_fused_fn()
 
-    # FLOPs of the real (non-padded) work per round, for MFU
+    # FLOPs of the real (non-padded) work per round, for MFU — computed
+    # by the profiling plane (same formula as the engine's per-round
+    # fed_round_mfu gauge) and recorded there so a bench run's metrics
+    # snapshot carries the flagship MFU too
     flops = tpu_sim.round_cost_flops(hyper)
     n_dev = tpu_sim.n_devices
     achieved_tflops = (flops / tpu_round_s) / 1e12 if flops else 0.0
-    peak_per_chip = _peak_tflops(jax.devices()[0])
-    mfu = (achieved_tflops / (peak_per_chip * n_dev)
-           if peak_per_chip else None)
+    mfu = _obs_profiler.mfu_value(flops, tpu_round_s, n_dev,
+                                  device=jax.devices()[0])
+    if mfu is not None:
+        _obs_metrics.record_round_mfu(mfu, tflops=achieved_tflops)
 
     # --- baseline: golden per-client loop (reference SP architecture),
     # scaled down (8 of 64 clients) then per-sample normalized

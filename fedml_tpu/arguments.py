@@ -184,6 +184,17 @@ _SCHEMA: Dict[str, Any] = {
     "comm_retry_deadline_s": 0.0,
     # tracking_args
     "enable_wandb": False,
+    "enable_tracking": True,     # master switch for the JSONL sink
+    "log_server_url": None,      # remote log shipper endpoint (log_daemon)
+    "sys_perf_profiling": False,  # host/device sampler thread (mlops)
+    # observability (core/obs): tracing + metrics are default-on-cheap
+    # (spans are dicts + one JSONL line; metric hooks are dict lookups);
+    # device profiling is OPT-IN because it blocks on dispatch results,
+    # defeating the engines' host/device overlap
+    "obs_tracing": True,          # spans + traceparent wire propagation
+    "obs_metrics": True,          # typed counter/gauge/histogram registry
+    "obs_metrics_flush_rounds": 10,  # metrics_snapshot JSONL cadence
+    "obs_profile_device": False,  # host/device split + per-round MFU
     "log_file_dir": "~/.cache/fedml_tpu/logs",
     "save_model_path": None,     # persist final params (serving artifact)
     "checkpoint_dir": None,

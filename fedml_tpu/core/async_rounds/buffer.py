@@ -38,6 +38,11 @@ class BufferedUpdate:
     version: int           # model version the client trained FROM
     arrival_t: float       # arrival timestamp (simulated or wall clock)
     seq: int = 0           # arrival tiebreaker: total order even at equal t
+    # trace context of the producing upload/dispatch span (core/obs):
+    # the pour span LINKS every poured entry's context, staleness per
+    # link. Observability only — not persisted (a crash-resumed pour
+    # replays identical math, just without links to pre-crash spans).
+    trace: Any = None
 
     def staleness(self, current_version: int) -> int:
         return max(int(current_version) - int(self.version), 0)
@@ -65,10 +70,11 @@ class UpdateBuffer:
 
     # --- producers ----------------------------------------------------------
     def add(self, client_id: int, update: Any, weight: float, version: int,
-            arrival_t: float) -> BufferedUpdate:
+            arrival_t: float, trace: Any = None) -> BufferedUpdate:
         with self._lock:
             e = BufferedUpdate(int(client_id), update, float(weight),
-                               int(version), float(arrival_t), self._seq)
+                               int(version), float(arrival_t), self._seq,
+                               trace)
             self._seq += 1
             self._added += 1
             self._entries.append(e)

@@ -51,6 +51,15 @@ class ChaosCommManager(BaseCommunicationManager):
         if decision.faulty:
             self.ledger.record_link(self.rank, receiver, msg.get_type(),
                                     decision)
+            # trace-plane mirror of the ledger entry: the fault lands as
+            # an event on whatever span is sending (broadcast, upload),
+            # so a dropped/delayed message is visible ON the round's
+            # trace instead of only in a separate ledger
+            from ..obs import trace as obs_trace
+            obs_trace.add_event(
+                "chaos.link_fault", link=f"{self.rank}->{receiver}",
+                msg_type=str(msg.get_type()), copies=int(decision.copies),
+                delay_s=float(decision.delay_s))
         if decision.copies <= 0:
             logger.warning("chaos: dropping message %r on link %d->%s",
                            msg.get_type(), self.rank, receiver)

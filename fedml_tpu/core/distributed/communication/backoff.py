@@ -45,6 +45,7 @@ def retry_with_backoff(
     seed: Optional[int] = None,
     describe: str = "operation",
     sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
 ):
     """Run ``fn`` with up to ``max_attempts`` retries after the first try
     (``max_attempts=0`` = fail fast, the pre-chaos behavior). Stops early
@@ -52,7 +53,12 @@ def retry_with_backoff(
     exceeded or would be by the next delay — the check counts time SPENT
     INSIDE ``fn`` too, so a slow failing call (connect timeout) cannot
     stretch the budget by arriving at the check late. Re-raises the last
-    failure."""
+    failure.
+
+    ``on_retry(attempt, delay_s, exc)`` fires before each retry sleep —
+    the observability seam: transports attach a span event per retry so
+    a trace shows WHERE a round's wall time went when the wire flapped.
+    Hook failures are swallowed (observability never breaks the send)."""
     delays = backoff_delays(base_s, factor, max_s, seed=seed)
     t0 = time.monotonic()
     attempt = 0
@@ -69,6 +75,11 @@ def retry_with_backoff(
             logger.debug("%s failed (%s: %s); retry %d/%d in %.2fs",
                          describe, type(e).__name__, e, attempt,
                          max_attempts, delay)
+            if on_retry is not None:
+                try:
+                    on_retry(attempt, delay, e)
+                except Exception:
+                    logger.debug("on_retry hook failed", exc_info=True)
             sleep(delay)
 
 

@@ -79,11 +79,23 @@ class GRPCCommManager(BaseCommunicationManager):
     def send_message(self, msg: Message) -> None:
         blob = msg.encode()
         stub = self._stub(msg.get_receiver_id())
+        from ....obs import trace as obs_trace
         from ..backoff import retry_with_backoff
-        retry_with_backoff(
-            lambda: stub(blob, timeout=60.0), retry_on=(grpc.RpcError,),
-            describe=f"grpc send {self.rank}->{msg.get_receiver_id()}",
-            **self.retry)
+        # one span per send with backoff retries as events (see the TCP
+        # manager — identical instrumentation, different transport label)
+        with obs_trace.span(
+                "comm.send",
+                attrs={"transport": "grpc",
+                       "receiver": int(msg.get_receiver_id()),
+                       "msg_type": str(msg.get_type()),
+                       "bytes": len(blob)}) as sp:
+            retry_with_backoff(
+                lambda: stub(blob, timeout=60.0), retry_on=(grpc.RpcError,),
+                describe=f"grpc send {self.rank}->{msg.get_receiver_id()}",
+                on_retry=lambda a, d, e: sp.add_event(
+                    "retry", attempt=a, delay_s=round(d, 4),
+                    error=type(e).__name__),
+                **self.retry)
 
     def handle_receive_message(self) -> None:
         self._running = True

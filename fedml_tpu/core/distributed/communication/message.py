@@ -16,6 +16,8 @@ from typing import Any, Dict
 import msgpack
 import numpy as np
 
+from ...obs import metrics as obs_metrics
+
 
 class WireStats:
     """Bytes-on-wire ledger at the encode seam: every ``Message.encode``
@@ -38,6 +40,10 @@ class WireStats:
             ent["messages"] += 1
             self._total_bytes += int(nbytes)
             self._total_msgs += 1
+        # the same seam feeds the typed metrics registry (per-message-type
+        # wire bytes counters — core/obs/metrics); outside the lock, the
+        # registry has its own
+        obs_metrics.record_wire(msg_type, nbytes)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -71,6 +77,9 @@ class Message:
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    # W3C trace-context header (core/obs/trace.inject/extract): an
+    # ordinary payload param, so EVERY transport propagates it for free
+    MSG_ARG_KEY_TRACEPARENT = "traceparent"
 
     def __init__(self, msg_type: Any = 0, sender_id: int = 0,
                  receiver_id: int = 0):

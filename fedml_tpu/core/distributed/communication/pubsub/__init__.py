@@ -263,11 +263,20 @@ class PubSubStorageCommManager(BaseCommunicationManager):
                         self.storage.put_object(blob))
         wire = Message()
         wire.msg_params = params
-        with self._lock:
-            _send_frame(self._sock, {
-                "kind": "pub",
-                "topic": self._topic("*", msg.get_receiver_id()),
-                "payload": wire.encode()})
+        from ....obs import trace as obs_trace
+        # same send-span instrumentation as TCP/gRPC; the traceparent
+        # param survives the control/data-plane split (it stays in the
+        # control frame, never offloaded)
+        with obs_trace.span(
+                "comm.send",
+                attrs={"transport": "pubsub",
+                       "receiver": int(msg.get_receiver_id()),
+                       "msg_type": str(msg.get_type())}):
+            with self._lock:
+                _send_frame(self._sock, {
+                    "kind": "pub",
+                    "topic": self._topic("*", msg.get_receiver_id()),
+                    "payload": wire.encode()})
 
     def handle_receive_message(self) -> None:
         # blocking reads; stop_receive_message closes the socket which

@@ -95,11 +95,24 @@ class TCPCommManager(BaseCommunicationManager):
                 s.sendall(struct.pack("!Q", len(blob)))
                 s.sendall(blob)
 
+        from ....obs import trace as obs_trace
         from ..backoff import retry_with_backoff
-        retry_with_backoff(
-            _send_once, retry_on=(OSError,),
-            describe=f"tcp send {self.rank}->{msg.get_receiver_id()}",
-            **self.retry)
+        # the wire half of the trace: one span per send, backoff retries
+        # attached as events — a flapping link shows up ON the round's
+        # critical path instead of vanishing into the send call
+        with obs_trace.span(
+                "comm.send",
+                attrs={"transport": "tcp",
+                       "receiver": int(msg.get_receiver_id()),
+                       "msg_type": str(msg.get_type()),
+                       "bytes": len(blob)}) as sp:
+            retry_with_backoff(
+                _send_once, retry_on=(OSError,),
+                describe=f"tcp send {self.rank}->{msg.get_receiver_id()}",
+                on_retry=lambda a, d, e: sp.add_event(
+                    "retry", attempt=a, delay_s=round(d, 4),
+                    error=type(e).__name__),
+                **self.retry)
 
     def handle_receive_message(self) -> None:
         self._running = True

@@ -23,6 +23,12 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+# the obs planes hang off the same sink: mlops stays the user-facing
+# façade and the JSONL funnel, core/obs owns tracing/metrics/profiling
+# (obs only imports mlops lazily at emission time — no cycle)
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
 logger = logging.getLogger(__name__)
 
 _state: Dict[str, Any] = {"run_id": "0", "sink": None, "enabled": False,
@@ -55,6 +61,11 @@ def init(args) -> None:
     an unwritable log dir degrades to disabled instead of failing init."""
     _state["run_id"] = str(getattr(args, "run_id", "0"))
     _state["enabled"] = bool(getattr(args, "enable_tracking", True))
+    # observability knobs (core/obs): tracing + metrics cadence + device
+    # profiling — configured here so every entry point that calls
+    # mlops.init wires the whole layer in one place
+    from .. import obs
+    obs.configure(args)
     if not _state["enabled"]:
         _state["sink"] = None
         return
@@ -108,8 +119,12 @@ def log_metric(metrics: Dict[str, Any], step: Optional[int] = None) -> None:
 
 
 def log_round_info(total_rounds: int, round_idx: int) -> None:
-    """(reference ``log_round_info`` :1004)"""
+    """(reference ``log_round_info`` :1004). Doubles as the metrics
+    registry's round-boundary clock: every engine/server already calls
+    it once per round, so the periodic ``metrics_snapshot`` JSONL flush
+    rides it with zero extra wiring."""
     _emit("round", {"round_idx": round_idx, "total_rounds": total_rounds})
+    obs_metrics.maybe_flush(int(round_idx))
 
 
 def log_comm_round(round_idx: int, wire_bytes: int,
@@ -148,6 +163,12 @@ def log_chaos(round_idx: Optional[int] = None,
         rec["link"] = link
     if arrivals is not None:
         rec["arrivals"] = arrivals
+        # pour-shaped records feed the staleness / buffer-occupancy
+        # histograms (both async seams funnel through record_pour here)
+        stal = [a.get("staleness", 0) for a in arrivals
+                if isinstance(a, dict)]
+        buffered = (observed or {}).get("buffered", 0)
+        obs_metrics.record_pour(stal, int(buffered), len(arrivals))
     _emit("chaos", rec)
 
 
@@ -172,6 +193,8 @@ def log_selection(round_idx: int, strategy: str,
     if dropout_posterior is not None:
         rec["dropout_posterior"] = float(dropout_posterior)
     rec.update(extra)
+    obs_metrics.record_selection(strategy, len(sampled or ()),
+                                 len(excluded or ()))
     _emit("selection", rec)
 
 
@@ -181,6 +204,7 @@ def log_dispatch(name: str, wall_s: float, rounds: int = 1,
     dispatch call, how many FL rounds it carried (fused blocks > 1), and
     how many XLA compiles it triggered (the recompile counter — a steady
     state of 0 is the invariant; anything else is shape instability)."""
+    obs_metrics.record_dispatch(name, wall_s, rounds, compiles)
     _emit("dispatch", {"dispatch": name, "wall_s": round(float(wall_s), 6),
                        "rounds": int(rounds), "compiles": int(compiles)})
 
@@ -237,49 +261,113 @@ def log_model_info(round_idx: int, model_path: str) -> None:
 # --- event spans (reference MLOpsProfilerEvent) ----------------------------
 
 class event:
-    """Span context manager / pair API:
+    """Span context manager / pair API — now a SHIM over the real tracer
+    (``core/obs/trace``):
 
         with mlops.event("train", round_idx=3): ...
     or  mlops.event("train", started=True); ...; mlops.event("train",
         started=False)
-    """
 
-    _open: Dict[str, float] = {}
+    The old implementation kept a class-level ``{name: start_time}`` dict,
+    so two concurrent same-name spans (cross-silo server handler threads,
+    the async pour timer racing an upload thread) clobbered each other's
+    start times and one duration came out garbage. Every event is now a
+    real tracer span with its own handle: the context-manager form holds
+    the span on the instance (no shared state at all), and the pair form
+    keeps per-``(thread, name)`` LIFO stacks under a lock — an end pops
+    the SAME thread's innermost open span of that name (cross-thread
+    closes fall back to any-thread LIFO, for the rare legacy caller that
+    splits a pair across threads). The legacy ``event_start``/
+    ``event_end`` records still flow for old readers; the span record
+    carries the trace-grade truth."""
+
+    _open_lock = threading.Lock()
+    # (thread_id, name) -> stack of open spans; None key = cross-thread
+    # fallback pool per name
+    _open: Dict[Any, list] = {}
 
     def __init__(self, name: str, started: Optional[bool] = None,
                  value: Any = None, **extra: Any):
         self.name = name
         self.extra = extra
+        self._span = None
         if started is True:
-            event._open[name] = time.time()
+            sp = obs_trace.tracer.start_span(name, attrs=dict(extra))
+            with event._open_lock:
+                event._open.setdefault(
+                    (threading.get_ident(), name), []).append(
+                    (sp, time.time()))
             _emit("event_start", {"event": name, "value": value, **extra})
         elif started is False:
-            t0 = event._open.pop(name, None)
-            dur = time.time() - t0 if t0 else None
+            handle = self._pop_open(name)
+            dur = None
+            if handle is not None:
+                sp, t0 = handle
+                sp.end()
+                # duration from the shim's own clock, so it survives
+                # obs_tracing: false (the span is a no-op then)
+                dur = time.time() - t0
             _emit("event_end", {"event": name, "value": value,
                                 "duration_s": dur, **extra})
 
+    @classmethod
+    def _pop_open(cls, name: str):
+        tid = threading.get_ident()
+        with cls._open_lock:
+            stack = cls._open.get((tid, name))
+            if not stack:
+                # legacy cross-thread pair: any thread's innermost span
+                for key in reversed(list(cls._open)):
+                    if key[1] == name and cls._open[key]:
+                        stack = cls._open[key]
+                        break
+            if not stack:
+                return None
+            sp = stack.pop()
+            if not stack:
+                cls._open = {k: v for k, v in cls._open.items() if v}
+            return sp
+
     def __enter__(self):
-        event._open[self.name] = time.time()
+        self._span = obs_trace.tracer.start_span(self.name,
+                                                 attrs=dict(self.extra))
+        self._span.__enter__()
+        self._t0 = time.time()
         _emit("event_start", {"event": self.name, **self.extra})
         return self
 
     def __exit__(self, *exc):
-        t0 = event._open.pop(self.name, None)
-        _emit("event_end", {"event": self.name,
-                            "duration_s": time.time() - t0 if t0 else None,
+        dur = time.time() - self._t0
+        self._span.__exit__(*exc)
+        _emit("event_end", {"event": self.name, "duration_s": dur,
                             **self.extra})
         return False
 
 
 # --- system perf daemon (reference mlops_device_perfs.py) ------------------
 
+_sys_perf_state = {"psutil_warned": False, "sample_warned": False}
+
+
 def _sys_sample() -> Dict[str, Any]:
-    import psutil
-    vm = psutil.virtual_memory()
-    rec = {"cpu_pct": psutil.cpu_percent(interval=None),
-           "mem_pct": vm.percent,
-           "mem_used_gb": round(vm.used / 2**30, 3)}
+    """One host+device sample. psutil is OPTIONAL: an environment without
+    it used to kill the sampler thread with an unlogged ImportError on the
+    very first sample — now the host-side fields degrade away ONCE,
+    loudly, and the jax-only device stats keep flowing."""
+    rec: Dict[str, Any] = {}
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        rec.update({"cpu_pct": psutil.cpu_percent(interval=None),
+                    "mem_pct": vm.percent,
+                    "mem_used_gb": round(vm.used / 2**30, 3)})
+    except Exception as e:
+        if not _sys_perf_state["psutil_warned"]:
+            _sys_perf_state["psutil_warned"] = True
+            logger.warning(
+                "sys_perf: psutil unavailable (%s: %s) — degrading to "
+                "jax-only device stats", type(e).__name__, e)
+        rec["degraded"] = True
     try:
         import jax
         stats = jax.local_devices()[0].memory_stats() or {}
@@ -298,7 +386,16 @@ def start_sys_perf(interval_s: float = 10.0) -> None:
         # identity check: a stop+start within one interval must not leave
         # the old thread alive emitting duplicates
         while _state.get("sys_thread") is threading.current_thread():
-            _emit("sys_perf", _sys_sample())
+            try:
+                _emit("sys_perf", _sys_sample())
+            except Exception:
+                # the sampler must never die silently: one WARNING with
+                # the traceback, then keep sampling (a transient device
+                # query failure is not a reason to go dark for the run)
+                if not _sys_perf_state["sample_warned"]:
+                    _sys_perf_state["sample_warned"] = True
+                    logger.warning("sys_perf sample failed; sampler "
+                                   "continues", exc_info=True)
             time.sleep(interval_s)
 
     t = threading.Thread(target=loop, daemon=True)

@@ -19,6 +19,7 @@ this by re-reading the index each cycle).
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -56,6 +57,8 @@ class LogShipper:
         # a multi-byte char split across reads survives intact)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._flush_lock = threading.Lock()
+        self._flushed = False
         self.shipped_lines = 0
         self.failed_batches = 0
 
@@ -144,25 +147,55 @@ class LogShipper:
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
+        # interpreter-exit flush: a short run can finish inside the first
+        # poll interval and previously lost its entire tail (daemon
+        # threads are killed, not joined, at exit) — the atexit hook
+        # ships whatever is still unsent. Unregistered on stop().
+        atexit.register(self._atexit_stop)
         return self
+
+    def _atexit_stop(self) -> None:
+        self.stop(flush=True, timeout_s=5.0)
 
     def _final_flush(self) -> None:
         """Ship everything, INCLUDING a trailing line with no newline — a
         crashed job's log usually ends mid-line and that last partial
-        traceback line is the most diagnostic one."""
-        self.pump_once()
-        tail = self._buf.decode("utf-8", errors="replace")
-        if tail.strip():
-            if self._post([tail]):
-                self._buf = b""
+        traceback line is the most diagnostic one. Runs at most once
+        (the loop thread's exit path, ``stop()``, and the atexit hook
+        can all race here)."""
+        with self._flush_lock:
+            if self._flushed:
+                return
+            self._flushed = True
+            self.pump_once()
+            tail = self._buf.decode("utf-8", errors="replace")
+            if tail.strip():
+                if self._post([tail]):
+                    self._buf = b""
 
     def stop(self, flush: bool = True, timeout_s: float = 10.0) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout_s)
-            self._thread = None
-        elif flush:
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout_s)
+            if t.is_alive():
+                # the loop thread is stuck mid-POST past our patience: it
+                # still owns _buf/_offset and will run its OWN final
+                # flush when the socket call returns — flushing from here
+                # too would race pump_once over unsynchronized tail state
+                logger.warning(
+                    "log shipper: loop thread still sending after %.1fs; "
+                    "it will flush on its own exit", timeout_s)
+                flush = False
+        if flush:
+            # guaranteed final flush even when the loop thread never ran
+            # a cycle (short run) or was never started — _final_flush
+            # itself dedups against the loop thread's exit-path flush
             self._final_flush()
+        try:
+            atexit.unregister(self._atexit_stop)
+        except Exception:
+            pass
 
 
 _shippers: List[LogShipper] = []

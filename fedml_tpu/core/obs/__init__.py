@@ -1,0 +1,43 @@
+"""Unified observability layer — three planes over one JSONL sink.
+
+1. **Tracing** (:mod:`.trace`): real spans with trace/span IDs and W3C
+   ``traceparent`` propagation on :class:`Message`, so one federated
+   round reconstructs as a single trace tree across processes; async
+   pours LINK the upload spans they consume, staleness per link.
+2. **Metrics** (:mod:`.metrics`): a typed counter/gauge/histogram
+   registry absorbing the scattered one-shot records — wire bytes by
+   message type, pour staleness and buffer occupancy, arrival rates,
+   selection decisions, compile count, dispatch wall time, checkpoint
+   flush time, HBM peak, per-round MFU — with Prometheus text exposition
+   and a periodic JSONL snapshot.
+3. **Profiling** (:mod:`.profiler`): per-dispatch host/device wall-time
+   attribution at the engine seam + the FLOPs model as a first-class
+   per-round MFU gauge (opt-in: blocking defeats dispatch overlap).
+
+``scripts/trace_report.py`` reads a run's JSONL and prints the per-round
+critical path. :mod:`.schema` is the one table every record kind
+validates against.
+
+Knobs (``arguments.py``): tracing + metrics default ON (cheap — spans
+are dicts, metric hooks are dict lookups); ``obs_profile_device``
+defaults OFF. ``configure(args)`` is called by ``mlops.init``; without
+it the defaults apply, so library use without init still traces.
+"""
+
+from __future__ import annotations
+
+from . import metrics, profiler, schema, trace                  # noqa: F401
+from .metrics import REGISTRY                                   # noqa: F401
+from .trace import (NOOP_SPAN, SpanContext, add_event, current_span,  # noqa: F401
+                    extract, inject, parse_traceparent, span, tracer)
+
+
+def configure(args=None) -> None:
+    """Wire the obs knobs from the flat config (idempotent; called by
+    ``mlops.init``). ``args=None`` restores the documented defaults."""
+    trace.set_enabled(bool(getattr(args, "obs_tracing", True)))
+    metrics.set_enabled(bool(getattr(args, "obs_metrics", True)))
+    metrics.set_flush_every(
+        int(getattr(args, "obs_metrics_flush_rounds", 10) or 0))
+    profiler.set_device_profiling(
+        bool(getattr(args, "obs_profile_device", False)))

@@ -1,0 +1,417 @@
+"""Typed metrics registry — counters, gauges, fixed-bucket histograms.
+
+Absorbs the scattered one-shot ``mlops.log_*`` numbers into ONE queryable
+surface: wire bytes by message type (fed at the ``Message.encode`` seam),
+pour staleness and buffer occupancy histograms, arrival-rate gauges,
+selection decisions, XLA compile count, dispatch wall time, checkpoint
+flush time, HBM peak, per-round MFU. Two readouts:
+
+* :func:`exposition` — Prometheus text format (the de-facto wire format
+  for pull-based scrapers; also what a human pastes into an issue);
+* periodic ``kind: metrics_snapshot`` JSONL records through the mlops
+  sink (:func:`maybe_flush` fires on round boundaries), so a run log is
+  self-contained for ``scripts/trace_report.py`` and post-mortems.
+
+Instruments are get-or-create by name (re-registration with a different
+type raises — a name means one thing). Histogram buckets are FIXED at
+registration: snapshots from different processes/rounds merge by simple
+addition, and the hot-path observe is a bisect, not an allocation.
+
+Default-on (``obs_metrics: true``): the hot hooks are a dict lookup and a
+float add. The registry itself always works — only the convenience
+``record_*`` hooks consult the knob, so instrumented code never branches.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_cfg = {"enabled": True, "flush_every": 10}
+
+
+def set_enabled(on: bool) -> None:
+    _cfg["enabled"] = bool(on)
+
+
+def is_enabled() -> bool:
+    return _cfg["enabled"]
+
+
+def set_flush_every(rounds: int) -> None:
+    """Snapshot-to-JSONL cadence for :func:`maybe_flush` (0 = never).
+    Also resets the per-round dedup — ``configure`` runs on every
+    ``mlops.init``, so a NEW run's round 0 flushes even when the
+    previous run in this process also flushed at round 0."""
+    _cfg["flush_every"] = max(int(rounds), 0)
+    _flush_state["last"] = None
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.label_names)}")
+        return tuple(str(labels[k]) for k in self.label_names)
+
+    def _label_str(self, key: Tuple[str, ...]) -> str:
+        if not self.label_names:
+            return ""
+        pairs = ",".join(f'{n}="{v}"'
+                         for n, v in zip(self.label_names, key))
+        return "{" + pairs + "}"
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels: Any) -> None:
+        if value < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = self._data.get(key, 0.0) + float(value)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._data.get(self._key(labels), 0.0))
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"labels": dict(zip(self.label_names, k)), "value": v}
+                    for k, v in sorted(self._data.items())]
+
+    def expose(self) -> List[str]:
+        # same lock as snapshot: a transport thread inserting a new
+        # label key mid-exposition would otherwise crash the iteration
+        with self._lock:
+            items = sorted(self._data.items())
+        return [f"{self.name}{self._label_str(k)} {v}" for k, v in items]
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = float(value)
+
+    def inc(self, value: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = self._data.get(key, 0.0) + float(value)
+
+    def value(self, **labels: Any) -> Optional[float]:
+        with self._lock:
+            v = self._data.get(self._key(labels))
+            return None if v is None else float(v)
+
+    snapshot = Counter.snapshot
+    expose = Counter.expose
+
+
+class Histogram(_Instrument):
+    """Fixed upper-bound buckets (+Inf implied). Per label set:
+    cumulative bucket counts, sum, count — the Prometheus layout."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...],
+                 buckets: Sequence[float]):
+        super().__init__(name, help, label_names)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"{name}: histogram needs >= 1 bucket bound")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        value = float(value)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                ent = self._data[key] = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0, "count": 0}
+            ent["counts"][i] += 1
+            ent["sum"] += value
+            ent["count"] += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for k, ent in sorted(self._data.items()):
+                out.append({"labels": dict(zip(self.label_names, k)),
+                            "buckets": list(self.buckets),
+                            "counts": list(ent["counts"]),
+                            "sum": ent["sum"], "count": ent["count"]})
+            return out
+
+    def expose(self) -> List[str]:
+        lines = []
+        with self._lock:  # see Counter.expose
+            items = [(k, {"counts": list(e["counts"]), "sum": e["sum"],
+                          "count": e["count"]})
+                     for k, e in sorted(self._data.items())]
+        for k, ent in items:
+            cum = 0
+            for b, c in zip(self.buckets, ent["counts"]):
+                cum += c
+                le = self._le_labels(k, b)
+                lines.append(f"{self.name}_bucket{le} {cum}")
+            le = self._le_labels(k, "+Inf")
+            lines.append(f"{self.name}_bucket{le} {ent['count']}")
+            ls = self._label_str(k)
+            lines.append(f"{self.name}_sum{ls} {ent['sum']}")
+            lines.append(f"{self.name}_count{ls} {ent['count']}")
+        return lines
+
+    def _le_labels(self, key: Tuple[str, ...], bound) -> str:
+        pairs = [f'{n}="{v}"' for n, v in zip(self.label_names, key)]
+        pairs.append(f'le="{bound}"')
+        return "{" + ",".join(pairs) + "}"
+
+
+class MetricsRegistry:
+    """Get-or-create instrument registry; the process-wide instance is
+    :data:`REGISTRY` (one process = one rank, like ``WIRE_STATS``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get(self, cls, name: str, help: str, labels: Tuple[str, ...],
+             **kw) -> _Instrument:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, help,
+                                                     tuple(labels), **kw)
+                return inst
+        if not isinstance(inst, cls):
+            raise ValueError(f"{name} already registered as {inst.kind}")
+        if tuple(labels) != inst.label_names:
+            raise ValueError(
+                f"{name} already registered with labels "
+                f"{inst.label_names}, not {tuple(labels)}")
+        want_buckets = kw.get("buckets")
+        if (want_buckets is not None
+                and tuple(sorted(float(b) for b in want_buckets))
+                != getattr(inst, "buckets", ())):
+            raise ValueError(
+                f"{name} already registered with buckets "
+                f"{inst.buckets}, not {tuple(want_buckets)}")
+        return inst
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help, tuple(labels))
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help, tuple(labels))
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None,
+                  labels: Sequence[str] = ()) -> Histogram:
+        """``buckets=None`` means "whatever is registered" on a re-get
+        (the default bounds apply only on first creation); passing
+        explicit buckets that differ from the registered ones raises —
+        the observations would land in bounds the caller never asked
+        for, silently."""
+        if buckets is None and name not in self._instruments:
+            buckets = (0.01, 0.1, 1.0, 10.0)
+        if buckets is None:
+            return self._get(Histogram, name, help, tuple(labels))
+        return self._get(Histogram, name, help, tuple(labels),
+                         buckets=buckets)
+
+    # --- readouts -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            insts = list(self._instruments.values())
+        return {i.name: {"type": i.kind, "help": i.help,
+                         "values": i.snapshot()} for i in insts}
+
+    def exposition(self) -> str:
+        """Prometheus text exposition of every instrument."""
+        with self._lock:
+            insts = sorted(self._instruments.values(), key=lambda i: i.name)
+        lines: List[str] = []
+        for i in insts:
+            if i.help:
+                lines.append(f"# HELP {i.name} {i.help}")
+            lines.append(f"# TYPE {i.name} {i.kind}")
+            lines.extend(i.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def flush(self, step: Optional[int] = None) -> None:
+        """Emit one ``metrics_snapshot`` JSONL record through mlops."""
+        from .. import mlops
+        mlops._emit("metrics_snapshot", {"metrics": self.snapshot(),
+                                         "step": step})
+
+    def reset(self) -> None:
+        """Drop every instrument (tests only — production counters are
+        process-lifetime by design)."""
+        with self._lock:
+            self._instruments.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+# shared bucket ladders (fixed at registration; see module docstring)
+STALENESS_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+OCCUPANCY_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+WALL_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0)
+
+
+# --- canonical hooks --------------------------------------------------------
+# One helper per seam, so the instrumented code is a single line and the
+# metric names/labels cannot drift between callers. Each consults the
+# enable knob; the registry itself is always live for direct users.
+
+def record_wire(msg_type: Any, nbytes: int) -> None:
+    """``Message.encode`` seam: per-message-type bytes on the wire."""
+    if not _cfg["enabled"]:
+        return
+    t = str(msg_type)
+    REGISTRY.counter("fed_wire_bytes_total",
+                     "bytes serialized at Message.encode, by message type",
+                     labels=("msg_type",)).inc(int(nbytes), msg_type=t)
+    REGISTRY.counter("fed_wire_messages_total",
+                     "messages serialized at Message.encode",
+                     labels=("msg_type",)).inc(1, msg_type=t)
+
+
+def record_dispatch(name: str, wall_s: float, rounds: int,
+                    compiles: int) -> None:
+    """Engine ``_traced`` seam: dispatch wall time + compile counter."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("fed_dispatch_wall_seconds",
+                       "host wall time of one device dispatch",
+                       buckets=WALL_BUCKETS,
+                       labels=("dispatch",)).observe(float(wall_s),
+                                                     dispatch=str(name))
+    REGISTRY.counter("fed_dispatch_rounds_total",
+                     "FL rounds carried by dispatches",
+                     labels=("dispatch",)).inc(int(rounds),
+                                               dispatch=str(name))
+    if compiles:
+        REGISTRY.counter("fed_xla_compiles_total",
+                         "XLA backend compiles observed at dispatch "
+                         "seams").inc(int(compiles))
+
+
+def record_pour(staleness: Sequence[float], buffered: int,
+                poured: int) -> None:
+    """Async pour seam: staleness + buffer occupancy histograms."""
+    if not _cfg["enabled"]:
+        return
+    h = REGISTRY.histogram("fed_pour_staleness",
+                           "per-update staleness (versions) at pour time",
+                           buckets=STALENESS_BUCKETS)
+    for s in staleness:
+        h.observe(float(s))
+    REGISTRY.histogram("fed_buffer_occupancy",
+                       "buffered update count after each pour",
+                       buckets=OCCUPANCY_BUCKETS).observe(int(buffered))
+    REGISTRY.counter("fed_pours_total", "pours executed").inc(1)
+    REGISTRY.counter("fed_updates_poured_total",
+                     "client updates aggregated by pours").inc(int(poured))
+
+
+def record_arrival(latency_s: float, rate_mean: Optional[float] = None
+                   ) -> None:
+    """Async arrival seam: per-update latency histogram + the population
+    arrival-rate gauge the adaptive staleness cap reads."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("fed_arrival_latency_seconds",
+                       "dispatch-to-arrival latency of client updates",
+                       buckets=LATENCY_BUCKETS).observe(float(latency_s))
+    if rate_mean is not None and rate_mean > 0:
+        REGISTRY.gauge("fed_arrival_rate_mean",
+                       "population-mean client arrival rate "
+                       "(updates/sec)").set(float(rate_mean))
+
+
+def record_selection(strategy: str, sampled: int, excluded: int) -> None:
+    """Selection seam: scheduled vs benched decisions per strategy."""
+    if not _cfg["enabled"]:
+        return
+    c = REGISTRY.counter("fed_selection_decisions_total",
+                         "participant-selection decisions",
+                         labels=("strategy", "outcome"))
+    c.inc(int(sampled), strategy=str(strategy), outcome="sampled")
+    if excluded:
+        c.inc(int(excluded), strategy=str(strategy), outcome="excluded")
+
+
+def record_checkpoint_flush(wall_s: float) -> None:
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("fed_checkpoint_flush_seconds",
+                       "blocking checkpoint flush wall time",
+                       buckets=WALL_BUCKETS).observe(float(wall_s))
+
+
+def record_hbm_peak(gb: float) -> None:
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.gauge("fed_hbm_peak_gb",
+                   "per-device peak HBM (GiB, process-monotonic "
+                   "counter)").set(float(gb))
+
+
+def record_round_mfu(mfu: float, tflops: Optional[float] = None) -> None:
+    """Profiling plane: per-round model FLOPs utilization (same FLOPs
+    model as the bench — ``engine.round_cost_flops``)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.gauge("fed_round_mfu",
+                   "per-round model FLOPs utilization").set(float(mfu))
+    if tflops is not None:
+        REGISTRY.gauge("fed_round_tflops",
+                       "achieved TFLOP/s over the round").set(float(tflops))
+
+
+_flush_state = {"last": None}
+
+
+def maybe_flush(round_idx: int) -> None:
+    """Round-boundary hook (``mlops.log_round_info``): snapshot to JSONL
+    every ``obs_metrics_flush_rounds`` rounds. Deduped per round — fused
+    blocks replay round boundaries in bursts."""
+    every = _cfg["flush_every"]
+    if not _cfg["enabled"] or every <= 0:
+        return
+    if round_idx % every == 0 and _flush_state["last"] != round_idx:
+        _flush_state["last"] = round_idx
+        REGISTRY.flush(step=round_idx)
+
+
+def flush_final(step: Optional[int] = None) -> None:
+    """Unconditional end-of-run snapshot (engines' ``run()`` end, the
+    server's ``finish_session``): without it, everything accumulated
+    since the last cadence boundary — the final rounds' wire bytes,
+    staleness histograms, MFU — would die with the process and the run
+    log would NOT be self-contained."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.flush(step=step)

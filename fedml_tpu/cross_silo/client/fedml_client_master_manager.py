@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from ...core import mlops
+from ...core.obs import trace as obs_trace
 from ...core.chaos import FaultPlan
 from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
                                                        Message,
@@ -138,6 +139,17 @@ class ClientMasterManager(FedMLCommManager):
             self._server_heard.set()
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, 0))
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        # join the server's round trace: the sync carried the broadcast
+        # span's traceparent, so this silo's train/upload spans nest
+        # under it — ONE tree per round across processes
+        with obs_trace.tracer.span(
+                "silo.round", parent=obs_trace.extract(msg),
+                attrs={"role": "client", "rank": self.rank,
+                       "round_idx": self.round_idx}) as rsp:
+            self._train_and_report_traced(msg, client_idx, rsp)
+
+    def _train_and_report_traced(self, msg: Message, client_idx: int,
+                                 rsp) -> None:
         # ALWAYS consume the broadcast, even when dropping out below: a
         # compressed sync is a delta vs the last reconstruction — skipping
         # it would leave _global_vec one delta behind and corrupt every
@@ -148,6 +160,7 @@ class ClientMasterManager(FedMLCommManager):
             # next round but train/report nothing this round
             logger.warning("chaos: silo %d drops out of round %d",
                            self.rank, self.round_idx)
+            rsp.set_attr("dropped", True)
             mlops.log_chaos(round_idx=self.round_idx,
                             injected={"dropped": [self.rank]})
             return
@@ -193,7 +206,14 @@ class ClientMasterManager(FedMLCommManager):
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n_samples))
         out.add_params(MyMessage.MSG_ARG_KEY_CLIENT_METRICS,
                        {k: float(v) for k, v in (metrics or {}).items()})
-        self.send_message(out)
+        with obs_trace.tracer.span(
+                "upload", attrs={"rank": self.rank,
+                                 "round_idx": self.round_idx}) as usp:
+            # the UPLOAD span's context rides the upload: the async
+            # server's pour links exactly these spans (staleness per
+            # link); the sync server links them off its wait span
+            obs_trace.inject(out, usp)
+            self.send_message(out)
 
     def handle_message_finish(self, msg: Message) -> None:
         if hasattr(self, "_server_heard"):

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core import mlops
+from ...core.obs import trace as obs_trace
 from ...core.security.defense import verdict_from_info
 from ...core.async_rounds import (UpdateBuffer, adaptive_staleness_cap,
                                   buffer_k_from_args, make_staleness_fn,
@@ -136,10 +137,11 @@ class AsyncFedMLAggregator(FedMLAggregator):
 
     def add_async_upload(self, rank: int, payload, sample_num: float,
                          up_version: int, arrival_t: float,
-                         compressed: bool) -> int:
+                         compressed: bool, trace=None) -> int:
         """Buffer one silo upload as a delta vs its dispatch base.
         Returns the buffered count (the pour trigger reads it under the
-        same lock discipline as the add)."""
+        same lock discipline as the add). ``trace`` is the upload span's
+        context — the pour span links it, staleness attached."""
         if compressed:
             # a compressed upload IS the delta vs the broadcast the silo
             # holds — exactly its dispatch base; no reconstruction needed
@@ -153,7 +155,8 @@ class AsyncFedMLAggregator(FedMLAggregator):
                                    np.float32))
             delta = vec - self.base_for(up_version)
         self.buffer.add(int(rank), delta, weight=float(sample_num),
-                        version=int(up_version), arrival_t=float(arrival_t))
+                        version=int(up_version), arrival_t=float(arrival_t),
+                        trace=trace)
         return len(self.buffer)
 
     # --- the pour -----------------------------------------------------------
@@ -238,7 +241,13 @@ class AsyncFedMLAggregator(FedMLAggregator):
         return [{"client": e.client_id, "staleness": int(s),
                  "arrival_t": e.arrival_t, "dispatch_version": e.version,
                  "weight": e.weight, "norm_weight": float(nw),
-                 "merge_scale": float(merge_scale)}
+                 "merge_scale": float(merge_scale),
+                 # the producing upload span's traceparent (None when the
+                 # silo predates tracing or the header was stripped): the
+                 # pour span links it, and the ledger record carries it so
+                 # post-mortems can jump from a pour to its uploads
+                 "trace": (e.trace.traceparent()
+                           if e.trace is not None else None)}
                 for e, s, nw in zip(entries, stal, norm_w)]
 
 
@@ -312,16 +321,23 @@ class AsyncFedMLServerManager(FedMLServerManager):
         now = time.time()
         assign = self.aggregator.assign_data_indices(self._round_targets,
                                                      client_indexes)
-        for rank in self._round_targets:
-            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank,
-                          rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
-                           self.aggregator.version)
-            self._sync_t[rank] = now
-            self._outstanding[rank] = self.aggregator.version
-            self.send_message(msg)
+        with obs_trace.tracer.span(
+                "async.sync", root=True,
+                attrs={"role": "server", "version": self.aggregator.version,
+                       "targets": len(self._round_targets),
+                       "init": True}) as ssp:
+            for rank in self._round_targets:
+                msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank,
+                              rank)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                               assign[rank])
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                               self.aggregator.version)
+                obs_trace.inject(msg, ssp)
+                self._sync_t[rank] = now
+                self._outstanding[rank] = self.aggregator.version
+                self.send_message(msg)
         self._arm_pour_timer()
 
     # --- the async upload seam ----------------------------------------------
@@ -365,13 +381,15 @@ class AsyncFedMLServerManager(FedMLServerManager):
         with self._pour_lock:
             buffered = self.aggregator.add_async_upload(
                 sender, payload, n, up_version, recv_t,
-                compressed=compressed)
+                compressed=compressed, trace=obs_trace.extract(msg))
         # arrival-rate observations: latency vs this silo's OWN sync,
         # inter-arrival gap (the arrival-rate posterior), and
         # participation evidence for the dropout posterior
         t0 = self._sync_t.get(sender)
         if t0 is not None:
             self.aggregator.observe_upload(sender, recv_t - t0)
+            from ...core.obs import metrics as obs_metrics
+            obs_metrics.record_arrival(recv_t - t0)
         self._outstanding.pop(sender, None)
         prev = self._last_arrival.get(sender)
         if prev is not None and 0 <= int(sender) < \
@@ -426,37 +444,63 @@ class AsyncFedMLServerManager(FedMLServerManager):
             self._arm_pour_timer()
 
     def _pour(self, reason: str) -> None:
-        with self._pour_lock:
-            if self._done:
-                return
-            arrivals = self.aggregator.pour()
-            if not arrivals:
-                self._arm_pour_timer()
-                return
-            version = self.aggregator.version  # post-pour version
-            self.chaos_ledger.record_pour(
-                version - 1, arrivals,
-                observed={"poured": len(arrivals),
-                          "buffered": len(self.aggregator.buffer),
-                          "reason": reason,
-                          "staleness_cap": self.aggregator.staleness_cap})
-            contributors = sorted({int(a["client"]) for a in arrivals})
-        freq = int(getattr(self.args, "frequency_of_the_test", 5) or 5)
-        rec: Dict[str, Any] = {
-            "round": version - 1, "poured": len(arrivals),
-            "staleness_mean": float(np.mean([a["staleness"]
-                                             for a in arrivals])),
-            "staleness_max": int(max(a["staleness"] for a in arrivals)),
-        }
-        if freq > 0 and ((version - 1) % freq == 0
-                         or version >= self.round_num):
-            stats = self.aggregator.test_on_server()
-            if stats:
-                rec.update(stats)
-                logger.info("async server pour %d (staleness mean %.2f): "
-                            "%s", version - 1, rec["staleness_mean"], stats)
-        self.history.append(rec)
-        mlops.log_round_info(self.round_num, version - 1)
+        # the pour is its own trace: it consumes uploads from MANY sync
+        # traces, so parentage cannot express the fan-in — LINKS to the
+        # K contributing upload spans do, staleness attached per link
+        psp = obs_trace.tracer.start_span(
+            "pour", root=True,
+            attrs={"role": "server", "reason": reason,
+                   "version": self.aggregator.version})
+        with psp:
+            with self._pour_lock:
+                if self._done:
+                    return
+                with obs_trace.span("aggregate",
+                                    attrs={"reason": reason}):
+                    arrivals = self.aggregator.pour()
+                if not arrivals:
+                    psp.set_attr("empty", True)
+                    self._arm_pour_timer()
+                    return
+                version = self.aggregator.version  # post-pour version
+                self.chaos_ledger.record_pour(
+                    version - 1, arrivals,
+                    observed={"poured": len(arrivals),
+                              "buffered": len(self.aggregator.buffer),
+                              "reason": reason,
+                              "staleness_cap":
+                                  self.aggregator.staleness_cap})
+                contributors = sorted({int(a["client"]) for a in arrivals})
+            psp.set_attr("poured", len(arrivals))
+            for a in arrivals:
+                if a.get("trace"):
+                    psp.add_link(a["trace"], client=int(a["client"]),
+                                 staleness=int(a["staleness"]),
+                                 dispatch_version=int(
+                                     a["dispatch_version"]))
+            freq = int(getattr(self.args, "frequency_of_the_test", 5)
+                       or 5)
+            rec: Dict[str, Any] = {
+                "round": version - 1, "poured": len(arrivals),
+                "staleness_mean": float(np.mean([a["staleness"]
+                                                 for a in arrivals])),
+                "staleness_max": int(max(a["staleness"]
+                                         for a in arrivals)),
+            }
+            if freq > 0 and ((version - 1) % freq == 0
+                             or version >= self.round_num):
+                with obs_trace.span("eval",
+                                    attrs={"version": version - 1}):
+                    stats = self.aggregator.test_on_server()
+                if stats:
+                    rec.update(stats)
+                    logger.info("async server pour %d (staleness mean "
+                                "%.2f): %s", version - 1,
+                                rec["staleness_mean"], stats)
+            with obs_trace.span("host.close",
+                                attrs={"version": version - 1}):
+                self.history.append(rec)
+                mlops.log_round_info(self.round_num, version - 1)
         if version >= self.round_num:
             self.finish_session()
             return
@@ -486,19 +530,31 @@ class AsyncFedMLServerManager(FedMLServerManager):
         wire = tree_to_wire(self.aggregator.global_params)
         now = time.time()
         assign = self.aggregator.assign_data_indices(ranks, client_indexes)
-        for rank in ranks:
-            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
-                          self.rank, rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
-            if rank not in self._outstanding:
-                # first sync of this outstanding period wins the clock: a
-                # timeout-nudge re-sync must not re-zero a slow silo's
-                # observed latency
-                self._sync_t[rank] = now
-            self._outstanding[rank] = version
-            self.send_message(msg)
+        # one sync span per batch, a fresh trace per model version: each
+        # silo's train/upload joins THIS version's trace, and the pour
+        # that eventually consumes the upload links back to it
+        with obs_trace.tracer.span(
+                "async.sync", root=True,
+                attrs={"role": "server", "version": version,
+                       "targets": len(ranks)}) as ssp:
+            for rank in ranks:
+                msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              self.rank, rank)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                               assign[rank])
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, version)
+                obs_trace.inject(msg, ssp)
+                if rank not in self._outstanding:
+                    # first sync of this outstanding period wins the
+                    # clock: a timeout-nudge re-sync must not re-zero a
+                    # slow silo's observed latency
+                    self._sync_t[rank] = now
+                self._outstanding[rank] = version
+                self.send_message(msg)
+
+    def _finish_step(self) -> int:
+        return int(self.aggregator.version)
 
     def finish_session(self) -> None:
         self._done = True

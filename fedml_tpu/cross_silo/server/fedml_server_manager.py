@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from ...core import mlops
+from ...core.obs import trace as obs_trace
 from ...core.chaos import FaultLedger, FaultPlan
 from ...core.collectives import tree_flatten_to_vector
 from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
@@ -44,6 +45,12 @@ class FedMLServerManager(FedMLCommManager):
     _bcast_t0 = None
     _round_targets: list = []
     _round_selected: list = []
+    # tracing: one trace per round — the ROOT span covers broadcast →
+    # wait → aggregate; WAIT is the explicit straggler-time span between
+    # broadcast end and round close (upload receipts land on it as
+    # events), so trace_report can attribute the round's wall time
+    _round_span = obs_trace.NOOP_SPAN
+    _wait_span = obs_trace.NOOP_SPAN
 
     def __init__(self, args, aggregator, comm=None, rank: int = 0,
                  size: int = 0, backend: str = "INPROC"):
@@ -129,39 +136,99 @@ class FedMLServerManager(FedMLCommManager):
         dense: the init model is the common reference both sides compute
         deltas against (a ``compress`` broadcast needs every client to hold
         the exact vector the server tracks in ``_bcast_prev_vec``)."""
+        self._begin_round_trace()
         client_indexes = self.aggregator.client_selection(
             self.round_idx, int(self.args.client_num_in_total),
             self.client_num)
-        wire = tree_to_wire(self.aggregator.global_params)
-        if self.cc_spec is not None and self.cc_spec.method is not None:
-            # whenever clients upload deltas the server must track the base
-            # they refer to (what the clients reconstruct) — for EVERY
-            # broadcast mode, including dense 'full': the upload handler
-            # captures this base under _round_lock, so a round-timeout
-            # aggregation racing a late upload cannot swap the base
-            # mid-flight. After a dense init it is the exact global vector.
-            # Broadcast-only specs (method None) get no deltas: skip.
-            self._bcast_prev_vec = self._global_f32_vec()
-        self._round_targets = sorted(self.client_online_status)
-        self._round_selected = list(self._round_targets)
-        self._bcast_t0 = time.time()
-        assign = self.aggregator.assign_data_indices(self._round_targets,
-                                                     client_indexes)
-        for rank in self._round_targets:
-            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
-            self.send_message(msg)
+        bsp = obs_trace.tracer.start_span(
+            "broadcast", parent=self._round_span,
+            attrs={"round_idx": self.round_idx})
+        with bsp:  # payload build INSIDE the span: prep time is broadcast
+            # time, and a prep exception still emits the span (error attr)
+            wire = tree_to_wire(self.aggregator.global_params)
+            if self.cc_spec is not None and self.cc_spec.method is not None:
+                # whenever clients upload deltas the server must track the
+                # base they refer to (what the clients reconstruct) — for
+                # EVERY broadcast mode, including dense 'full': the upload
+                # handler captures this base under _round_lock, so a
+                # round-timeout aggregation racing a late upload cannot
+                # swap the base mid-flight. After a dense init it is the
+                # exact global vector. Broadcast-only specs (method None)
+                # get no deltas: skip.
+                self._bcast_prev_vec = self._global_f32_vec()
+            self._round_targets = sorted(self.client_online_status)
+            self._round_selected = list(self._round_targets)
+            self._bcast_t0 = time.time()
+            assign = self.aggregator.assign_data_indices(
+                self._round_targets, client_indexes)
+            bsp.set_attr("targets", len(self._round_targets))
+            for rank in self._round_targets:
+                msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank,
+                              rank)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire)
+                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                               assign[rank])
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                               self.round_idx)
+                # the broadcast span's context rides every sync: each
+                # silo's train/upload spans join THIS round's trace
+                obs_trace.inject(msg, bsp)
+                self.send_message(msg)
+        self._begin_wait_span()
         if self.chaos.enabled:
             # under chaos the whole round's uploads can vanish — the
             # timeout must run from the broadcast, not from an upload
             # that may never come
             self._arm_round_timer()
 
+    # --- round tracing (core/obs) -------------------------------------------
+    def _begin_round_trace(self) -> None:
+        """Open a fresh trace for the round about to broadcast (root=True:
+        round boundaries are trace boundaries)."""
+        self._end_round_trace()  # a skipped round may have left one open
+        self._round_span = obs_trace.tracer.start_span(
+            "round", root=True, attrs={"role": "server",
+                                       "round_idx": self.round_idx})
+
+    def _begin_wait_span(self) -> None:
+        """The straggler-time span: broadcast done → round close. Upload
+        receipts land on it as events (from transport threads — the span
+        is internally locked)."""
+        self._wait_span = obs_trace.tracer.start_span(
+            "wait.uploads", parent=self._round_span,
+            attrs={"round_idx": self.round_idx})
+
+    def _end_round_trace(self, **attrs) -> None:
+        self._wait_span.end()
+        self._wait_span = obs_trace.NOOP_SPAN
+        for k, v in attrs.items():
+            self._round_span.set_attr(k, v)
+        self._round_span.end()
+        self._round_span = obs_trace.NOOP_SPAN
+
     def handle_message_receive_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
         recv_t = time.time()
+        # receipt lands on the wait span: an event with the sender plus a
+        # link to the silo's upload span (its context rode the wire), so
+        # the round trace shows WHEN each straggler finally reported.
+        # Recorded only after the stale check resolves: a chaos-delayed
+        # upload from a timed-out round belongs to the OLD round's trace,
+        # and must not read as a receipt the current round consumed.
+        up_ctx = obs_trace.extract(msg)
+
+        def _record_receipt(stale: bool) -> None:
+            # a silo fast enough to upload while the server is still
+            # inside the broadcast send loop beats _begin_wait_span(); the
+            # receipt then falls back to the ROUND span (live since before
+            # the broadcast) instead of vanishing into the NOOP wait span
+            sp = self._wait_span
+            if sp is obs_trace.NOOP_SPAN:
+                sp = self._round_span
+            sp.add_event("upload", sender=int(sender), stale=bool(stale))
+            if up_ctx is not None:
+                sp.add_link(up_ctx, sender=int(sender), stale=bool(stale))
+
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0))
         update = msg.get(MyMessage.MSG_ARG_KEY_MODEL_UPDATE)
         if is_compressed_payload(update):  # delta vs the broadcast model
@@ -186,6 +253,7 @@ class FedMLServerManager(FedMLCommManager):
                     "server: dropping stale compressed update from silo "
                     "%s (round %s, now %d)", sender, up_round,
                     self.round_idx)
+                _record_receipt(stale=True)
                 return
         else:
             wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
@@ -206,7 +274,9 @@ class FedMLServerManager(FedMLCommManager):
                 logger.warning(
                     "server: dropping stale upload from silo %s "
                     "(round %s, now %d)", sender, up_round, self.round_idx)
+                _record_receipt(stale=True)
                 return
+        _record_receipt(stale=False)
         if self._bcast_t0 is not None:
             # broadcast→receipt wall time: the silo-selection latency
             # signal (the silo's train time + both wire hops — what the
@@ -301,6 +371,7 @@ class FedMLServerManager(FedMLCommManager):
                                   "skipped": True})
                     self._timeout_graced = False
                     self.round_idx += 1
+                    self._end_round_trace(skipped=True, reported=0)
                 else:
                     logger.warning(
                         "server round %d: timeout with %d/%d models — "
@@ -331,7 +402,17 @@ class FedMLServerManager(FedMLCommManager):
                     self._round_selected
                     or sorted(self.client_online_status))
                 round_key = jrandom.fold_in(self._root_key, self.round_idx)
-                self.aggregator.aggregate(round_key)
+                # the wait is over: everything from here is server work
+                self._wait_span.set_attr("reported", reported)
+                self._wait_span.set_attr("from_timeout",
+                                         bool(from_timeout))
+                self._wait_span.end()
+                self._wait_span = obs_trace.NOOP_SPAN
+                with obs_trace.tracer.span(
+                        "aggregate", parent=self._round_span,
+                        attrs={"round_idx": self.round_idx,
+                               "reported": reported}):
+                    self.aggregator.aggregate(round_key)
                 # close the round under the SAME lock acquisition that
                 # aggregates: a straggler arriving during the (slow) server
                 # eval below must already see the new round_idx, or its
@@ -348,20 +429,26 @@ class FedMLServerManager(FedMLCommManager):
             else:
                 self.sync_model_to_clients()
             return
-        stats = self.aggregator.test_on_server()
-        rec = {"round": completed_round}
-        if stats:
-            rec.update(stats)
-            logger.info("server round %d: %s", completed_round, stats)
-        # bytes-on-wire this round (diff of the process-wide encode ledger)
-        total = WIRE_STATS.total_bytes
-        rec["wire_bytes"] = total - self._wire_mark
-        self._wire_mark = total
-        mlops.log_comm_round(completed_round, rec["wire_bytes"],
-                             compression=getattr(self.cc_spec, "method",
-                                                 None))
-        self.history.append(rec)
-        mlops.log_round_info(self.round_num, completed_round)
+        with obs_trace.tracer.span("eval", parent=self._round_span,
+                                   attrs={"round_idx": completed_round}):
+            stats = self.aggregator.test_on_server()
+        with obs_trace.tracer.span("host.close", parent=self._round_span,
+                                   attrs={"round_idx": completed_round}):
+            rec = {"round": completed_round}
+            if stats:
+                rec.update(stats)
+                logger.info("server round %d: %s", completed_round, stats)
+            # bytes-on-wire this round (diff of the process-wide ledger)
+            total = WIRE_STATS.total_bytes
+            rec["wire_bytes"] = total - self._wire_mark
+            self._wire_mark = total
+            mlops.log_comm_round(completed_round, rec["wire_bytes"],
+                                 compression=getattr(self.cc_spec,
+                                                     "method", None))
+            self.history.append(rec)
+            mlops.log_round_info(self.round_num, completed_round)
+        self._end_round_trace(reported=len(self._round_selected),
+                              wire_bytes=rec["wire_bytes"])
         if self.round_idx >= self.round_num:
             self.finish_session()
             return
@@ -412,6 +499,7 @@ class FedMLServerManager(FedMLCommManager):
                  tree_to_wire(self.aggregator.global_params))]
 
     def sync_model_to_clients(self) -> None:
+        self._begin_round_trace()
         client_indexes = self.aggregator.client_selection(
             self.round_idx, int(self.args.client_num_in_total),
             self.client_num)
@@ -437,24 +525,45 @@ class FedMLServerManager(FedMLCommManager):
             target_n=len(selected))
         self._round_targets = online
         self._round_selected = selected
-        payload = self._sync_payload()
-        self._bcast_t0 = time.time()
-        # DATA-index assignment: legacy round-robin by default; the
-        # `scored` knob routes the first-sampled indices to the silos the
-        # stats store scores most deliverable (see assign_data_indices)
-        assign = self.aggregator.assign_data_indices(online, client_indexes)
-        for rank in online:
-            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
-                          self.rank, rank)
-            for key, value in payload:
-                msg.add_params(key, value)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, assign[rank])
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
-            self.send_message(msg)
+        bsp = obs_trace.tracer.start_span(
+            "broadcast", parent=self._round_span,
+            attrs={"round_idx": self.round_idx, "targets": len(online)})
+        with bsp:  # payload build INSIDE the span: prep time is broadcast
+            # time, and a prep exception still emits the span (error attr)
+            payload = self._sync_payload()
+            self._bcast_t0 = time.time()
+            # DATA-index assignment: legacy round-robin by default; the
+            # `scored` knob routes the first-sampled indices to the silos
+            # the stats store scores most deliverable (assign_data_indices)
+            assign = self.aggregator.assign_data_indices(online,
+                                                         client_indexes)
+            for rank in online:
+                msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              self.rank, rank)
+                for key, value in payload:
+                    msg.add_params(key, value)
+                msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                               assign[rank])
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                               self.round_idx)
+                obs_trace.inject(msg, bsp)  # see send_init_msg
+                self.send_message(msg)
+        self._begin_wait_span()
         if self.chaos.enabled:
             self._arm_round_timer()  # see send_init_msg
 
+    def _finish_step(self) -> int:
+        """Step stamped on the end-of-run metrics snapshot (the async
+        manager progresses by aggregator version, not round_idx)."""
+        return int(self.round_idx)
+
     def finish_session(self) -> None:
+        self._end_round_trace()  # a timeout-skipped final round leaves one
+        # final metrics snapshot before the FINISH broadcast: the run log
+        # must carry the whole session's instruments, not just the last
+        # cadence boundary's
+        from ...core.obs import metrics as obs_metrics
+        obs_metrics.flush_final(step=self._finish_step())
         for rank in sorted(self.client_online_status):
             self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH,
                                       self.rank, rank))

@@ -74,6 +74,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...constants import AXIS_CLIENT
 from ...core import mlops
+from ...core.obs import metrics as obs_metrics
+from ...core.obs import trace as obs_trace
 from ...core.async_rounds import (adaptive_staleness_cap, buffer_k_from_args,
                                   durations_from_args, faulted_duration,
                                   make_staleness_fn, merge_alpha_from_args,
@@ -209,6 +211,11 @@ class AsyncBufferedSimulator(TPUSimulator):
         # the arrival-rate signal behind the adaptive staleness cap
         self._lat_ema = np.zeros(fed_dataset.num_clients, np.float64)
         self._lat_seen = np.zeros(fed_dataset.num_clients, np.float64)
+        # running aggregates over the seen-clients' EMAs, maintained
+        # incrementally so the per-arrival rate gauge costs O(1), not a
+        # full-population mean in the event-heap hot loop
+        self._lat_ema_sum = 0.0
+        self._lat_seen_n = 0
         self._last_arrival_t = np.full(fed_dataset.num_clients, -1.0,
                                        np.float64)
         # idle rotation: seeded permutation so dispatch order respects
@@ -578,10 +585,15 @@ class AsyncBufferedSimulator(TPUSimulator):
                          faulted_duration(self.durations[cid], ws)))
         return idx, active, work, plan
 
-    def _push_events(self, plan, rows_mat) -> None:
+    def _push_events(self, plan, rows_mat, ctx=None) -> None:
         """Turn a dispatch plan into future events: arrivals carry the
         client's update row (extracted as a device slice — computed at
-        dispatch, delivered at arrival); drops become redemption events."""
+        dispatch, delivered at arrival); drops become redemption events.
+        ``ctx`` is the dispatching pour span's trace context: it rides
+        the event to the buffer entry, so the pour that eventually
+        consumes the update can LINK back to the dispatch that produced
+        it (staleness per link). Never compared by the heap — ``seq`` is
+        unique before it."""
         t0 = self.virtual_t
         dropped = []
         for cid, row, ws, dur in plan:
@@ -593,7 +605,7 @@ class AsyncBufferedSimulator(TPUSimulator):
                                                   jnp.int32(row))
             heapq.heappush(self._events,
                            (t0 + dur, self._evseq, kind, cid, self.version,
-                            float(self._n_k[cid]), dur, vec))
+                            float(self._n_k[cid]), dur, vec, ctx))
             self._evseq += 1
         if dropped:
             mlops.log_chaos(round_idx=self._dispatch_seq,
@@ -605,11 +617,12 @@ class AsyncBufferedSimulator(TPUSimulator):
         while len(self.buffer) < n:
             if not self._events:
                 return False
-            t, _, kind, cid, ver, w, dur, vec = heapq.heappop(self._events)
+            (t, _, kind, cid, ver, w, dur, vec,
+             ctx) = heapq.heappop(self._events)
             self.virtual_t = max(self.virtual_t, t)
             if kind == _ARRIVE:
                 self.buffer.add(cid, vec, weight=w, version=ver,
-                                arrival_t=t)
+                                arrival_t=t, trace=ctx)
                 # observed arrival latency = the FAULTED duration (a
                 # straggler's slowness is the signal, not its base speed)
                 self._note_arrival(cid, dur)
@@ -622,51 +635,93 @@ class AsyncBufferedSimulator(TPUSimulator):
 
     def _note_arrival(self, cid: int, latency_s: float) -> None:
         a = 0.2
+        old = float(self._lat_ema[cid])
         if self._lat_seen[cid] > 0:
-            self._lat_ema[cid] = (1 - a) * self._lat_ema[cid] \
-                + a * float(latency_s)
+            self._lat_ema[cid] = (1 - a) * old + a * float(latency_s)
+            self._lat_ema_sum += float(self._lat_ema[cid]) - old
         else:
             self._lat_ema[cid] = float(latency_s)
             self._lat_seen[cid] = 1.0
+            self._lat_ema_sum += float(latency_s)
+            self._lat_seen_n += 1
         self.selection.note_latency(int(cid), float(latency_s))
+        # arrival-rate plane: latency histogram + the population-mean
+        # rate gauge the adaptive staleness cap effectively tracks
+        # (running sum/count — O(1) per arrival)
+        mean_lat = (self._lat_ema_sum / self._lat_seen_n
+                    if self._lat_seen_n else 0.0)
+        obs_metrics.record_arrival(
+            float(latency_s),
+            rate_mean=(1.0 / mean_lat) if mean_lat > 0 else None)
 
     # ------------------------------------------------------------------
     def _pour_step(self, hyper: TrainHyper) -> Dict[str, Any]:
         """One pour: absorb arrivals to K, aggregate them, re-dispatch the
-        freed clients — all device work in ONE program call."""
-        self._absorb_until(self.k)
-        entries = self.buffer.pour(self.version)
-        fn = self._staleness_fn()
-        stal = np.asarray([e.staleness(self.version) for e in entries],
-                          np.float64)
-        pad = self.k - len(entries)
-        if entries:
-            # the ONE staleness implementation: relative mix + absolute
-            # merge scale from core/async_rounds.pour_weights, fed to the
-            # program as data (padded rows carry weight 0)
-            norm_w, merge_scale = pour_weights(
-                [e.weight for e in entries], stal, fn, self.merge_alpha)
-            buf_nw = np.concatenate([norm_w, np.zeros(pad, np.float32)])
-        else:  # bootstrap / drained heap: a no-op pour
-            buf_nw = np.zeros(self.k, np.float32)
-            merge_scale = 0.0
-        vecs = [e.update for e in entries] + [self._zero_row] * pad
-        # pin the stacked buffer to the replicated sharding: the bootstrap
-        # rows (fresh zeros, single-device sharding) and steady-state rows
-        # (slices of the shard_map output, named sharding) must present
-        # the SAME input sharding or pjit recompiles the pour program on
-        # the bootstrap->steady-state transition
-        buf_mat = jax.device_put(self._stack_fn(vecs), self.repl_sharding)
+        freed clients — all device work in ONE program call. The pour is
+        its own trace, LINKING each consumed update back to the pour span
+        of the dispatch that produced it, staleness per link — the async
+        fan-in a parent/child tree cannot express."""
+        with obs_trace.tracer.span(
+                "pour", root=True,
+                attrs={"role": "engine", "version": self.version}) as psp:
+            with obs_trace.span("wait.arrivals",
+                                attrs={"version": self.version}):
+                # the absorb loop advances the virtual clock to the K-th
+                # arrival; wall-wise it is the host draining the event
+                # heap (device row slices included) — the async analog
+                # of the sync server's wait.uploads
+                self._absorb_until(self.k)
+                entries = self.buffer.pour(self.version)
+            psp.set_attr("poured", len(entries))
+            for e in entries:
+                if e.trace is not None:
+                    psp.add_link(e.trace, client=int(e.client_id),
+                                 staleness=int(e.staleness(self.version)),
+                                 dispatch_version=int(e.version))
+            return self._pour_step_traced(hyper, entries, psp)
 
-        target = max(0, self.concurrency - self._inflight()
-                     - len(self.buffer))
-        cohort = self._draw_cohort(target)
-        idx, active, work, plan = self._dispatch_plan(cohort)
-        idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
-        active = jax.device_put(jnp.asarray(active), self.client_sharding)
-        work = jax.device_put(jnp.asarray(work), self.client_sharding)
-        round_key = jax.random.fold_in(self.rng, self._dispatch_seq)
-        hyper_r = hyper.replace(round_idx=jnp.int32(self.version))
+    def _pour_step_traced(self, hyper: TrainHyper, entries,
+                          psp) -> Dict[str, Any]:
+        # host-side pour prep (staleness weights, buffer stack, cohort
+        # draw, schedule device_put) — its own span so trace_report can
+        # attribute the pour's host half, not just the dispatch
+        # the with-form ends the span even when prep raises (device_put
+        # OOM, shape errors) — a failed pour still flushes its host half
+        with obs_trace.span("host.input", attrs={"version": self.version}):
+            fn = self._staleness_fn()
+            stal = np.asarray([e.staleness(self.version) for e in entries],
+                              np.float64)
+            pad = self.k - len(entries)
+            if entries:
+                # the ONE staleness implementation: relative mix + absolute
+                # merge scale from core/async_rounds.pour_weights, fed to
+                # the program as data (padded rows carry weight 0)
+                norm_w, merge_scale = pour_weights(
+                    [e.weight for e in entries], stal, fn, self.merge_alpha)
+                buf_nw = np.concatenate([norm_w, np.zeros(pad, np.float32)])
+            else:  # bootstrap / drained heap: a no-op pour
+                buf_nw = np.zeros(self.k, np.float32)
+                merge_scale = 0.0
+            vecs = [e.update for e in entries] + [self._zero_row] * pad
+            # pin the stacked buffer to the replicated sharding: the
+            # bootstrap rows (fresh zeros, single-device sharding) and
+            # steady-state rows (slices of the shard_map output, named
+            # sharding) must present the SAME input sharding or pjit
+            # recompiles the pour program on the bootstrap->steady-state
+            # transition
+            buf_mat = jax.device_put(self._stack_fn(vecs),
+                                     self.repl_sharding)
+
+            target = max(0, self.concurrency - self._inflight()
+                         - len(self.buffer))
+            cohort = self._draw_cohort(target)
+            idx, active, work, plan = self._dispatch_plan(cohort)
+            idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
+            active = jax.device_put(jnp.asarray(active),
+                                    self.client_sharding)
+            work = jax.device_put(jnp.asarray(work), self.client_sharding)
+            round_key = jax.random.fold_in(self.rng, self._dispatch_seq)
+            hyper_r = hyper.replace(round_idx=jnp.int32(self.version))
         if self._defended:
             dmask, row_mask, pour_ids, byz = self._defended_pour_data(
                 entries)
@@ -701,34 +756,37 @@ class AsyncBufferedSimulator(TPUSimulator):
                 self.client_states, idx, active, work, buf_mat,
                 jnp.asarray(buf_nw), jnp.float32(merge_scale),
                 jnp.float32(len(entries)), round_key, hyper_r)
-        self._push_events(plan, rows_mat)
-        if self.selection.track:
-            self.selection.note_results(
-                self.version, cohort,
-                slot_placement(cohort, self.n_devices, self.cpd),
-                slot_metrics=slot_mets)
+        with obs_trace.span("host.close", attrs={"version": self.version}):
+            self._push_events(plan, rows_mat, ctx=psp.context)
+            if self.selection.track:
+                self.selection.note_results(
+                    self.version, cohort,
+                    slot_placement(cohort, self.n_devices, self.cpd),
+                    slot_metrics=slot_mets)
 
-        poured = len(entries)
-        self.updates_aggregated += poured
-        if poured:
-            # pour-interval EMA: the clock the adaptive staleness cap
-            # converts arrival latencies into version lag with
-            dt = self.virtual_t - self._last_pour_t
-            self._last_pour_t = self.virtual_t
-            self._pour_interval_ema = (dt if self._pour_interval_ema is None
-                                       else 0.8 * self._pour_interval_ema
-                                       + 0.2 * dt)
-            self.chaos_ledger.record_pour(
-                self.version,
-                arrivals=[{"client": e.client_id,
-                           "staleness": e.staleness(self.version),
-                           "arrival_t": e.arrival_t,
-                           "dispatch_version": e.version}
-                          for e in entries],
-                observed={"poured": poured, "buffered": len(self.buffer),
-                          "staleness_cap": self.staleness_cap,
-                          "virtual_t": self.virtual_t})
-            self.version += 1
+            poured = len(entries)
+            self.updates_aggregated += poured
+            if poured:
+                # pour-interval EMA: the clock the adaptive staleness cap
+                # converts arrival latencies into version lag with
+                dt = self.virtual_t - self._last_pour_t
+                self._last_pour_t = self.virtual_t
+                self._pour_interval_ema = (dt
+                                           if self._pour_interval_ema is None
+                                           else 0.8 * self._pour_interval_ema
+                                           + 0.2 * dt)
+                self.chaos_ledger.record_pour(
+                    self.version,
+                    arrivals=[{"client": e.client_id,
+                               "staleness": e.staleness(self.version),
+                               "arrival_t": e.arrival_t,
+                               "dispatch_version": e.version}
+                              for e in entries],
+                    observed={"poured": poured,
+                              "buffered": len(self.buffer),
+                              "staleness_cap": self.staleness_cap,
+                              "virtual_t": self.virtual_t})
+                self.version += 1
         return {"metrics": metrics, "poured": poured,
                 "staleness_mean": float(np.mean(stal)) if poured else 0.0,
                 "staleness_max": int(np.max(stal)) if poured else 0}
@@ -765,6 +823,7 @@ class AsyncBufferedSimulator(TPUSimulator):
             logger.info("resumed async state from checkpoint at pour %d "
                         "(version %d)", step, self.version)
         freq = int(getattr(args, "frequency_of_the_test", 5) or 5)
+        self._ensure_flops_model(hyper)
         self._bootstrap(hyper)
         stalls = 0
         while self.version < pours:
@@ -808,6 +867,8 @@ class AsyncBufferedSimulator(TPUSimulator):
                 self.ckpt.flush()
                 raise ChaosCrash(v)
         self.ckpt.flush()
+        # final metrics snapshot (see the sync engine's run())
+        obs_metrics.flush_final(step=self.version - 1)
         wall = time.time() - t0
         last_eval = next((r for r in reversed(self.history)
                           if "test_acc" in r), None)
@@ -861,7 +922,10 @@ class AsyncBufferedSimulator(TPUSimulator):
         ev_meta = np.zeros((e_rows, 7), np.float64)  # t,seq,kind,cid,ver,w,dur
         ev_vecs = np.zeros((e_rows, self._row_d), np.float32)
         ev_mask = np.zeros((e_rows,), np.float32)
-        for i, (t, seq, kind, cid, ver, w, dur, vec) in enumerate(ev):
+        # the trailing trace context (observability only) is NOT
+        # persisted: a resumed run replays identical pours, just without
+        # links to spans from before the crash
+        for i, (t, seq, kind, cid, ver, w, dur, vec, _ctx) in enumerate(ev):
             ev_meta[i] = (t, seq, kind, cid, ver, w, dur)
             if vec is not None:
                 ev_vecs[i] = np.asarray(vec, np.float32)
@@ -917,11 +981,15 @@ class AsyncBufferedSimulator(TPUSimulator):
             vec = jnp.asarray(vecs[i]) if int(kind) == _ARRIVE else None
             heapq.heappush(self._events, (float(t), int(seq), int(kind),
                                           int(cid), int(ver), float(w),
-                                          float(dur), vec))
+                                          float(dur), vec, None))
         self._idle = deque(int(c) for c in np.asarray(st["idle"], np.int64)
                            if c >= 0)
         self._lat_ema = np.asarray(st["lat_ema"], np.float64).copy()
         self._lat_seen = np.asarray(st["lat_seen"], np.float64).copy()
+        # rebuild the O(1) running aggregates from the restored arrays
+        seen = self._lat_seen > 0
+        self._lat_ema_sum = float(np.sum(self._lat_ema[seen]))
+        self._lat_seen_n = int(np.sum(seen))
         self._last_arrival_t = np.asarray(st["last_arrival_t"],
                                           np.float64).copy()
         if self._defended and "ring" in st:

@@ -32,6 +32,8 @@ from ...core.collectives import (
     psum_tree, tree_scale, tree_zeros_like, vector_to_tree_like)
 from ...core.dp import FedMLDifferentialPrivacy
 from ...core import mlops
+from ...core.obs import profiler as obs_profiler
+from ...core.obs import trace as obs_trace
 from ...core.chaos import ChaosCrash, FaultLedger, FaultPlan
 from ...core.checkpoint import RoundCheckpointer
 from ...core.contribution import ContributionAssessorManager
@@ -174,6 +176,14 @@ class TPUSimulator:
         mlops.install_compile_counter()
         self.dispatch_stats: Dict[str, Any] = {"dispatches": 0,
                                                "compiles": 0}
+        # profiling plane (core/obs/profiler): OPT-IN host/device wall
+        # split + per-round MFU at the dispatch seam. Off by default
+        # because it blocks on dispatch results, defeating the async
+        # dispatch overlap (and its FLOPs-model lowering would perturb
+        # the compile-once counters tests pin).
+        self._obs_profile = bool(getattr(args, "obs_profile_device",
+                                         False))
+        self._flops_per_round: Optional[float] = None
 
         # chaos: seeded fault injection (off by default). Availability
         # faults ride the round programs as DATA (per-slot work fractions
@@ -512,16 +522,60 @@ class TPUSimulator:
         donated — it is reused every round)."""
         return argnums if self._donate else ()
 
+    # dispatches that execute no client training: profiled for wall/wait
+    # but never converted to MFU (the FLOPs model is per training round)
+    _NON_TRAINING_DISPATCHES = frozenset({"server_update"})
+
+    def _ensure_flops_model(self, hyper) -> None:
+        """Profiling plane: lower the FLOPs model once per run (it is the
+        SAME ``round_cost_flops`` the bench reads, so MFU numbers stay
+        comparable across BENCH rounds). Only under ``obs_profile_device``
+        — the lowering compiles a throwaway program, which would otherwise
+        trip the compile-once regression counters."""
+        if self._obs_profile and self._flops_per_round is None:
+            self._flops_per_round = self.round_cost_flops(hyper)
+
     def _traced(self, name: str, n_rounds: int, fn, *args):
-        """Per-dispatch observability at the mlops seam: wall time of the
-        dispatch call (host-side cost; device work is async) plus the
-        process-wide XLA-compile delta it triggered — the recompile
-        counter that makes shape instability loud instead of silent."""
+        """Per-dispatch observability at the mlops seam: a ``dispatch``
+        span + wall time of the dispatch call (host-side cost; device
+        work is async) plus the process-wide XLA-compile delta it
+        triggered — the recompile counter that makes shape instability
+        loud instead of silent.
+
+        With ``obs_profile_device`` the dispatch additionally blocks on
+        its outputs to split wall time into host (enqueue) vs device-wait
+        (compute tail), wraps the call in a ``jax.profiler`` annotation,
+        and converts the FLOPs model into the per-round MFU gauge."""
         c0 = mlops.compile_count()
-        t0 = time.perf_counter()
-        out = fn(*args)
-        wall = time.perf_counter() - t0
+        with obs_trace.span("dispatch",
+                            attrs={"name": name,
+                                   "rounds": int(n_rounds)}) as sp:
+            t0 = time.perf_counter()
+            if self._obs_profile:
+                with obs_profiler.trace_annotation(name):
+                    out = fn(*args)
+            else:
+                out = fn(*args)
+            wall = time.perf_counter() - t0
+            wait = None
+            if self._obs_profile:
+                t1 = time.perf_counter()
+                jax.block_until_ready(out)
+                wait = time.perf_counter() - t1
+                sp.set_attr("device_wait_s", round(wait, 6))
         compiles = mlops.compile_count() - c0
+        if self._obs_profile:
+            # the FLOPs model describes a TRAINING round: dispatches that
+            # carry no training (the host-robust path's server_update is
+            # a millisecond aggregation) must not be credited a round's
+            # FLOPs — the resulting >1.0 MFU would overwrite the real
+            # per-round gauge every round
+            fpr = (self._flops_per_round
+                   if name not in self._NON_TRAINING_DISPATCHES else None)
+            obs_profiler.record_dispatch_profile(
+                name, n_rounds, wall, wait, fpr,
+                self.n_devices, compiles=compiles)
+            obs_profiler.sample_hbm_peak_gb()
         self.dispatch_stats["dispatches"] += 1
         self.dispatch_stats["compiles"] += compiles
         mlops.log_dispatch(name, wall, rounds=n_rounds, compiles=compiles)
@@ -1265,13 +1319,24 @@ class TPUSimulator:
             return 0.0
 
     def run_round(self, round_idx: int, hyper: TrainHyper) -> Dict[str, float]:
+        self._ensure_flops_model(hyper)
+        with obs_trace.span("round", root=True,
+                            attrs={"role": "engine",
+                                   "round_idx": int(round_idx)}):
+            return self._run_round_traced(round_idx, hyper)
+
+    def _run_round_traced(self, round_idx: int,
+                          hyper: TrainHyper) -> Dict[str, float]:
         pad_to = self._canonical_width() if self.robust_fused else None
-        sampled, (idx, active, work), faults = self._schedule_for(
-            round_idx, pad_to=pad_to)
-        self._ledger_round(round_idx, sampled, active, work, faults)
-        idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
-        active = jax.device_put(jnp.asarray(active), self.client_sharding)
-        work = jax.device_put(jnp.asarray(work), self.client_sharding)
+        with obs_trace.span("host.input",
+                            attrs={"round_idx": int(round_idx)}):
+            sampled, (idx, active, work), faults = self._schedule_for(
+                round_idx, pad_to=pad_to)
+            self._ledger_round(round_idx, sampled, active, work, faults)
+            idx = jax.device_put(jnp.asarray(idx), self.client_sharding)
+            active = jax.device_put(jnp.asarray(active),
+                                    self.client_sharding)
+            work = jax.device_put(jnp.asarray(work), self.client_sharding)
         round_key = jax.random.fold_in(self.rng, round_idx)
         hyper_r = hyper.replace(round_idx=jnp.int32(round_idx))
         placement = slot_placement(sampled, self.n_devices, self.cpd)
@@ -1419,6 +1484,32 @@ class TPUSimulator:
                 or (self.robust_fused and self.contribution.enabled):
             return [self.run_round(start_round + i, hyper)
                     for i in range(n_rounds)]
+        self._ensure_flops_model(hyper)
+        with obs_trace.span("block", root=True,
+                            attrs={"role": "engine",
+                                   "start_round": int(start_round),
+                                   "rounds": int(n_rounds)}):
+            return self._run_rounds_fused_traced(start_round, n_rounds,
+                                                 hyper)
+
+    def _run_rounds_fused_traced(self, start_round: int, n_rounds: int,
+                                 hyper: TrainHyper) -> List[Dict[str, float]]:
+        host_span = obs_trace.tracer.start_span(
+            "host.input", attrs={"start_round": int(start_round),
+                                 "rounds": int(n_rounds)})
+        try:
+            return self._run_rounds_fused_body(
+                start_round, n_rounds, hyper, host_span)
+        finally:
+            # schedule building can raise (device_put OOM, shape errors);
+            # the span must still flush so a failed run's log shows where
+            # the host time went. end() is idempotent — the success path
+            # already ended it right before dispatch.
+            host_span.end()
+
+    def _run_rounds_fused_body(self, start_round: int, n_rounds: int,
+                               hyper: TrainHyper,
+                               host_span) -> List[Dict[str, float]]:
         idxs, acts, works, keys, ridxs, rows_r, byz_r, ids_r = (
             [], [], [], [], [], [], [], [])
         sampled_r = []
@@ -1455,6 +1546,7 @@ class TPUSimulator:
         keys = jnp.stack(keys)
         ridxs = jnp.asarray(ridxs, jnp.int32)
         hyper_0 = hyper.replace(round_idx=jnp.int32(start_round))
+        host_span.end()  # host-side schedule building done; dispatch next
         if self.robust_fused:
             if not hasattr(self, "_robust_fused_fn"):
                 self._robust_fused_fn = self._build_robust_fused_fn()
@@ -1501,6 +1593,7 @@ class TPUSimulator:
         rounds = comm_round if comm_round is not None else int(args.comm_round)
         hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
                            epochs=int(args.epochs))
+        self._ensure_flops_model(hyper)
         t0 = time.time()
         start_round = 0
         restored = self._ckpt_latest()
@@ -1547,12 +1640,16 @@ class TPUSimulator:
                 rec["train_loss"] = float(metrics["loss_sum"]) / cnt
                 rec["train_acc"] = float(metrics["correct"]) / cnt
                 if freq > 0 and (r % freq == 0 or r == rounds - 1):
-                    stats = self._evaluate(self.params, self.fed.test["x"],
-                                           self.fed.test["y"],
-                                           self.fed.test["mask"])
-                    n = max(float(stats["count"]), 1.0)
-                    rec["test_acc"] = float(stats["correct"]) / n
-                    rec["test_loss"] = float(stats["loss_sum"]) / n
+                    with obs_trace.span("eval", root=True,
+                                        attrs={"role": "engine",
+                                               "round_idx": r}):
+                        stats = self._evaluate(self.params,
+                                               self.fed.test["x"],
+                                               self.fed.test["y"],
+                                               self.fed.test["mask"])
+                        n = max(float(stats["count"]), 1.0)
+                        rec["test_acc"] = float(stats["correct"]) / n
+                        rec["test_loss"] = float(stats["loss_sum"]) / n
                     logger.info("round %d: test_acc=%.4f", r,
                                 rec["test_acc"])
                 self.history.append(rec)
@@ -1561,7 +1658,10 @@ class TPUSimulator:
                     # stateful selection store flushes its device-array
                     # observation queue) — skip it when checkpointing is
                     # off rather than paying a readback per round
-                    self.ckpt.maybe_save(r, self._ckpt_state())
+                    with obs_trace.span("checkpoint", root=True,
+                                        attrs={"role": "engine",
+                                               "round_idx": r}):
+                        self.ckpt.maybe_save(r, self._ckpt_state())
                 mlops.log_round_info(rounds, r)
                 mlops.log({k: v for k, v in rec.items() if k != "round"},
                           step=r)
@@ -1578,6 +1678,10 @@ class TPUSimulator:
         # the next run's RoundCheckpointer is a different manager and
         # cannot wait on this one's pending writes
         self.ckpt.flush()
+        # final metrics snapshot: the cadence flush misses everything
+        # after its last boundary — the run log must be self-contained
+        from ...core.obs import metrics as _obs_metrics
+        _obs_metrics.flush_final(step=rounds - 1)
         wall = time.time() - t0
         last_eval = next((r for r in reversed(self.history) if "test_acc" in r),
                          None)

@@ -158,3 +158,66 @@ def test_cr_and_crlf_and_binary_lines(tmp_path, log_server):
     with open(path, "ab") as f:
         f.write(b"progress 10%\r")
     assert s.pump_once() == 1
+
+
+def test_stop_flushes_tail_before_first_poll_interval(tmp_path,
+                                                      log_server):
+    """The short-run satellite fix: a run that finishes inside the first
+    poll interval must not lose its tail — stop() guarantees the final
+    flush even when the loop thread never completed a cycle (and even
+    when it was never started at all)."""
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "w") as f:
+        f.write("only line\npartial tail")
+    # long interval: the loop thread will NOT have pumped before stop
+    s = LogShipper(path, url, interval_s=60.0).start()
+    s.stop()
+    lines = [ln for b in col.received for ln in b["log_lines"]]
+    # the complete line AND the newline-less tail both shipped
+    assert "only line" in lines and "partial tail" in lines
+    # never-started shipper: stop() still flushes
+    with open(path, "a") as f:
+        f.write(" grew\nfresh\n")
+    col.received.clear()
+    s2 = LogShipper(path, url)
+    s2.stop()
+    lines = [ln for b in col.received for ln in b["log_lines"]]
+    assert "fresh" in lines
+
+
+def test_final_flush_runs_exactly_once(tmp_path, log_server):
+    """stop() after the loop thread already flushed (and the atexit hook
+    after stop()) must not re-ship the tail — the flush is deduped."""
+    url, col = log_server
+    path = str(tmp_path / "job.log")
+    with open(path, "w") as f:
+        f.write("tail with no newline")
+    s = LogShipper(path, url, interval_s=0.05).start()
+    s.stop()          # loop thread flushes on the stop event; dedup here
+    s.stop()          # second stop: no double flush
+    s._atexit_stop()  # simulated interpreter exit after stop: no-op
+    lines = [ln for b in col.received for ln in b["log_lines"]]
+    assert lines.count("tail with no newline") == 1
+
+
+def test_atexit_hook_registered_and_unregistered(tmp_path, log_server):
+    """start() registers the interpreter-exit flush; stop() retires it
+    so a long-lived process doesn't accumulate dead hooks."""
+    import atexit
+    url, _ = log_server
+    path = str(tmp_path / "job.log")
+    open(path, "w").write("x\n")
+    registered = []
+    real_register = atexit.register
+    real_unregister = atexit.unregister
+    try:
+        atexit.register = lambda fn, *a, **k: registered.append(fn)
+        atexit.unregister = lambda fn: registered.remove(fn)
+        s = LogShipper(path, url, interval_s=60.0).start()
+        assert registered and registered[0].__name__ == "_atexit_stop"
+        s.stop()
+        assert not registered
+    finally:
+        atexit.register = real_register
+        atexit.unregister = real_unregister
