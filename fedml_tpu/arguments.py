@@ -182,6 +182,27 @@ _SCHEMA: Dict[str, Any] = {
     "comm_retry_base_s": 0.2,
     "comm_retry_max_s": 2.0,
     "comm_retry_deadline_s": 0.0,
+    # serving_args — LLM serving (serving/llm_template + serving/batch).
+    # Default `single` keeps the original one-request-at-a-time compiled
+    # full-forward loop; `batch` turns on continuous batching (paged KV
+    # cache, fixed [serving_slots] slot matrix, per-request multi-LoRA
+    # adapter selection from llm_adapter_dir).
+    "llm_serving_mode": "single",      # single | batch
+    "serving_slots": 8,                # in-flight decode slots [S]
+    "serving_kv_block_size": 16,       # KV-cache block (must divide
+                                       # llm_max_seq_len)
+    "serving_prefill_chunk": 32,       # chunked-prefill program width
+    "serving_max_adapters": 64,        # adapter-bank capacity [A]
+    "serving_deadline_s": 0.0,         # per-request decode deadline;
+                                       # past it the request is evicted
+                                       # with finish_reason: length (0=off)
+    "serving_request_timeout_s": 120.0,
+    "llm_adapter_dir": None,           # adapter-bank manifest dir to serve
+    # federated-LoRA adapter export: after run_federated_llm, write the
+    # global + per-silo personalized adapters as named artifacts the
+    # serving adapter bank loads (None = off)
+    "llm_adapter_export_dir": None,
+    "llm_adapter_personalize_steps": 4,
     # tracking_args
     "enable_wandb": False,
     "enable_tracking": True,     # master switch for the JSONL sink

@@ -391,6 +391,53 @@ def record_round_mfu(mfu: float, tflops: Optional[float] = None) -> None:
                        "achieved TFLOP/s over the round").set(float(tflops))
 
 
+def record_llm_serving_step(tokens_out: int, occupancy: int,
+                            queue_depth: int, tokens_per_s: float) -> None:
+    """Continuous-batching decode seam (serving/batch): per-step slot
+    occupancy + queue depth histograms and the decode-throughput gauge."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.gauge("llm_tokens_per_s",
+                   "decode throughput over the engine's rolling window "
+                   "(generated tokens/sec)").set(float(tokens_per_s))
+    REGISTRY.histogram("llm_slot_occupancy",
+                       "in-flight requests per decode step",
+                       buckets=OCCUPANCY_BUCKETS).observe(int(occupancy))
+    REGISTRY.histogram("llm_queue_depth",
+                       "requests waiting for a slot at each decode step",
+                       buckets=OCCUPANCY_BUCKETS).observe(int(queue_depth))
+    REGISTRY.counter("llm_tokens_generated_total",
+                     "tokens emitted by the batched decode "
+                     "step").inc(int(tokens_out))
+
+
+def record_llm_admit(n: int = 1) -> None:
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_requests_admitted_total",
+                     "requests admitted into decode slots").inc(int(n))
+
+
+def record_llm_evict(reason: str) -> None:
+    """Eviction seam: deadline evictions vs queued-request expiry."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_requests_evicted_total",
+                     "requests evicted before natural finish",
+                     labels=("reason",)).inc(1, reason=str(reason))
+
+
+def record_gateway_latency(latency_s: float) -> None:
+    """Serving gateway seam: per-request end-to-end latency histogram
+    (the exact p50/p99 the autoscaler reads comes from the gateway's
+    trailing window; this is the exposition/post-mortem view)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("serving_gateway_latency_seconds",
+                       "gateway request latency",
+                       buckets=LATENCY_BUCKETS).observe(float(latency_s))
+
+
 _flush_state = {"last": None}
 
 

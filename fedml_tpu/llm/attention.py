@@ -83,6 +83,36 @@ def dense_causal_attention(q, k, v, attn_mask=None):
     return out.astype(q.dtype)
 
 
+def cached_attention(q, k_all, v_all, q_positions):
+    """Decode/prefill attention over a position-ordered cached K/V view.
+
+    q: [b, s, h, d] (s = 1 for decode, chunk length for prefill);
+    k_all/v_all: [b, T, h, d] — the slot's gathered cache view with the
+    current tokens already written at their logical positions;
+    q_positions: [b, s] absolute positions of the query rows.
+
+    The live mask is ``key_index <= q_position``: the view is position-
+    ordered, every position <= q_pos holds a genuinely written key, and
+    everything after is masked to NEG_INF (exact-zero probability). The
+    math mirrors :func:`dense_causal_attention` term for term — f32
+    scores, NEG_INF masking, softmax over a T-long key axis — so a decode
+    step over a ``T == max_seq_len`` view is bit-compatible with the
+    full-forward step on the padded ``[1, max_seq_len]`` buffer (masked
+    positions contribute exact 0.0 in both).
+    """
+    _, _, _, d = q.shape
+    t = k_all.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_all.astype(jnp.float32)) * scale
+    key_idx = jnp.arange(t, dtype=jnp.int32)
+    live = key_idx[None, None, None, :] <= q_positions[:, None, :, None]
+    scores = jnp.where(live, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_all.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 # ---------------------------------------------------------------- flash ----
 # FlashAttention-2 style: the forward saves only (O, LSE); both backward
 # kernels recompute P = exp(QK^T·scale − LSE) blockwise in VMEM, so neither

@@ -112,10 +112,154 @@ def build_llm(args) -> Tuple[Any, LLMBundle, CausalLMTrainer, ByteTokenizer]:
 
 def run_federated_llm(args) -> dict:
     """Run a federated LoRA fine-tune with the standard runner dispatch
-    (simulation backend or cross-silo per ``args.training_type``)."""
+    (simulation backend or cross-silo per ``args.training_type``).
+    ``llm_adapter_export_dir`` additionally writes the global + per-silo
+    personalized adapters as named artifacts the serving adapter bank
+    (``serving/batch/``) loads."""
     from ..runner import FedMLRunner
 
     fed, bundle, spec, _ = build_llm(args)
+    export_dir = getattr(args, "llm_adapter_export_dir", None)
+    if export_dir and int(getattr(args, "lora_rank", 8)) <= 0:
+        # fail BEFORE the (possibly hours-long) run, not after it
+        raise ValueError("llm_adapter_export_dir needs lora_rank > 0 "
+                         "(the adapter bank serves adapters over a "
+                         "frozen base)")
     runner = FedMLRunner(args, dataset=fed, model=bundle,
                          client_trainer=spec)
-    return runner.run()
+    result = runner.run()
+    export_dir = getattr(args, "llm_adapter_export_dir", None)
+    if export_dir and isinstance(result, dict) and "params" in result:
+        export_silo_adapters(args, export_dir, result=result,
+                             prebuilt=(fed, bundle, spec))
+    return result
+
+
+# --- adapter-bank artifacts -------------------------------------------------
+# The serving side of the federated-personalization loop: named LoRA
+# adapter trees (kilobytes each) written with the msgpack artifact codec,
+# plus a manifest the AdapterBank loads. One gateway then serves every
+# silo's personalization side by side over a shared base model.
+
+_MANIFEST = "manifest.json"
+
+
+def _safe_name(name: str) -> str:
+    import re
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(name))
+    if not safe:
+        raise ValueError(f"adapter name {name!r} is empty after "
+                         "sanitization")
+    return safe
+
+
+def save_adapter_artifacts(adapters, out_dir: str, *,
+                           lora_rank: Optional[int] = None,
+                           lora_alpha: Optional[float] = None) -> str:
+    """Write ``{name: adapter_tree}`` as one msgpack artifact per adapter
+    plus ``manifest.json``; returns the manifest path."""
+    import json
+    import os
+
+    from ..serving import save_model
+
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {"format": "fedml_tpu_adapter_bank_v1", "adapters": {}}
+    if lora_rank is not None:
+        manifest["lora_rank"] = int(lora_rank)
+    if lora_alpha is not None:
+        manifest["lora_alpha"] = float(lora_alpha)
+    for name, tree in adapters.items():
+        fname = _safe_name(name) + ".fmtpu"
+        save_model(tree, os.path.join(out_dir, fname))
+        manifest["adapters"][str(name)] = fname
+    path = os.path.join(out_dir, _MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+    os.replace(tmp, path)
+    logger.info("adapter artifacts: %d adapters -> %s",
+                len(manifest["adapters"]), out_dir)
+    return path
+
+
+def load_adapter_artifacts(manifest_dir: str) -> dict:
+    """Manifest dir → ``{name: adapter_tree}`` (msgpack artifacts only —
+    same trust story as every served model)."""
+    import json
+    import os
+
+    from ..serving import load_model
+
+    with open(os.path.join(manifest_dir, _MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "fedml_tpu_adapter_bank_v1":
+        raise ValueError(f"{manifest_dir}: not an adapter-bank manifest")
+    return {name: load_model(os.path.join(manifest_dir, fname))
+            for name, fname in manifest["adapters"].items()}
+
+
+def personalize_adapter(spec, global_adapter, silo_data, *,
+                        learning_rate: float = 1e-3, steps: int = 4,
+                        step_fn=None):
+    """A few local SGD steps from the global adapter over one silo's
+    batches — the cheap per-silo personalization pass whose output the
+    adapter bank serves. ``silo_data``: ``{"x": [nb, bs, L], "y", "mask"}``
+    numpy/jnp arrays. Returns ``(adapter, step_fn)`` so callers
+    personalizing many silos reuse the compiled step."""
+    import optax
+
+    opt = optax.sgd(float(learning_rate))
+    if step_fn is None:
+        def _step(params, opt_state, batch):
+            grads, _ = jax.grad(spec.loss, has_aux=True)(params, batch,
+                                                         None)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+        step_fn = jax.jit(_step)
+    params = global_adapter
+    opt_state = opt.init(params)
+    n_batches = int(silo_data["x"].shape[0])
+    for s in range(int(steps)):
+        j = s % n_batches
+        batch = {"x": jnp.asarray(silo_data["x"][j]),
+                 "y": jnp.asarray(silo_data["y"][j]),
+                 "mask": jnp.asarray(silo_data["mask"][j])}
+        params, opt_state = step_fn(params, opt_state, batch)
+    return params, step_fn
+
+
+def export_silo_adapters(args, out_dir: str, result: Optional[dict] = None,
+                         prebuilt=None) -> str:
+    """Federated LoRA → a served adapter bank: run (or reuse) the
+    federated fine-tune, personalize the global adapter per silo with a
+    few local steps on that silo's shard, and write ``global`` +
+    ``silo_<i>`` named artifacts. Returns the manifest path."""
+    if prebuilt is not None:
+        fed, bundle, spec = prebuilt
+    else:
+        fed, bundle, spec, _ = build_llm(args)
+    if int(getattr(args, "lora_rank", 8)) <= 0:
+        raise ValueError("adapter export needs lora_rank > 0 (the bank "
+                         "serves adapters over a frozen base)")
+    if result is None:
+        from ..runner import FedMLRunner
+        result = FedMLRunner(args, dataset=fed, model=bundle,
+                             client_trainer=spec).run()
+    global_adapter = result["params"]
+    adapters = {"global": global_adapter}
+    steps = int(getattr(args, "llm_adapter_personalize_steps", 4))
+    step_fn = None
+    import numpy as np
+    for i in range(fed.num_clients):
+        silo = {"x": np.asarray(fed.train.x[i]),
+                "y": np.asarray(fed.train.y[i]),
+                "mask": np.asarray(fed.train.mask[i])}
+        adapters[f"silo_{i}"], step_fn = personalize_adapter(
+            spec, global_adapter, silo,
+            learning_rate=float(getattr(args, "learning_rate", 1e-3)),
+            steps=steps, step_fn=step_fn)
+    return save_adapter_artifacts(
+        adapters, out_dir,
+        lora_rank=int(getattr(args, "lora_rank", 8)),
+        lora_alpha=float(getattr(args, "lora_alpha", 16.0)))

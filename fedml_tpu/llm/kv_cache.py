@@ -1,0 +1,155 @@
+"""Paged (block-allocated) KV cache for continuous-batching decode.
+
+The serving template's original decode loop re-ran the FULL forward over a
+padded ``[1, max_seq_len]`` buffer for every token — O(s) attention work
+per emitted token and one request at a time. This module gives the decode
+step a vLLM-style paged cache (Kwon et al. 2023) so each step is
+one-token work per slot and S requests share one compiled program:
+
+* the physical cache is a fixed pool of ``num_blocks`` blocks of
+  ``block_size`` key/value rows per layer (one trailing TRASH block
+  absorbs writes from inactive slots and padded prefill rows, so the
+  jitted step never branches on occupancy);
+* each slot owns a **block table** ``[max_blocks]`` of physical block ids
+  mapping logical position ``p`` to ``table[p // block_size]`` — tables,
+  positions, and occupancy are DATA, so admit/evict never recompiles;
+* the jitted decode/prefill programs *gather* a slot's blocks into a
+  position-ordered dense view ``[T = max_seq_len]`` (bit-compatible with
+  the full-forward attention: same key-axis length, masked tail
+  contributes exact zeros) and *scatter* the step's new K/V rows back into
+  the pool at ``(table[p // bs], p % bs)``.
+
+Block allocation/free is host-side bookkeeping (a free list); admission
+reserves the request's worst-case block count up front so decode can never
+hit out-of-memory mid-stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static geometry of the paged pool (baked into the compiled step)."""
+
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    max_seq_len: int
+    block_size: int = 16
+    num_blocks: int = 256   # physical pool, shared across slots
+
+    def __post_init__(self):
+        if self.max_seq_len % self.block_size:
+            raise ValueError(
+                f"block_size {self.block_size} must divide max_seq_len "
+                f"{self.max_seq_len}: the gathered view must be exactly "
+                "max_seq_len keys for full-forward bit-compatibility")
+
+    @property
+    def max_blocks_per_slot(self) -> int:
+        return self.max_seq_len // self.block_size
+
+    @property
+    def trash_block(self) -> int:
+        """Sacrificial physical block: writes from inactive slots and
+        padded prefill rows land here; unallocated table entries read it."""
+        return self.num_blocks
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+
+def init_pools(cfg: KVCacheConfig, dtype=jnp.float32
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Zeroed K and V pools ``[L, num_blocks + 1, block_size, H, D]``
+    (the +1 is the trash block)."""
+    shape = (cfg.num_layers, cfg.num_blocks + 1, cfg.block_size,
+             cfg.kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def gather_view(pool_layer: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """``[NB+1, bs, H, D]`` pool + ``[..., max_blocks]`` tables →
+    position-ordered dense view ``[..., T, H, D]`` (T = max_blocks * bs).
+    Pure; safe under jit — tables are data."""
+    v = pool_layer[tables]                      # [..., max_blocks, bs, H, D]
+    shape = v.shape[:-4] + (v.shape[-4] * v.shape[-3],) + v.shape[-2:]
+    return v.reshape(shape)
+
+
+def scatter_token(pool_layer: jnp.ndarray, tables: jnp.ndarray,
+                  positions: jnp.ndarray, values: jnp.ndarray,
+                  active: jnp.ndarray, block_size: int,
+                  trash_block: int) -> jnp.ndarray:
+    """Write one new K or V row per slot at its logical position.
+
+    pool_layer ``[NB+1, bs, H, D]``; tables ``[S, max_blocks]``; positions
+    ``[S]``; values ``[S, H, D]``; active ``[S]`` bool. Inactive slots'
+    writes are routed to the trash block. Active slots own disjoint blocks,
+    so the scatter has no cross-slot conflicts.
+    """
+    s = tables.shape[0]
+    pos = jnp.clip(positions, 0, tables.shape[1] * block_size - 1)
+    blk = tables[jnp.arange(s), pos // block_size]
+    blk = jnp.where(active, blk, trash_block)
+    return pool_layer.at[blk, pos % block_size].set(values)
+
+
+def scatter_chunk(pool_layer: jnp.ndarray, table_row: jnp.ndarray,
+                  positions: jnp.ndarray, values: jnp.ndarray,
+                  valid: jnp.ndarray, block_size: int,
+                  trash_block: int) -> jnp.ndarray:
+    """Write a prefill chunk's K or V rows for ONE slot.
+
+    table_row ``[max_blocks]``; positions ``[C]`` (logical); values
+    ``[C, H, D]``; valid ``[C]`` bool (padded chunk tail → trash)."""
+    pos = jnp.clip(positions, 0, table_row.shape[0] * block_size - 1)
+    blk = jnp.where(valid, table_row[pos // block_size], trash_block)
+    return pool_layer.at[blk, pos % block_size].set(values)
+
+
+class BlockAllocator:
+    """Host-side free-list over the physical pool. Admission reserves the
+    request's worst-case block count up front (prompt + max_new_tokens,
+    clamped to max_seq_len), so a decoding slot can never fail to grow."""
+
+    def __init__(self, cfg: KVCacheConfig):
+        self.cfg = cfg
+        self._free: List[int] = list(range(cfg.num_blocks))
+        self._owned: dict = {}   # slot -> list of physical block ids
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n_tokens: int) -> bool:
+        return self.cfg.blocks_needed(n_tokens) <= len(self._free)
+
+    def alloc(self, slot: int, n_tokens: int) -> np.ndarray:
+        """Reserve blocks for ``n_tokens`` positions; returns the slot's
+        table row ``[max_blocks_per_slot]`` (unused entries = trash)."""
+        need = self.cfg.blocks_needed(n_tokens)
+        if need > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: need {need} blocks, "
+                f"{len(self._free)} free")
+        if slot in self._owned:
+            raise RuntimeError(f"slot {slot} already holds blocks")
+        blocks = [self._free.pop() for _ in range(need)]
+        self._owned[slot] = blocks
+        row = np.full((self.cfg.max_blocks_per_slot,),
+                      self.cfg.trash_block, np.int32)
+        row[:need] = blocks
+        return row
+
+    def free(self, slot: int) -> None:
+        for b in self._owned.pop(slot, []):
+            self._free.append(b)

@@ -77,6 +77,34 @@ def lora_merge(params: PyTree, lora: PyTree, alpha: float = 16.0) -> PyTree:
     return traverse_util.unflatten_dict(flat)
 
 
+def lora_zero_like(lora: PyTree) -> PyTree:
+    """An all-zero adapter with ``lora``'s structure: zero ``lora_b``
+    already means zero effect, but zeroing ``lora_a`` too makes the
+    identity adapter content-independent — the bank's 'serve the base
+    model' row."""
+    return jax.tree_util.tree_map(jnp.zeros_like, lora)
+
+
+def lora_stack(adapters: Sequence[PyTree]) -> PyTree:
+    """Stack N structurally-identical adapter trees into ONE pytree whose
+    leaves carry a leading ``[A]`` axis — the resident multi-LoRA bank a
+    batched serving step gathers from (S-LoRA, Sheng et al. 2023).
+    Structures must match exactly (same targets, same rank)."""
+    if not adapters:
+        raise ValueError("lora_stack needs >= 1 adapter")
+    return jax.tree_util.tree_map(
+        lambda *ls: jnp.stack([jnp.asarray(l, jnp.float32) for l in ls]),
+        *adapters)
+
+
+def lora_select(stack: PyTree, idx) -> PyTree:
+    """Gather per-slot adapters out of a stacked bank: every ``[A, ...]``
+    leaf becomes ``[S, ...]`` (or ``[...]`` for a scalar ``idx``). Pure
+    gather — safe inside jit with ``idx`` as data, which is what keeps the
+    decode step compile-once across any adapter mix."""
+    return jax.tree_util.tree_map(lambda l: l[idx], stack)
+
+
 def lora_param_count(lora: PyTree) -> int:
     return int(sum(np.prod(p.shape)
                    for p in jax.tree_util.tree_leaves(lora)))

@@ -101,83 +101,173 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
+def _lora_delta(x: jnp.ndarray, pair, scale: float) -> jnp.ndarray:
+    """Low-rank side path ``(x @ a) @ b * scale`` (the S-LoRA batched
+    apply: adapters stay factored instead of being merged into W, so a
+    per-slot adapter gather is two small einsums, not a weight copy).
+
+    ``pair = {"lora_a", "lora_b"}`` with leaves either shared
+    ``[d_in, r]`` / ``[r, d_out]`` or per-slot ``[b, d_in, r]`` /
+    ``[b, r, d_out]`` (gathered from a stacked adapter bank)."""
+    a, bb = pair["lora_a"], pair["lora_b"]
+    xf = x.astype(jnp.float32)
+    if a.ndim == 3:   # per-slot adapters
+        h = jnp.einsum("bsd,bdr->bsr", xf, a)
+        return jnp.einsum("bsr,bro->bso", h, bb) * scale
+    return ((xf @ a) @ bb) * scale
+
+
 class Attention(nn.Module):
     cfg: LLMConfig
 
     @nn.compact
-    def __call__(self, x, positions, attn_mask=None):
+    def __call__(self, x, positions, attn_mask=None, kv_view=None,
+                 adapter=None, lora_scale: float = 1.0):
+        """Default path (``kv_view=None``): full causal self-attention,
+        returns ``(out, None)``. Cache path: ``kv_view = (k_all, v_all)``
+        position-ordered dense views ``[b, T, kv_heads, head_dim]`` of the
+        slot's cached keys/values; the current tokens' K/V are written
+        into the view at ``positions`` before attending, and returned as
+        ``(out, (k_cur, v_cur))`` for the caller to scatter into the
+        paged pool. ``adapter``: optional ``{q,k,v,o: {lora_a, lora_b}}``
+        low-rank side paths (per-slot when leaves carry a leading batch
+        axis)."""
         cfg = self.cfg
         b, s, _ = x.shape
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, name=name,
             dtype=cfg.compute_dtype, param_dtype=jnp.float32)
-        q = dense((cfg.num_heads, cfg.head_dim), "q")(x)
-        k = dense((cfg.kv_heads, cfg.head_dim), "k")(x)
-        v = dense((cfg.kv_heads, cfg.head_dim), "v")(x)
+
+        def proj(name, feats):
+            y = dense(feats, name)(x)
+            if adapter is not None and name in adapter:
+                delta = _lora_delta(x, adapter[name], lora_scale)
+                y = y + delta.reshape(y.shape).astype(y.dtype)
+            return y
+
+        q = proj("q", (cfg.num_heads, cfg.head_dim))
+        k = proj("k", (cfg.kv_heads, cfg.head_dim))
+        v = proj("v", (cfg.kv_heads, cfg.head_dim))
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        if cfg.kv_heads != cfg.num_heads:
-            rep = cfg.num_heads // cfg.kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
 
-        from .attention import causal_attention
-        out = causal_attention(q, k, v, impl=cfg.attention_impl,
-                               attn_mask=attn_mask)
+        from .attention import cached_attention, causal_attention
+        if kv_view is not None:
+            k_all, v_all = kv_view
+            new_kv = (k, v)
+            # write the current tokens into the gathered view at their
+            # logical positions (out-of-range sentinel positions — padded
+            # prefill rows, inactive slots — are dropped)
+            bidx = jnp.arange(b)[:, None]
+            k_all = k_all.at[bidx, positions].set(k, mode="drop")
+            v_all = v_all.at[bidx, positions].set(v, mode="drop")
+            if cfg.kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.kv_heads
+                k_all = jnp.repeat(k_all, rep, axis=2)
+                v_all = jnp.repeat(v_all, rep, axis=2)
+            out = cached_attention(q, k_all, v_all, positions)
+        else:
+            new_kv = None
+            if cfg.kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            out = causal_attention(q, k, v, impl=cfg.attention_impl,
+                                   attn_mask=attn_mask)
         out = out.reshape(b, s, cfg.num_heads * cfg.head_dim)
-        return nn.DenseGeneral(cfg.hidden_size, use_bias=False, name="o",
-                               dtype=cfg.compute_dtype,
-                               param_dtype=jnp.float32)(out)
+        y = nn.DenseGeneral(cfg.hidden_size, use_bias=False, name="o",
+                            dtype=cfg.compute_dtype,
+                            param_dtype=jnp.float32)(out)
+        if adapter is not None and "o" in adapter:
+            y = y + _lora_delta(out, adapter["o"],
+                                lora_scale).reshape(y.shape).astype(y.dtype)
+        return y, new_kv
 
 
 class MLP(nn.Module):
     cfg: LLMConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter=None, lora_scale: float = 1.0):
         cfg = self.cfg
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
             feats, use_bias=False, name=name, dtype=cfg.compute_dtype,
             param_dtype=jnp.float32)
-        gate = dense(cfg.intermediate_size, "gate")(x)
-        up = dense(cfg.intermediate_size, "up")(x)
-        return dense(cfg.hidden_size, "down")(nn.silu(gate) * up)
+
+        def proj(name, feats, inp):
+            y = dense(feats, name)(inp)
+            if adapter is not None and name in adapter:
+                delta = _lora_delta(inp, adapter[name], lora_scale)
+                y = y + delta.reshape(y.shape).astype(y.dtype)
+            return y
+
+        gate = proj("gate", cfg.intermediate_size, x)
+        up = proj("up", cfg.intermediate_size, x)
+        return proj("down", cfg.hidden_size, nn.silu(gate) * up)
 
 
 class DecoderLayer(nn.Module):
     cfg: LLMConfig
 
     @nn.compact
-    def __call__(self, x, positions, attn_mask=None):
-        h = x + Attention(self.cfg, name="attn")(
+    def __call__(self, x, positions, attn_mask=None, kv_view=None,
+                 adapter=None, lora_scale: float = 1.0):
+        attn = adapter.get("attn") if adapter is not None else None
+        mlp = adapter.get("mlp") if adapter is not None else None
+        a_out, new_kv = Attention(self.cfg, name="attn")(
             RMSNorm(self.cfg.rms_eps, name="ln_attn")(x), positions,
-            attn_mask)
-        return h + MLP(self.cfg, name="mlp")(
-            RMSNorm(self.cfg.rms_eps, name="ln_mlp")(h))
+            attn_mask, kv_view=kv_view, adapter=attn,
+            lora_scale=lora_scale)
+        h = x + a_out
+        h = h + MLP(self.cfg, name="mlp")(
+            RMSNorm(self.cfg.rms_eps, name="ln_mlp")(h), adapter=mlp,
+            lora_scale=lora_scale)
+        return h, new_kv
 
 
 class CausalLM(nn.Module):
-    """Decoder-only LM. ``__call__(tokens [b, s]) -> logits [b, s, vocab]``."""
+    """Decoder-only LM. ``__call__(tokens [b, s]) -> logits [b, s, vocab]``.
+
+    Cache-aware path (continuous-batching serving): pass ``positions``
+    ([b, s] absolute positions; out-of-range values mark padded/inactive
+    rows whose cache writes are dropped) and ``kv_view`` (per-layer
+    ``(k_all, v_all)`` gathered cache views) — returns
+    ``(logits, [(k_cur, v_cur), ...])`` so the caller can scatter the new
+    rows into its paged pool. ``adapters``: a LoRA tree shaped like
+    :func:`~fedml_tpu.llm.lora.lora_init`'s output, optionally with a
+    leading per-slot batch axis on every leaf (gathered from a stacked
+    adapter bank) — applied as factored side paths, never merged."""
 
     cfg: LLMConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, attn_mask=None):
+    def __call__(self, tokens, train: bool = False, attn_mask=None,
+                 positions=None, kv_view=None, adapters=None,
+                 lora_scale: float = 1.0):
         cfg = self.cfg
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed",
                        dtype=cfg.compute_dtype, param_dtype=jnp.float32)
         x = emb(tokens)
-        pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
-        if cfg.attention_impl == "ring":
-            # sequence is sharded over the ring axis: offset to global
-            # positions so RoPE and the causal mask stay correct per shard
-            from .attention import _RING_AXIS
-            ax = _RING_AXIS.get()
-            if ax is not None:
-                pos = pos + jax.lax.axis_index(ax[0]) * tokens.shape[1]
-        positions = jnp.broadcast_to(pos[None, :], tokens.shape)
+        if positions is None:
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            if cfg.attention_impl == "ring":
+                # sequence is sharded over the ring axis: offset to global
+                # positions so RoPE and the causal mask stay correct per
+                # shard
+                from .attention import _RING_AXIS
+                ax = _RING_AXIS.get()
+                if ax is not None:
+                    pos = pos + jax.lax.axis_index(ax[0]) * tokens.shape[1]
+            positions = jnp.broadcast_to(pos[None, :], tokens.shape)
+        new_kvs = []
         for i in range(cfg.num_layers):
-            x = DecoderLayer(cfg, name=f"layer_{i}")(x, positions, attn_mask)
+            x, new_kv = DecoderLayer(cfg, name=f"layer_{i}")(
+                x, positions, attn_mask,
+                kv_view=None if kv_view is None else kv_view[i],
+                adapter=None if adapters is None
+                else adapters.get(f"layer_{i}"),
+                lora_scale=lora_scale)
+            new_kvs.append(new_kv)
         x = RMSNorm(cfg.rms_eps, name="ln_f")(x)
         if cfg.tie_embeddings:
             logits = emb.attend(x)
@@ -185,7 +275,10 @@ class CausalLM(nn.Module):
             logits = nn.DenseGeneral(cfg.vocab_size, use_bias=False,
                                      name="lm_head", dtype=cfg.compute_dtype,
                                      param_dtype=jnp.float32)(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if kv_view is not None:
+            return logits, new_kvs
+        return logits
 
 
 def init_llm(cfg: LLMConfig, rng: jax.Array) -> Tuple[CausalLM, PyTree]:

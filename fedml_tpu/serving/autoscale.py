@@ -48,8 +48,12 @@ class EWMPolicy:
 class ConcurrencyPolicy:
     """Little's-law concurrency policy (reference ``ConcurrentQueryPolicy``
     :135): in-flight = qps x latency; one replica sustains
-    ``target_concurrency``."""
+    ``target_concurrency``. ``latency_signal`` picks which latency the
+    autoscaler feeds in — ``"p99"`` makes in-flight a tail estimate, so
+    the fleet sizes for the slow requests batching directly shapes, not
+    the mean the fast ones dominate."""
     target_concurrency: float = 4.0
+    latency_signal: str = "mean"    # mean | p50 | p99
 
     def desired_replicas(self, qps: float, latency_s: float,
                          current: int) -> int:
@@ -60,9 +64,14 @@ class ConcurrencyPolicy:
 @dataclasses.dataclass
 class LookbackPolicy:
     """Scale on the max QPS seen in a trailing window (reference
-    ``MeetTrafficDemandPolicy`` :186 shape): headroom for bursts."""
+    ``MeetTrafficDemandPolicy`` :186 shape): headroom for bursts.
+    ``max_latency_s`` adds a tail-latency guard on ``latency_signal``
+    (default p99): while the observed tail exceeds it, demand-based
+    sizing is overridden upward by one replica per step."""
     target_qps_per_replica: float = 10.0
     window: int = 10
+    max_latency_s: float = 0.0      # 0 = QPS-only (original behavior)
+    latency_signal: str = "p99"
     _hist: Deque[float] = dataclasses.field(default_factory=deque)
 
     def desired_replicas(self, qps: float, latency_s: float,
@@ -71,7 +80,10 @@ class LookbackPolicy:
         while len(self._hist) > self.window:
             self._hist.popleft()
         peak = max(self._hist)
-        return max(1, math.ceil(peak / self.target_qps_per_replica))
+        desired = max(1, math.ceil(peak / self.target_qps_per_replica))
+        if self.max_latency_s > 0 and latency_s > self.max_latency_s:
+            desired = max(desired, current + 1)
+        return desired
 
 
 # ---------------------------------------------------------- replica set ----
@@ -323,9 +335,32 @@ class ReplicaSet:
 
 # -------------------------------------------------------------- gateway ----
 
+@dataclasses.dataclass
+class GatewayMetrics:
+    """Trailing-window request metrics. Iterates as the legacy
+    ``(qps, mean_latency)`` pair so existing unpacking call sites keep
+    working; ``p50``/``p99`` carry the tail the autoscaler policies can
+    target."""
+    qps: float
+    latency_s: float     # mean
+    p50: float
+    p99: float
+    count: int
+
+    def __iter__(self):
+        return iter((self.qps, self.latency_s))
+
+    def signal(self, name: str) -> float:
+        return {"mean": self.latency_s, "p50": self.p50,
+                "p99": self.p99}[str(name)]
+
+
 class Gateway:
     """Round-robin HTTP front over a ReplicaSet that records the
-    QPS/latency series policies consume (reference inference gateway)."""
+    QPS/latency series policies consume (reference inference gateway).
+    Every request's latency also lands in the ``core/obs`` metrics
+    registry (``serving_gateway_latency_seconds``), so the Prometheus
+    exposition and JSONL snapshots carry the serving tail."""
 
     def __init__(self, replica_set: ReplicaSet, window_s: float = 5.0):
         self.replica_set = replica_set
@@ -367,6 +402,8 @@ class Gateway:
                         or not isinstance(reason, ConnectionError)):
                     raise
         dt = time.perf_counter() - t0
+        from ..core.obs import metrics as obs_metrics
+        obs_metrics.record_gateway_latency(dt)
         now = time.time()
         with self._lock:
             self._events.append((now, dt))
@@ -375,16 +412,27 @@ class Gateway:
                 self._events.popleft()
         return out
 
-    def metrics(self) -> Tuple[float, float]:
-        """(qps, mean latency seconds) over the trailing window."""
+    def metrics(self) -> GatewayMetrics:
+        """Trailing-window :class:`GatewayMetrics` — qps, mean latency,
+        and exact p50/p99 over the recorded events (computed from the
+        raw window, not histogram buckets, so the tail the autoscaler
+        reacts to is not bucket-quantized). Unpacks as the legacy
+        ``(qps, mean)`` pair."""
         now = time.time()
         with self._lock:
             cutoff = now - self.window_s
             while self._events and self._events[0][0] < cutoff:
                 self._events.popleft()
-            n = len(self._events)
-            lat = (sum(l for _, l in self._events) / n) if n else 0.0
-        return n / self.window_s, lat
+            lats = sorted(l for _, l in self._events)
+        n = len(lats)
+        if n:
+            mean = sum(lats) / n
+            p50 = lats[min(n - 1, int(0.50 * (n - 1) + 0.5))]
+            p99 = lats[min(n - 1, int(0.99 * (n - 1) + 0.5))]
+        else:
+            mean = p50 = p99 = 0.0
+        return GatewayMetrics(qps=n / self.window_s, latency_s=mean,
+                              p50=p50, p99=p99, count=n)
 
 
 # ------------------------------------------------------------ autoscaler ----
@@ -402,11 +450,14 @@ class Autoscaler:
     def step(self) -> int:
         """One evaluation: heal -> metrics -> desired -> scale. Returns the
         new replica count (also usable directly, without the daemon
-        thread)."""
+        thread). Policies declaring a ``latency_signal`` ("mean" | "p50" |
+        "p99") are fed that percentile from the gateway window — tail-
+        latency-targeting autoscaling."""
         self.gateway.replica_set.health_check()
-        qps, lat = self.gateway.metrics()
+        m = self.gateway.metrics()
+        lat = m.signal(getattr(self.policy, "latency_signal", "mean"))
         desired = self.policy.desired_replicas(
-            qps, lat, len(self.gateway.replica_set))
+            m.qps, lat, len(self.gateway.replica_set))
         return self.gateway.replica_set.scale_to(desired)
 
     def start(self) -> None:

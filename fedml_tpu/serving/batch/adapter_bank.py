@@ -1,0 +1,154 @@
+"""Multi-LoRA adapter bank: named per-user/per-silo adapters resident as
+ONE stacked pytree the jitted decode step gathers from.
+
+The federated-personalization loop this closes: ``llm/federated.py``
+produces per-silo LoRA adapter artifacts (kilobytes each); the bank loads
+them side by side over one frozen base model, and every request selects
+its adapter by name — the selection becomes a per-slot integer index, the
+gather happens inside the compiled step, and serving a new silo's users
+costs one bank row, not a model replica (S-LoRA's economics).
+
+The stack is CAPACITY-padded: leaves are ``[capacity, ...]`` from
+construction, so registering adapter #2 through #capacity never changes
+the compiled step's input shapes (compile-once holds across bank growth).
+Index 0 is always the zero adapter — requests with no adapter get the
+base model exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+PyTree = Any
+logger = logging.getLogger(__name__)
+
+BASE_ADAPTER = "base"
+
+
+class AdapterBank:
+    """Named LoRA adapters over one base model.
+
+    ``template``: any adapter tree with the served model's LoRA structure
+    (``lora_init`` output or a loaded artifact) — defines the leaf shapes;
+    its values are NOT registered. ``capacity``: maximum adapters
+    (including the reserved zero adapter at index 0)."""
+
+    def __init__(self, template: PyTree, alpha: float = 16.0,
+                 capacity: int = 64):
+        import jax.numpy as jnp
+
+        leaves, self._treedef = jax.tree_util.tree_flatten(template)
+        if not leaves:
+            raise ValueError("adapter template has no leaves")
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.alpha = float(alpha)
+        # rank from any lora_a leaf: [d_in, r]
+        self.rank = int(leaves[0].shape[-1] if leaves[0].ndim == 2 else 0)
+        self._lock = threading.Lock()
+        self._names: Dict[str, int] = {BASE_ADAPTER: 0}
+        # host mirror [capacity, ...] per leaf; row 0 stays zero
+        self._host: List[np.ndarray] = [
+            np.zeros((self.capacity,) + tuple(l.shape), np.float32)
+            for l in leaves]
+        self._stack = None   # lazily device-put pytree
+        self._jnp = jnp
+
+    @property
+    def scale(self) -> float:
+        """The merged path's ``alpha / rank`` factor."""
+        r = max(self.rank, 1)
+        return self.alpha / r
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._names)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._names, key=self._names.get)
+
+    def add(self, name: str, adapter: PyTree) -> int:
+        """Register (or replace) a named adapter; returns its index."""
+        leaves = jax.tree_util.tree_leaves(adapter)
+        if len(leaves) != len(self._host):
+            raise ValueError(
+                f"adapter {name!r}: {len(leaves)} leaves != template's "
+                f"{len(self._host)}")
+        with self._lock:
+            if name == BASE_ADAPTER:
+                raise ValueError(f"{BASE_ADAPTER!r} is the reserved zero "
+                                 "adapter")
+            idx = self._names.get(name)
+            if idx is None:
+                idx = len(self._names)
+                if idx >= self.capacity:
+                    raise RuntimeError(
+                        f"adapter bank full ({self.capacity}); raise "
+                        "serving_max_adapters")
+                self._names[name] = idx
+            for host, leaf in zip(self._host, leaves):
+                arr = np.asarray(leaf, np.float32)
+                if arr.shape != host.shape[1:]:
+                    raise ValueError(
+                        f"adapter {name!r}: leaf shape {arr.shape} != "
+                        f"template {host.shape[1:]} (same targets and "
+                        "rank required)")
+                host[idx] = arr
+            self._stack = None
+        return idx
+
+    def index(self, name: Optional[str]) -> int:
+        """Name → bank index; ``None`` → the zero adapter. Unknown names
+        raise — serving a user the WRONG personalization silently is the
+        one failure mode a personalization gateway must not have."""
+        if name is None:
+            return 0
+        with self._lock:
+            idx = self._names.get(str(name))
+        if idx is None:
+            raise KeyError(f"unknown adapter {name!r}; loaded: "
+                           f"{self.names()}")
+        return idx
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return str(name) in self._names
+
+    def stack(self) -> PyTree:
+        """The resident ``[capacity, ...]`` device pytree (rebuilt lazily
+        after adds; the capacity padding keeps its shapes constant)."""
+        with self._lock:
+            if self._stack is None:
+                self._stack = jax.tree_util.tree_unflatten(
+                    self._treedef,
+                    [self._jnp.asarray(h) for h in self._host])
+            return self._stack
+
+    @classmethod
+    def from_artifacts(cls, manifest_dir: str, alpha: float = 16.0,
+                       capacity: int = 64) -> "AdapterBank":
+        """Build a bank from a ``save_adapter_artifacts`` directory
+        (manifest.json + one msgpack artifact per named adapter — the
+        layout ``llm/federated.py`` exports per silo)."""
+        from ...llm.federated import load_adapter_artifacts
+        adapters = load_adapter_artifacts(manifest_dir)
+        if not adapters:
+            raise ValueError(f"no adapters in {manifest_dir}")
+        template = next(iter(adapters.values()))
+        # +2: the reserved zero row AND the served artifact's own adapter,
+        # which CausalLMPredictor registers as "default" after loading —
+        # a manifest that exactly fills `capacity` must not crash there
+        bank = cls(template, alpha=alpha,
+                   capacity=max(capacity, len(adapters) + 2))
+        for name, tree in adapters.items():
+            bank.add(name, tree)
+        logger.info("adapter bank: loaded %d adapters from %s",
+                    len(adapters), manifest_dir)
+        return bank

@@ -1,0 +1,270 @@
+"""BatchingEngine — threaded request queue over the DecodeScheduler.
+
+The serving surface (HTTP handler threads) submits requests and blocks on
+per-request futures; ONE worker thread owns the scheduler and runs the
+admit → step → evict loop. Iteration-level scheduling: a finishing
+request frees its slot at the very next step boundary and a queued
+request is admitted into it — no batch barriers, no head-of-line
+blocking behind long generations (Orca's core idea).
+
+Deadlines: a request past its deadline is EVICTED at the next step
+boundary and resolves with what it has, ``finish_reason: "length"`` —
+tail-latency control the autoscaler's p99 policies can rely on.
+
+Instrumented through the PR 8 planes: ``llm_tokens_per_s`` gauge,
+queue-depth and slot-occupancy histograms, admit/evict counters, one
+span per request (admit/evict recorded as span events).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Deque, Dict, List, Optional
+
+from ...core.obs import metrics as obs_metrics
+from ...core.obs import trace as obs_trace
+from ...llm.data import EOS
+
+logger = logging.getLogger(__name__)
+
+
+class _Request:
+    __slots__ = ("ids", "max_new", "temperature", "seed", "adapter_idx",
+                 "deadline_ts", "future", "span", "out_ids", "slot",
+                 "submitted_ts")
+
+    def __init__(self, ids, max_new, temperature, seed, adapter_idx,
+                 deadline_ts, span):
+        self.ids = ids
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.adapter_idx = int(adapter_idx)
+        self.deadline_ts = deadline_ts
+        self.future: Future = Future()
+        self.span = span
+        self.out_ids: List[int] = []
+        self.slot: Optional[int] = None
+        self.submitted_ts = time.time()
+
+
+class BatchingEngine:
+    """Continuous-batching front over one :class:`DecodeScheduler`."""
+
+    def __init__(self, scheduler, default_deadline_s: float = 0.0,
+                 rate_window_s: float = 2.0):
+        self.scheduler = scheduler
+        self.default_deadline_s = float(default_deadline_s)
+        self.rate_window_s = float(rate_window_s)
+        self._q: "queue.Queue[_Request]" = queue.Queue()
+        self._pending: Deque[_Request] = collections.deque()
+        self._inflight: Dict[int, _Request] = {}
+        self._tokens: Deque = collections.deque()   # (ts, n) for tokens/s
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="llm-batch-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------- submit --
+    def submit(self, prompt_ids, max_new_tokens: int = 64,
+               temperature: float = 0.0, seed: int = 0,
+               adapter_idx: int = 0,
+               deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one request; the future resolves to ``{"ids",
+        "finish_reason", "prompt_tokens", "completion_tokens"}``."""
+        if not self._running:
+            raise RuntimeError("engine stopped")
+        span = obs_trace.tracer.start_span(
+            "serving.request", root=True,
+            attrs={"prompt_tokens": len(prompt_ids),
+                   "adapter_idx": int(adapter_idx)})
+        dl = self.default_deadline_s if deadline_s is None \
+            else float(deadline_s)
+        req = _Request(list(map(int, prompt_ids)), max_new_tokens,
+                       temperature, seed, adapter_idx,
+                       time.time() + dl if dl > 0 else None, span)
+        if req.max_new <= 0 or not req.ids:
+            self._finish(req, "length")
+            return req.future
+        if len(req.ids) >= self.scheduler.cfg.max_seq_len:
+            err = ValueError(
+                f"prompt of {len(req.ids)} tokens >= max_seq_len "
+                f"{self.scheduler.cfg.max_seq_len}")
+            req.span.set_attr("error", "prompt_too_long").end()
+            req.future.set_exception(err)
+            return req.future
+        ccfg = self.scheduler.cache_cfg
+        need = ccfg.blocks_needed(min(len(req.ids) + req.max_new,
+                                      ccfg.max_seq_len))
+        if need > ccfg.num_blocks:
+            # can_admit() would be False forever: failing it now beats
+            # wedging the queue head until the caller's timeout
+            err = ValueError(
+                f"request needs {need} KV blocks, pool has only "
+                f"{ccfg.num_blocks} (raise num_blocks or shrink the "
+                "request)")
+            req.span.set_attr("error", "kv_pool_too_small").end()
+            req.future.set_exception(err)
+            return req.future
+        self._q.put(req)
+        return req.future
+
+    def queue_depth(self) -> int:
+        return self._q.qsize() + len(self._pending)
+
+    # --------------------------------------------------------------- loop --
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self._drain_queue()
+                self._admit()
+                self._evict_deadlines()
+                if not self._inflight:
+                    if not self._pending:
+                        try:
+                            self._pending.append(self._q.get(timeout=0.05))
+                        except queue.Empty:
+                            pass
+                    else:
+                        # pending but unadmittable (pool too small for the
+                        # request) with nothing in flight: don't busy-spin
+                        time.sleep(0.005)
+                    continue
+                t0 = time.perf_counter()
+                toks = self.scheduler.step()
+                self._observe_step(len(toks), time.perf_counter() - t0)
+                self._collect(toks)
+            except Exception:  # noqa: BLE001 — serving loop must survive
+                logger.exception("batch engine step failed")
+                self._fail_all(RuntimeError("batch engine step failed"))
+        # drain on shutdown
+        self._fail_all(RuntimeError("engine stopped"))
+
+    def _drain_queue(self) -> None:
+        while True:
+            try:
+                self._pending.append(self._q.get_nowait())
+            except queue.Empty:
+                return
+
+    def _admit(self) -> None:
+        while self._pending:
+            req = self._pending[0]
+            if req.deadline_ts is not None and time.time() > req.deadline_ts:
+                self._pending.popleft()
+                obs_metrics.record_llm_evict("deadline_queued")
+                req.span.add_event("evict", reason="deadline_queued")
+                self._finish(req, "length")
+                continue
+            if not self.scheduler.can_admit(len(req.ids), req.max_new):
+                return
+            self._pending.popleft()
+            try:
+                slot, first = self.scheduler.admit(
+                    req.ids, adapter_idx=req.adapter_idx,
+                    temperature=req.temperature, seed=req.seed,
+                    max_new_tokens=req.max_new)
+            except Exception as e:  # noqa: BLE001
+                req.span.set_attr("error", type(e).__name__).end()
+                req.future.set_exception(e)
+                continue
+            req.slot = slot
+            req.span.add_event("admit", slot=slot)
+            obs_metrics.record_llm_admit()
+            self._inflight[slot] = req
+            self._note_tokens(1)
+            if not self._append_token(req, first):
+                self._retire(req)
+
+    def _append_token(self, req: _Request, token: int) -> bool:
+        """Append one generated token; False when the request finished."""
+        if token == EOS:
+            self._finish(req, "stop")
+            return False
+        req.out_ids.append(int(token))
+        if (len(req.out_ids) >= req.max_new
+                or (req.slot is not None
+                    and self.scheduler.slot_position(req.slot) + 1
+                    >= self.scheduler.cfg.max_seq_len)):
+            self._finish(req, "length")
+            return False
+        return True
+
+    def _collect(self, toks: Dict[int, int]) -> None:
+        self._note_tokens(len(toks))
+        for slot, token in toks.items():
+            req = self._inflight.get(slot)
+            if req is None:
+                continue
+            if not self._append_token(req, token):
+                self._retire(req)
+
+    def _evict_deadlines(self) -> None:
+        now = time.time()
+        for slot, req in list(self._inflight.items()):
+            if req.deadline_ts is not None and now > req.deadline_ts:
+                obs_metrics.record_llm_evict("deadline")
+                req.span.add_event("evict", reason="deadline", slot=slot)
+                self._finish(req, "length")
+                self._retire(req)
+
+    def _retire(self, req: _Request) -> None:
+        if req.slot is not None:
+            self._inflight.pop(req.slot, None)
+            self.scheduler.release(req.slot)
+            req.slot = None
+
+    def _finish(self, req: _Request, reason: str) -> None:
+        if req.future.done():
+            return
+        req.span.set_attr("completion_tokens", len(req.out_ids))
+        req.span.set_attr("finish_reason", reason)
+        req.span.end()
+        req.future.set_result({
+            "ids": list(req.out_ids), "finish_reason": reason,
+            "prompt_tokens": len(req.ids),
+            "completion_tokens": len(req.out_ids)})
+
+    def _fail_all(self, err: Exception) -> None:
+        self._drain_queue()   # a submit racing stop() must fail too
+        for req in list(self._inflight.values()):
+            self._retire(req)
+            if not req.future.done():
+                req.span.set_attr("error", "engine_failure").end()
+                req.future.set_exception(err)
+        for req in list(self._pending):
+            if not req.future.done():
+                req.span.set_attr("error", "engine_failure").end()
+                req.future.set_exception(err)
+        self._pending.clear()
+
+    # ------------------------------------------------------------ metrics --
+    def _note_tokens(self, n: int) -> None:
+        now = time.time()
+        self._tokens.append((now, n))
+        cutoff = now - self.rate_window_s
+        while self._tokens and self._tokens[0][0] < cutoff:
+            self._tokens.popleft()
+
+    def tokens_per_s(self) -> float:
+        now = time.time()
+        total = sum(n for ts, n in self._tokens
+                    if ts >= now - self.rate_window_s)
+        return total / self.rate_window_s
+
+    def _observe_step(self, tokens_out: int, wall_s: float) -> None:
+        obs_metrics.record_llm_serving_step(
+            tokens_out=tokens_out,
+            occupancy=self.scheduler.active_count(),
+            queue_depth=self.queue_depth(),
+            tokens_per_s=self.tokens_per_s())
+
+    # ------------------------------------------------------------- control --
+    def stop(self) -> None:
+        self._running = False
+        self._thread.join(timeout=5.0)

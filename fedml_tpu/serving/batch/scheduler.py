@@ -1,0 +1,240 @@
+"""DecodeScheduler — the compile-once continuous-batching core.
+
+One jitted DECODE step advances every in-flight request by one token:
+``[S]`` slots, each step one-token work per slot against the paged KV
+cache (vs the old template's full ``[1, max_seq_len]`` forward per
+token). One jitted PREFILL program writes a prompt into the cache in
+fixed-size chunks. Everything per-request — occupancy, positions, block
+tables, adapter indices, temperatures, seeds — enters the programs as
+DATA, so the two programs compile exactly once for a given geometry and
+stay hot across any admit/evict sequence or adapter mix (the
+compile-count regression test pins this).
+
+Sampling is stateless per (seed, position): the token for position ``p``
+uses ``fold_in(PRNGKey(seed), p)``, so a request's sample path is
+reproducible regardless of which slot it lands in or what else is in
+flight — batching must never change a seeded request's output.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...llm import kv_cache as kvc
+
+PyTree = Any
+logger = logging.getLogger(__name__)
+
+
+class DecodeScheduler:
+    """Fixed-shape slot matrix over a paged KV cache.
+
+    ``module``/``cfg``: the :class:`~fedml_tpu.llm.model.CausalLM` and its
+    config; ``base_params``: the full parameter tree the slots share;
+    ``bank``: optional :class:`AdapterBank` (None = no LoRA side paths).
+    """
+
+    def __init__(self, module, cfg, base_params, bank=None, *,
+                 slots: int = 8, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 32):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self.module = module
+        self.cfg = cfg
+        self.params = base_params
+        self.bank = bank
+        self.slots = int(slots)
+        self.prefill_chunk = min(int(prefill_chunk), cfg.max_seq_len)
+        self.cache_cfg = kvc.KVCacheConfig(
+            num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+            head_dim=cfg.head_dim, max_seq_len=cfg.max_seq_len,
+            block_size=int(block_size),
+            # default pool: every slot can hold a full sequence
+            num_blocks=int(num_blocks) if num_blocks is not None
+            else self.slots * (cfg.max_seq_len // int(block_size)))
+        self.alloc = kvc.BlockAllocator(self.cache_cfg)
+        self._kp, self._vp = kvc.init_pools(self.cache_cfg,
+                                            cfg.compute_dtype)
+        s, mb = self.slots, self.cache_cfg.max_blocks_per_slot
+        # host mirrors of per-slot state — all DATA to the jitted step
+        self._active = np.zeros(s, bool)
+        self._tables = np.full((s, mb), self.cache_cfg.trash_block,
+                               np.int32)
+        self._pos = np.zeros(s, np.int32)       # position of last_tok
+        self._last = np.zeros(s, np.int32)      # token awaiting its step
+        self._temp = np.zeros(s, np.float32)
+        self._seed = np.zeros(s, np.int32)
+        self._aidx = np.zeros(s, np.int32)
+        self.steps_run = 0
+        self._build_programs()
+
+    # ------------------------------------------------------------ programs --
+    def _build_programs(self) -> None:
+        jax, jnp = self._jax, self._jnp
+        cfg, ccfg = self.cfg, self.cache_cfg
+        n_layers = cfg.num_layers
+        bs, trash = ccfg.block_size, ccfg.trash_block
+        sentinel = ccfg.max_blocks_per_slot * bs   # OOB position: drop
+        scale = self.bank.scale if self.bank is not None else 1.0
+
+        def sample(row, temp, seed, position):
+            """The single-request step's formula, per slot: greedy at
+            temp 0, else categorical on logits/temp with a per-(seed,
+            position) key."""
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+            greedy = jnp.argmax(row).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                key, row / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+            return jnp.where(temp > 0, sampled, greedy)
+
+        def decode_step(params, stack, kp, vp, tables, pos, active, aidx,
+                        last_tok, temps, seeds):
+            views = [(kvc.gather_view(kp[i], tables),
+                      kvc.gather_view(vp[i], tables))
+                     for i in range(n_layers)]
+            adapters = None
+            if stack is not None:
+                from ...llm.lora import lora_select
+                adapters = lora_select(stack, aidx)
+            q_pos = jnp.where(active, pos, sentinel)
+            logits, kvs = self.module.apply(
+                {"params": params}, last_tok[:, None],
+                positions=q_pos[:, None], kv_view=views,
+                adapters=adapters, lora_scale=scale)
+            row = logits[:, 0]
+            nxt = jax.vmap(sample)(row, temps, seeds, pos + 1)
+            for i, (kc, vc) in enumerate(kvs):
+                kp = kp.at[i].set(kvc.scatter_token(
+                    kp[i], tables, pos, kc[:, 0], active, bs, trash))
+                vp = vp.at[i].set(kvc.scatter_token(
+                    vp[i], tables, pos, vc[:, 0], active, bs, trash))
+            return nxt, kp, vp
+
+        def prefill_chunk(params, stack, kp, vp, table_row, tokens, p0,
+                          n_valid, aidx):
+            c = tokens.shape[0]
+            offs = jnp.arange(c, dtype=jnp.int32)
+            positions = p0 + offs
+            valid = offs < n_valid
+            q_pos = jnp.where(valid, positions, sentinel)
+            views = [(kvc.gather_view(kp[i], table_row[None]),
+                      kvc.gather_view(vp[i], table_row[None]))
+                     for i in range(n_layers)]
+            adapters = None
+            if stack is not None:
+                from ...llm.lora import lora_select
+                adapters = lora_select(stack, aidx)   # shared 2-D leaves
+            logits, kvs = self.module.apply(
+                {"params": params}, tokens[None], positions=q_pos[None],
+                kv_view=views, adapters=adapters, lora_scale=scale)
+            for i, (kc, vc) in enumerate(kvs):
+                kp = kp.at[i].set(kvc.scatter_chunk(
+                    kp[i], table_row, positions, kc[0], valid, bs, trash))
+                vp = vp.at[i].set(kvc.scatter_chunk(
+                    vp[i], table_row, positions, vc[0], valid, bs, trash))
+            return logits[0], kp, vp
+
+        self._step_fn = jax.jit(decode_step, donate_argnums=(2, 3))
+        self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(2, 3))
+        self._sample_fn = jax.jit(sample)
+
+    def _stack(self):
+        return self.bank.stack() if self.bank is not None else None
+
+    # ---------------------------------------------------------- admission --
+    def free_slots(self) -> List[int]:
+        return [int(i) for i in np.flatnonzero(~self._active)]
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        if not (self._active < 1).any():
+            return False
+        total = min(int(prompt_len) + int(max_new_tokens),
+                    self.cfg.max_seq_len)
+        return self.alloc.can_alloc(total)
+
+    def admit(self, prompt_ids, *, adapter_idx: int = 0,
+              temperature: float = 0.0, seed: int = 0,
+              max_new_tokens: int = 64) -> Tuple[int, int]:
+        """Prefill one request into the lowest free slot; returns
+        ``(slot, first_generated_token)``. Deterministic: the same admit
+        sequence always lands in the same slots with the same cache
+        layout."""
+        jnp = self._jnp
+        ids = list(map(int, prompt_ids))
+        if not ids:
+            raise ValueError("empty prompt")
+        if len(ids) >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens >= max_seq_len "
+                f"{self.cfg.max_seq_len}")
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slot")
+        slot = free[0]
+        total = min(len(ids) + int(max_new_tokens), self.cfg.max_seq_len)
+        table_row = self.alloc.alloc(slot, total)
+        c = self.prefill_chunk
+        row_dev = jnp.asarray(table_row)
+        stack = self._stack()
+        logits_last = None
+        for j in range(0, len(ids), c):
+            chunk = ids[j:j + c]
+            n_valid = len(chunk)
+            chunk = chunk + [0] * (c - n_valid)
+            logits_last, self._kp, self._vp = self._prefill_fn(
+                self.params, stack, self._kp, self._vp, row_dev,
+                jnp.asarray(chunk, jnp.int32), jnp.int32(j),
+                jnp.int32(n_valid), jnp.int32(adapter_idx))
+            last_valid = n_valid
+        first = int(self._sample_fn(
+            logits_last[last_valid - 1], jnp.float32(temperature),
+            jnp.int32(int(seed) & 0x7FFFFFFF), jnp.int32(len(ids))))
+        self._active[slot] = True
+        self._tables[slot] = table_row
+        self._pos[slot] = len(ids)
+        self._last[slot] = first
+        self._temp[slot] = float(temperature)
+        self._seed[slot] = int(seed) & 0x7FFFFFFF
+        self._aidx[slot] = int(adapter_idx)
+        return slot, first
+
+    def release(self, slot: int) -> None:
+        self.alloc.free(int(slot))
+        self._active[slot] = False
+        self._tables[slot] = self.cache_cfg.trash_block
+
+    # --------------------------------------------------------------- step --
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    def step(self) -> Dict[int, int]:
+        """One decode step for every active slot → ``{slot: next_token}``.
+        Each slot's ``last_tok`` is written into the cache at its position
+        and the following token is sampled; positions advance by one."""
+        jnp = self._jnp
+        if not self._active.any():
+            return {}
+        nxt, self._kp, self._vp = self._step_fn(
+            self.params, self._stack(), self._kp, self._vp,
+            jnp.asarray(self._tables), jnp.asarray(self._pos),
+            jnp.asarray(self._active), jnp.asarray(self._aidx),
+            jnp.asarray(self._last), jnp.asarray(self._temp),
+            jnp.asarray(self._seed))
+        toks = np.asarray(nxt)
+        self.steps_run += 1
+        out: Dict[int, int] = {}
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            self._pos[slot] += 1
+            self._last[slot] = toks[slot]
+            out[slot] = int(toks[slot])
+        return out
+
+    def slot_position(self, slot: int) -> int:
+        return int(self._pos[slot])

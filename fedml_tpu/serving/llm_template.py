@@ -37,17 +37,28 @@ PyTree = Any
 
 
 class CausalLMPredictor(FedMLPredictor):
-    """Serve a fedml_tpu causal LM: greedy/temperature decoding with a
-    single compiled step.
+    """Serve a fedml_tpu causal LM.
 
     ``bundle`` is an :class:`~fedml_tpu.llm.federated.LLMBundle` (its
     ``apply`` merges LoRA adapters when present); ``params`` is the
     trainable tree that ``run_federated_llm`` / ``save_model`` produced.
+
+    Two serving modes (``llm_serving_mode``):
+
+    * ``"single"`` (default, the original behavior): one request at a
+      time through one compiled full-forward step over the padded
+      ``[1, max_seq_len]`` buffer;
+    * ``"batch"``: requests flow through the continuous-batching engine
+      (``serving/batch/``) — paged KV cache, one-token decode work per
+      step, per-request LoRA adapter selection from a multi-adapter bank
+      (``adapter_bank`` / ``llm_adapter_dir``), deadline eviction.
     """
 
     def __init__(self, bundle, params: PyTree, tokenizer=None,
                  max_seq_len: Optional[int] = None,
-                 temperature: float = 0.0):
+                 temperature: float = 0.0, mode: str = "single",
+                 batch_opts: Optional[Dict[str, Any]] = None,
+                 adapter_bank=None):
         import jax
         import jax.numpy as jnp
 
@@ -58,6 +69,9 @@ class CausalLMPredictor(FedMLPredictor):
         self.tokenizer = tokenizer or ByteTokenizer()
         self.max_seq_len = int(max_seq_len or bundle.cfg.max_seq_len)
         self.temperature = float(temperature)
+        self.mode = str(mode)
+        if self.mode not in ("single", "batch"):
+            raise ValueError(f"llm_serving_mode {mode!r}: single|batch")
 
         def step(params, buf, pos, temp, key):
             # buf: [1, L] padded token buffer; logits at the last real
@@ -71,26 +85,127 @@ class CausalLMPredictor(FedMLPredictor):
         self._step = jax.jit(step)
         self._jnp = jnp
         self._jax = jax
+        self._engine = None
+        self._bank = adapter_bank
+        self._default_aidx = 0
+        self._request_timeout_s = float(
+            (batch_opts or {}).get("request_timeout_s", 120.0))
+        if self.mode == "batch":
+            self._build_engine(batch_opts or {})
+
+    def _build_engine(self, opts: Dict[str, Any]) -> None:
+        from .batch import AdapterBank, BatchingEngine, DecodeScheduler
+        bundle = self.bundle
+        if bundle.base_params is not None:
+            # LoRA artifact: base model resident, the artifact's adapter
+            # registered as "default" so adapter-less requests behave like
+            # the single path (modulo factored-vs-merged float paths)
+            base = bundle.base_params
+            if self._bank is None:
+                self._bank = AdapterBank(
+                    self.params, alpha=bundle.lora_alpha,
+                    capacity=int(opts.get("max_adapters", 64)))
+            self._default_aidx = self._bank.add("default", self.params)
+        else:
+            # full fine-tune artifact: the params ARE the model; a bank
+            # only makes sense if the caller supplied one
+            base = self.params
+            if self._bank is not None:
+                self._default_aidx = 0
+        scheduler = DecodeScheduler(
+            bundle.module, bundle.cfg, base, self._bank,
+            slots=int(opts.get("slots", 8)),
+            block_size=int(opts.get("block_size", 16)),
+            num_blocks=opts.get("num_blocks"),
+            prefill_chunk=int(opts.get("prefill_chunk", 32)))
+        self._engine = BatchingEngine(
+            scheduler,
+            default_deadline_s=float(opts.get("deadline_s", 0.0)))
+
+    @property
+    def adapter_bank(self):
+        return self._bank
+
+    def close(self) -> None:
+        if self._engine is not None:
+            self._engine.stop()
+            self._engine = None
 
     @classmethod
     def from_artifact(cls, args, params_path: str, **kw):
         """Load a served artifact the way the CLI/launcher does: rebuild
         the bundle from config (model only — no dataset construction),
-        params from the msgpack artifact."""
+        params from the msgpack artifact. ``llm_serving_mode: batch``
+        turns on continuous batching; ``llm_adapter_dir`` loads a named
+        adapter bank exported by ``llm/federated.py``."""
         from ..llm.federated import build_llm_bundle
         bundle, tokenizer = build_llm_bundle(args)
+        kw.setdefault("mode", str(getattr(args, "llm_serving_mode",
+                                          "single")))
+        if kw["mode"] == "batch":
+            kw.setdefault("batch_opts", {
+                "slots": int(getattr(args, "serving_slots", 8)),
+                "block_size": int(getattr(args, "serving_kv_block_size",
+                                          16)),
+                "prefill_chunk": int(getattr(args, "serving_prefill_chunk",
+                                             32)),
+                "max_adapters": int(getattr(args, "serving_max_adapters",
+                                            64)),
+                "deadline_s": float(getattr(args, "serving_deadline_s",
+                                            0.0)),
+                "request_timeout_s": float(
+                    getattr(args, "serving_request_timeout_s", 120.0)),
+            })
+            adapter_dir = getattr(args, "llm_adapter_dir", None)
+            if adapter_dir and kw.get("adapter_bank") is None:
+                from .batch import AdapterBank
+                kw["adapter_bank"] = AdapterBank.from_artifacts(
+                    adapter_dir,
+                    alpha=float(getattr(args, "lora_alpha", 16.0)),
+                    capacity=int(getattr(args, "serving_max_adapters",
+                                         64)))
         return cls(bundle, load_model(params_path), tokenizer=tokenizer,
                    **kw)
 
     # --- generation ---------------------------------------------------------
+    def _encode_prompt(self, prompt: str, max_new_tokens: int) -> List[int]:
+        """Tokenize and fit the prompt: keep the TAIL of an over-long
+        prompt (the most recent turns — for chat, dropping the head is
+        right and dropping the tail is exactly wrong) and reserve room
+        for ``max_new_tokens`` of completion."""
+        from ..llm.data import BOS, SEP
+        ids = [BOS] + self.tokenizer.encode(prompt) + [SEP]
+        reserve = max(1, min(int(max_new_tokens), self.max_seq_len - 1))
+        budget = max(1, self.max_seq_len - reserve)
+        if len(ids) > budget:
+            ids = ids[-budget:]
+        return ids
+
     def generate(self, prompt: str, max_new_tokens: int = 64,
                  temperature: Optional[float] = None,
-                 seed: int = 0) -> Dict[str, Any]:
-        from ..llm.data import BOS, EOS, SEP
-        jnp = self._jnp
+                 seed: Optional[int] = None,
+                 adapter: Optional[str] = None) -> Dict[str, Any]:
+        """``seed=None`` (the default) derives a fresh per-request seed,
+        so concurrent no-seed users at ``temperature > 0`` get distinct
+        samples; an explicit seed reproduces exactly."""
+        import os as _os
         temp = self.temperature if temperature is None else float(temperature)
-        ids = [BOS] + self.tokenizer.encode(prompt) + [SEP]
-        ids = ids[: self.max_seq_len - 1]
+        if seed is None:
+            seed = int.from_bytes(_os.urandom(4), "little") & 0x7FFFFFFF
+        ids = self._encode_prompt(prompt, max_new_tokens)
+        if self._engine is not None:
+            return self._generate_batched(ids, max_new_tokens, temp,
+                                          int(seed), adapter)
+        if adapter is not None:
+            raise ValueError(
+                "per-request adapter selection needs llm_serving_mode: "
+                "batch (the single path serves one merged artifact)")
+        return self._generate_single(ids, max_new_tokens, temp, int(seed))
+
+    def _generate_single(self, ids: List[int], max_new_tokens: int,
+                         temp: float, seed: int) -> Dict[str, Any]:
+        from ..llm.data import EOS
+        jnp = self._jnp
         n_prompt = len(ids)
         buf = np.zeros((1, self.max_seq_len), np.int32)
         buf[0, :n_prompt] = ids
@@ -116,16 +231,52 @@ class CausalLMPredictor(FedMLPredictor):
                 "prompt_tokens": n_prompt,
                 "completion_tokens": len(out_ids)}
 
+    def _generate_batched(self, ids: List[int], max_new_tokens: int,
+                          temp: float, seed: int,
+                          adapter: Optional[str]) -> Dict[str, Any]:
+        if adapter is not None and self._bank is None:
+            raise ValueError(
+                f"adapter {adapter!r} requested but no adapter bank is "
+                "loaded (full fine-tune artifact without llm_adapter_dir)")
+        aidx = (self._bank.index(adapter) if adapter is not None
+                else self._default_aidx)
+        fut = self._engine.submit(ids, max_new_tokens=int(max_new_tokens),
+                                  temperature=temp, seed=seed,
+                                  adapter_idx=aidx)
+        out = fut.result(timeout=self._request_timeout_s)
+        return {"text": self.tokenizer.decode(out["ids"]),
+                "finish_reason": out["finish_reason"],
+                "prompt_tokens": out["prompt_tokens"],
+                "completion_tokens": out["completion_tokens"]}
+
     # --- request surfaces ---------------------------------------------------
     def predict(self, request: Any) -> Any:
         """Plain surface: ``{"prompt": str, "max_new_tokens"?,
-        "temperature"?}`` → ``{"text": ...}``."""
+        "temperature"?, "seed"?, "adapter"?}`` → ``{"text": ...}``.
+        No ``seed`` in the request → a fresh per-request seed (each
+        sampled request gets its own stream); an explicit seed is
+        reproducible."""
+        seed = request.get("seed")
         out = self.generate(
             str(request.get("prompt", "")),
             max_new_tokens=int(request.get("max_new_tokens", 64)),
             temperature=request.get("temperature"),
-            seed=int(request.get("seed", 0)))
+            seed=None if seed is None else int(seed),
+            adapter=request.get("adapter"))
         return out
+
+    def _resolve_adapter(self, request: Any) -> Optional[str]:
+        """Explicit ``adapter`` wins; otherwise an OpenAI ``model`` field
+        naming a bank entry selects it — existing OpenAI clients pick
+        their federated per-silo personalization by model name."""
+        adapter = request.get("adapter")
+        if adapter is not None:
+            return str(adapter)
+        model = request.get("model")
+        if (model is not None and self._bank is not None
+                and self._bank.has(str(model))):
+            return str(model)
+        return None
 
     def chat(self, request: Any) -> Any:
         """OpenAI ``/v1/chat/completions`` schema. The prompt is the
@@ -137,11 +288,13 @@ class CausalLMPredictor(FedMLPredictor):
         # incoherent
         prompt = "\n".join(str(m.get("content", "")) for m in messages
                            if m.get("content"))
+        seed = request.get("seed")
         out = self.generate(
             prompt,
             max_new_tokens=int(request.get("max_tokens", 64)),
             temperature=request.get("temperature"),
-            seed=int(request.get("seed", 0)))
+            seed=None if seed is None else int(seed),
+            adapter=self._resolve_adapter(request))
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
