@@ -197,6 +197,13 @@ _SCHEMA: Dict[str, Any] = {
                                        # past it the request is evicted
                                        # with finish_reason: length (0=off)
     "serving_request_timeout_s": 120.0,
+    # serving-plane observability: the engine's stall/NaN watchdog (0 =
+    # off) and the black-box flight recorder (ring of the last N
+    # request-lifecycle + engine-step records, dumped as JSONL on crash,
+    # SIGTERM, or watchdog trip; dir None = next to the run logs)
+    "serving_watchdog_s": 30.0,
+    "serving_flight_records": 256,
+    "serving_flight_dir": None,
     "llm_adapter_dir": None,           # adapter-bank manifest dir to serve
     # federated-LoRA adapter export: after run_federated_llm, write the
     # global + per-silo personalized adapters as named artifacts the
@@ -215,6 +222,10 @@ _SCHEMA: Dict[str, Any] = {
     "obs_tracing": True,          # spans + traceparent wire propagation
     "obs_metrics": True,          # typed counter/gauge/histogram registry
     "obs_metrics_flush_rounds": 10,  # metrics_snapshot JSONL cadence
+    # wall-clock metrics_snapshot cadence (seconds; 0 = off) for
+    # workloads that never cross a round boundary — serving, the
+    # cross-device handshake, agents; skips when nothing changed
+    "obs_metrics_flush_s": 60.0,
     "obs_profile_device": False,  # host/device split + per-round MFU
     "log_file_dir": "~/.cache/fedml_tpu/logs",
     "save_model_path": None,     # persist final params (serving artifact)

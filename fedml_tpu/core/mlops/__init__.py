@@ -258,6 +258,15 @@ def log_model_info(round_idx: int, model_path: str) -> None:
     _emit("model", {"round_idx": round_idx, "path": model_path})
 
 
+def log_health(component: str, status: str,
+               detail: Optional[Dict[str, Any]] = None) -> None:
+    """One component health transition: watchdog trips (``stalled`` /
+    ``nan_logits``), serving ``/healthz`` state changes. Post-mortems
+    grep these to bracket when a process went bad."""
+    _emit("health", {"component": str(component), "status": str(status),
+                     "detail": detail})
+
+
 # --- event spans (reference MLOpsProfilerEvent) ----------------------------
 
 class event:

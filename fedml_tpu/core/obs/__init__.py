@@ -26,7 +26,8 @@ it the defaults apply, so library use without init still traces.
 
 from __future__ import annotations
 
-from . import metrics, profiler, schema, trace                  # noqa: F401
+from . import flight, metrics, profiler, schema, trace          # noqa: F401
+from .flight import FlightRecorder, Watchdog                    # noqa: F401
 from .metrics import REGISTRY                                   # noqa: F401
 from .trace import (NOOP_SPAN, SpanContext, add_event, current_span,  # noqa: F401
                     extract, inject, parse_traceparent, span, tracer)
@@ -39,5 +40,10 @@ def configure(args=None) -> None:
     metrics.set_enabled(bool(getattr(args, "obs_metrics", True)))
     metrics.set_flush_every(
         int(getattr(args, "obs_metrics_flush_rounds", 10) or 0))
+    # wall-clock snapshot cadence for workloads with no round boundary
+    # (serving, cross-device handshakes, agents): the round flusher
+    # never fires there, so a crash would lose everything since init
+    metrics.set_flush_interval(
+        float(getattr(args, "obs_metrics_flush_s", 60.0) or 0.0))
     profiler.set_device_profiling(
         bool(getattr(args, "obs_profile_device", False)))

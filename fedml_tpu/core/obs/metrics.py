@@ -25,8 +25,10 @@ float add. The registry itself always works — only the convenience
 from __future__ import annotations
 
 import bisect
+import collections
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 _cfg = {"enabled": True, "flush_every": 10}
 
@@ -73,6 +75,14 @@ class _Instrument:
         return "{" + pairs + "}"
 
 
+# process-wide mutation epoch: every instrument write bumps it, so the
+# wall-clock flusher can skip snapshots when nothing changed (an idle
+# process stays silent instead of re-emitting identical instruments;
+# flushed starts EQUAL to epoch so a process that never records
+# anything never emits an empty snapshot)
+_activity = {"epoch": 0, "flushed": 0}
+
+
 class Counter(_Instrument):
     kind = "counter"
 
@@ -82,6 +92,7 @@ class Counter(_Instrument):
         key = self._key(labels)
         with self._lock:
             self._data[key] = self._data.get(key, 0.0) + float(value)
+        _activity["epoch"] += 1
 
     def value(self, **labels: Any) -> float:
         with self._lock:
@@ -107,11 +118,13 @@ class Gauge(_Instrument):
         key = self._key(labels)
         with self._lock:
             self._data[key] = float(value)
+        _activity["epoch"] += 1
 
     def inc(self, value: float = 1.0, **labels: Any) -> None:
         key = self._key(labels)
         with self._lock:
             self._data[key] = self._data.get(key, 0.0) + float(value)
+        _activity["epoch"] += 1
 
     def value(self, **labels: Any) -> Optional[float]:
         with self._lock:
@@ -149,6 +162,7 @@ class Histogram(_Instrument):
             ent["counts"][i] += 1
             ent["sum"] += value
             ent["count"] += 1
+        _activity["epoch"] += 1
 
     def snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -261,6 +275,7 @@ class MetricsRegistry:
     def flush(self, step: Optional[int] = None) -> None:
         """Emit one ``metrics_snapshot`` JSONL record through mlops."""
         from .. import mlops
+        _activity["flushed"] = _activity["epoch"]
         mlops._emit("metrics_snapshot", {"metrics": self.snapshot(),
                                          "step": step})
 
@@ -430,7 +445,7 @@ def record_llm_evict(reason: str) -> None:
 def record_gateway_latency(latency_s: float) -> None:
     """Serving gateway seam: per-request end-to-end latency histogram
     (the exact p50/p99 the autoscaler reads comes from the gateway's
-    trailing window; this is the exposition/post-mortem view)."""
+    :class:`LatencyWindow`; this is the exposition/post-mortem view)."""
     if not _cfg["enabled"]:
         return
     REGISTRY.histogram("serving_gateway_latency_seconds",
@@ -438,7 +453,198 @@ def record_gateway_latency(latency_s: float) -> None:
                        buckets=LATENCY_BUCKETS).observe(float(latency_s))
 
 
+# serving-plane SLO buckets: TTFT is gated by queue wait + prefill (tens
+# of ms to seconds); ITL is one decode step (sub-ms to tens of ms)
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0)
+ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
+TOKRATE_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                   1000.0)
+
+
+def record_llm_ttft(seconds: float) -> None:
+    """Time-to-first-token: request submit → first generated token (the
+    Orca-style admission SLO — queue wait + chunked prefill)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("llm_ttft_seconds",
+                       "request submit to first generated token",
+                       buckets=TTFT_BUCKETS).observe(float(seconds))
+
+
+def record_llm_itl(step_wall_s: float) -> None:
+    """Inter-token latency: one observation per decode STEP (every active
+    slot experienced this gap — per-step, not per-token, so the hot loop
+    costs one bisect regardless of occupancy)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("llm_inter_token_seconds",
+                       "decode-step wall time = inter-token latency of "
+                       "every in-flight request",
+                       buckets=ITL_BUCKETS).observe(float(step_wall_s))
+
+
+def record_llm_request(tokens_per_s: float, queue_wait_s: float) -> None:
+    """Per-request close-out: individual decode throughput + queue wait
+    (the aggregate tokens/s gauge hides per-request starvation)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("llm_request_tokens_per_s",
+                       "per-request decode throughput at finish",
+                       buckets=TOKRATE_BUCKETS).observe(
+                           float(tokens_per_s))
+    REGISTRY.histogram("llm_queue_wait_seconds",
+                       "request submit to decode-slot admission",
+                       buckets=TTFT_BUCKETS).observe(float(queue_wait_s))
+
+
+def record_llm_kv_pool(used_blocks: int, free_blocks: int,
+                       headroom_requests: int, fragmentation: float
+                       ) -> None:
+    """Paged-KV pool state: occupancy, free list, how many WORST-CASE
+    requests the admission reserve could still take, and internal
+    fragmentation (reserved-but-unwritten fraction of allocated
+    blocks)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.gauge("llm_kv_blocks_used",
+                   "KV pool blocks allocated to slots").set(
+                       int(used_blocks))
+    REGISTRY.gauge("llm_kv_blocks_free",
+                   "KV pool blocks on the free list").set(int(free_blocks))
+    REGISTRY.gauge("llm_kv_admission_headroom_requests",
+                   "worst-case (max_seq_len) requests the free list can "
+                   "still admit").set(int(headroom_requests))
+    REGISTRY.gauge("llm_kv_fragmentation",
+                   "reserved-but-unwritten fraction of allocated KV "
+                   "blocks").set(float(fragmentation))
+
+
+def record_llm_adapter(name: str) -> None:
+    """Adapter-bank mix: which personalization each request selected."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_adapter_requests_total",
+                     "requests by selected adapter",
+                     labels=("adapter",)).inc(1, adapter=str(name))
+
+
+def record_llm_reject(reason: str) -> None:
+    """Submit-time rejections (never admitted), by reason — distinct from
+    evictions, which had a slot and lost it."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_requests_rejected_total",
+                     "requests rejected at submit",
+                     labels=("reason",)).inc(1, reason=str(reason))
+
+
+def record_watchdog_trip(component: str, reason: str) -> None:
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("obs_watchdog_trips_total",
+                     "black-box watchdog trips",
+                     labels=("component", "reason")).inc(
+                         1, component=str(component), reason=str(reason))
+
+
+class LatencyWindow:
+    """Trailing-window latency store with EXACT nearest-rank percentiles —
+    the one implementation of windowed tail stats (the serving gateway's
+    p50/p99 and any autoscaler signal read this; the cumulative registry
+    histograms remain the exposition/post-mortem view, fed separately by
+    the ``record_*`` hooks)."""
+
+    def __init__(self, window_s: float = 5.0):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._events: Deque[Tuple[float, float]] = collections.deque()
+
+    def observe(self, latency_s: float, ts: Optional[float] = None) -> None:
+        now = time.time() if ts is None else float(ts)
+        with self._lock:
+            self._events.append((now, float(latency_s)))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    @staticmethod
+    def _rank(lats: List[float], q: float) -> float:
+        n = len(lats)
+        return lats[min(n - 1, int(q * (n - 1) + 0.5))]
+
+    def stats(self) -> Tuple[float, float, float, float, int]:
+        """``(qps, mean, p50, p99, count)`` over the trailing window."""
+        now = time.time()
+        with self._lock:
+            self._trim(now)
+            lats = sorted(l for _, l in self._events)
+        n = len(lats)
+        if not n:
+            return 0.0, 0.0, 0.0, 0.0, 0
+        return (n / self.window_s, sum(lats) / n,
+                self._rank(lats, 0.50), self._rank(lats, 0.99), n)
+
+
 _flush_state = {"last": None}
+
+# wall-clock flusher state: at most one live daemon thread per process —
+# ownership is `_wall_flush["thread"] is current_thread()`, so a
+# re-configure (new interval, or 0 = off) retires the old loop instead
+# of stacking threads
+_wall_flush = {"interval_s": 0.0, "thread": None, "last_ts": 0.0}
+
+
+def set_flush_interval(seconds: float) -> None:
+    """Wall-clock snapshot cadence (``obs_metrics_flush_s``; 0 = off).
+
+    The round-boundary flusher (:func:`maybe_flush`) only fires on
+    ``log_round_info`` — serving, cross-device handshakes, and agent
+    paths never cross a round boundary, so without this their metrics
+    exist only in the final :func:`flush_final` snapshot (or not at all
+    on a crash). The wall-clock loop emits a ``metrics_snapshot`` every
+    ``seconds`` — but only when an instrument actually changed since the
+    last flush (the activity epoch), so an idle process stays silent."""
+    interval = max(float(seconds or 0.0), 0.0)
+    _wall_flush["interval_s"] = interval
+    if interval <= 0:
+        _wall_flush["thread"] = None  # orphan the loop; it exits itself
+        return
+    th = _wall_flush["thread"]
+    if th is not None and th.is_alive():
+        return  # live loop re-reads interval_s every tick
+
+    def loop() -> None:
+        me = threading.current_thread()
+        while _wall_flush["thread"] is me:
+            ivl = _wall_flush["interval_s"]
+            if ivl <= 0:
+                return
+            time.sleep(min(ivl, 1.0))
+            # re-check AFTER the sleep: a disable (or takeover) during
+            # the nap must not let one more flush slip through
+            if (_wall_flush["thread"] is not me
+                    or _wall_flush["interval_s"] <= 0):
+                return
+            now = time.time()
+            if now - _wall_flush["last_ts"] < _wall_flush["interval_s"]:
+                continue
+            if not _cfg["enabled"]:
+                continue
+            if _activity["epoch"] == _activity["flushed"]:
+                continue  # nothing changed since the last snapshot
+            _wall_flush["last_ts"] = now
+            try:
+                REGISTRY.flush()
+            except Exception:  # pragma: no cover — sink died mid-run
+                pass
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="obs-metrics-wall-flush")
+    _wall_flush["thread"] = t
+    t.start()
 
 
 def maybe_flush(round_idx: int) -> None:

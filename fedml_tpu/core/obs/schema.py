@@ -122,7 +122,31 @@ RECORD_SCHEMAS: Dict[str, Dict[str, FieldSpec]] = {
                 "compiles": _f(INT),
                 "tflops": _f(NUM),
                 "mfu": _f(NUM)},
+    # mlops.log_health — component health transitions: watchdog trips
+    # (status: stalled | nan_logits), serving /healthz state changes
+    "health": {"component": _f(STR, required=True),
+               "status": _f(STR, required=True),
+               "detail": _f(DICT, nullable=True)},
+    # core/obs/flight.py ring-buffer dump: one line per recorded event,
+    # oldest first — the black-box artifact validates like a run log
+    "flight": {"component": _f(STR, required=True),
+               "seq": _f(INT, required=True),
+               "event": _f(STR, required=True),
+               "data": _f(DICT)},
 }
+
+# Span names the serving request lifecycle emits (engine + HTTP surface).
+# scripts/serving_report.py keys its waterfall on these; the e2e trace
+# test pins that every emitted serving span uses a name from this set,
+# so the report and the instrumentation cannot drift apart.
+SERVING_SPAN_NAMES = frozenset({
+    "serving.http",          # replica/gateway HTTP receive -> reply
+    "serving.request",       # submit -> finish (the per-request root)
+    "serving.queue",         # submit -> admission (queue wait)
+    "serving.prefill",       # chunked prefill inside admit
+    "serving.decode",        # first token -> finish/evict
+    "serving.decode_steps",  # shared engine-side step block (fan-in links)
+})
 
 
 def _type_ok(ty: str, v: Any) -> bool:

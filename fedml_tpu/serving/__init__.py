@@ -112,11 +112,29 @@ class CheckpointPredictor(FedMLPredictor):
 class FedMLInferenceRunner:
     """HTTP wrapper: POST /predict, GET /ready (reference
     ``fedml_inference_runner.py:8-39``). ``start()`` serves on a background
-    thread and returns the bound port; ``run()`` blocks."""
+    thread and returns the bound port; ``run()`` blocks.
+
+    Operator surface (the serving observability plane):
+
+    * ``GET /metrics`` — Prometheus text exposition of the process-wide
+      ``core/obs`` registry (TTFT/ITL histograms, KV-pool gauges, ...);
+    * ``GET /healthz`` — liveness JSON from the predictor's ``health()``
+      when it has one (503 on a non-``ok`` status — the watchdog's view);
+    * ``GET /debug/state`` — the predictor's ``debug_state()`` (slot
+      matrix, block-table summary, queue snapshot) for live inspection.
+
+    Tracing: a ``POST`` carrying a W3C ``traceparent`` header joins the
+    caller's trace — the handler wraps the route in a ``serving.http``
+    span parented on the header (or a fresh root), active on the handler
+    thread so the engine's per-request spans nest under it, and echoes
+    the span's ``traceparent`` on the response."""
 
     def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1",
                  port: int = 0,
                  extra_routes: Optional[dict] = None):
+        from ..core.obs import metrics as obs_metrics
+        from ..core.obs import trace as obs_trace
+
         self.predictor = predictor
         # POST routes: path -> callable(json_request) -> json_response.
         # /predict is always mounted; templates mount more (e.g. the LLM
@@ -129,10 +147,22 @@ class FedMLInferenceRunner:
             def log_message(self, fmt, *args_):  # quiet by default
                 logger.debug("serving: " + fmt, *args_)
 
-            def _reply(self, code: int, payload: Any) -> None:
+            def _reply(self, code: int, payload: Any,
+                       traceparent: Optional[str] = None) -> None:
                 blob = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                if traceparent:
+                    self.send_header("traceparent", traceparent)
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _reply_text(self, code: int, text: str) -> None:
+                blob = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 self.wfile.write(blob)
@@ -141,6 +171,14 @@ class FedMLInferenceRunner:
                 if self.path == "/ready":
                     ok = runner.predictor.ready()
                     self._reply(200 if ok else 503, {"ready": ok})
+                elif self.path == "/metrics":
+                    self._reply_text(200, obs_metrics.REGISTRY.exposition())
+                elif self.path == "/healthz":
+                    health = runner.health()
+                    self._reply(200 if health.get("status") == "ok"
+                                else 503, health)
+                elif self.path == "/debug/state":
+                    self._reply(200, runner.debug_state())
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -149,17 +187,45 @@ class FedMLInferenceRunner:
                 if handler is None:
                     self._reply(404, {"error": "not found"})
                     return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    request = json.loads(self.rfile.read(n) or b"{}")
-                    self._reply(200, handler(request))
-                except Exception as e:
-                    logger.exception("predict failed")
-                    self._reply(500, {"error": str(e)})
+                parent = obs_trace.parse_traceparent(
+                    self.headers.get("traceparent"))
+                with obs_trace.span("serving.http", parent=parent,
+                                    attrs={"path": self.path}) as sp:
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        request = json.loads(self.rfile.read(n) or b"{}")
+                        self._reply(200, handler(request),
+                                    traceparent=sp.traceparent())
+                    except Exception as e:
+                        logger.exception("predict failed")
+                        sp.set_attr("error", type(e).__name__)
+                        self._reply(500, {"error": str(e)},
+                                    traceparent=sp.traceparent())
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def health(self) -> dict:
+        """Predictor ``health()`` when present, else readiness only."""
+        fn = getattr(self.predictor, "health", None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception as e:  # health must answer, not raise
+                return {"status": "error", "error": str(e)}
+        ok = self.predictor.ready()
+        return {"status": "ok" if ok else "not_ready"}
+
+    def debug_state(self) -> dict:
+        fn = getattr(self.predictor, "debug_state", None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception as e:
+                return {"error": str(e)}
+        return {"routes": sorted(self.routes),
+                "predictor": type(self.predictor).__name__}
 
     def start(self) -> int:
         self._thread = threading.Thread(target=self._server.serve_forever,

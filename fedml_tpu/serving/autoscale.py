@@ -21,7 +21,7 @@ import threading
 import time
 import urllib.request
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -358,22 +358,36 @@ class GatewayMetrics:
 class Gateway:
     """Round-robin HTTP front over a ReplicaSet that records the
     QPS/latency series policies consume (reference inference gateway).
-    Every request's latency also lands in the ``core/obs`` metrics
-    registry (``serving_gateway_latency_seconds``), so the Prometheus
-    exposition and JSONL snapshots carry the serving tail."""
+
+    Windowed tail stats live in ONE place: the ``core/obs``
+    :class:`~fedml_tpu.core.obs.metrics.LatencyWindow` (exact
+    nearest-rank percentiles over the trailing window — the autoscaler's
+    signal is never bucket-quantized). Every request's latency also
+    lands in the registry histogram (``serving_gateway_latency_seconds``)
+    for the ``/metrics`` exposition and JSONL snapshots. An active span
+    on the calling thread is forwarded to the replica as a W3C
+    ``traceparent`` header, so the replica-side request trace joins the
+    caller's."""
 
     def __init__(self, replica_set: ReplicaSet, window_s: float = 5.0):
+        from ..core.obs import metrics as obs_metrics
         self.replica_set = replica_set
         self.window_s = float(window_s)
         self._i = 0
         self._lock = threading.Lock()
-        self._events: Deque[Tuple[float, float]] = deque()  # (ts, latency)
+        self._window = obs_metrics.LatencyWindow(window_s=self.window_s)
 
     def predict(self, request: dict, timeout: float = 30.0,
                 path: str = "/predict") -> dict:
         """Route one request to a replica; ``path`` selects the replica
         route (e.g. ``/v1/chat/completions`` on LLM replicas)."""
+        from ..core.obs import metrics as obs_metrics
+        from ..core.obs import trace as obs_trace
         body = json.dumps(request).encode()
+        headers = {"Content-Type": "application/json"}
+        cur = obs_trace.current_span()
+        if cur is not None and cur.traceparent():
+            headers["traceparent"] = cur.traceparent()
         t0 = time.perf_counter()
         # one retry on a CONNECTION-PHASE failure only (replica swapped or
         # crashed between routing and connect — the request never reached
@@ -389,7 +403,7 @@ class Gateway:
                 self._i += 1
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}{path}", data=body,
-                headers={"Content-Type": "application/json"})
+                headers=headers)
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as r:
                     out = json.load(r)
@@ -402,36 +416,16 @@ class Gateway:
                         or not isinstance(reason, ConnectionError)):
                     raise
         dt = time.perf_counter() - t0
-        from ..core.obs import metrics as obs_metrics
         obs_metrics.record_gateway_latency(dt)
-        now = time.time()
-        with self._lock:
-            self._events.append((now, dt))
-            cutoff = now - self.window_s
-            while self._events and self._events[0][0] < cutoff:
-                self._events.popleft()
+        self._window.observe(dt)
         return out
 
     def metrics(self) -> GatewayMetrics:
-        """Trailing-window :class:`GatewayMetrics` — qps, mean latency,
-        and exact p50/p99 over the recorded events (computed from the
-        raw window, not histogram buckets, so the tail the autoscaler
-        reacts to is not bucket-quantized). Unpacks as the legacy
-        ``(qps, mean)`` pair."""
-        now = time.time()
-        with self._lock:
-            cutoff = now - self.window_s
-            while self._events and self._events[0][0] < cutoff:
-                self._events.popleft()
-            lats = sorted(l for _, l in self._events)
-        n = len(lats)
-        if n:
-            mean = sum(lats) / n
-            p50 = lats[min(n - 1, int(0.50 * (n - 1) + 0.5))]
-            p99 = lats[min(n - 1, int(0.99 * (n - 1) + 0.5))]
-        else:
-            mean = p50 = p99 = 0.0
-        return GatewayMetrics(qps=n / self.window_s, latency_s=mean,
+        """Trailing-window :class:`GatewayMetrics` from the shared
+        :class:`~fedml_tpu.core.obs.metrics.LatencyWindow`. Unpacks as
+        the legacy ``(qps, mean)`` pair."""
+        qps, mean, p50, p99, n = self._window.stats()
+        return GatewayMetrics(qps=qps, latency_s=mean,
                               p50=p50, p99=p99, count=n)
 
 

@@ -11,32 +11,57 @@ Deadlines: a request past its deadline is EVICTED at the next step
 boundary and resolves with what it has, ``finish_reason: "length"`` —
 tail-latency control the autoscaler's p99 policies can rely on.
 
-Instrumented through the PR 8 planes: ``llm_tokens_per_s`` gauge,
-queue-depth and slot-occupancy histograms, admit/evict counters, one
-span per request (admit/evict recorded as span events).
+Observability (the full request lifecycle through the ``core/obs``
+planes):
+
+* one trace per request — ``serving.request`` (child of the HTTP
+  surface's span when one is active, so an inbound W3C ``traceparent``
+  joins the caller's trace) containing ``serving.queue`` (submit →
+  admission), ``serving.prefill`` (chunked prefill), and
+  ``serving.decode`` (first token → finish/evict, decode progress as
+  step-bucketed events, never per-token);
+* shared engine-side ``serving.decode_steps`` spans — one per block of
+  decode steps, LINKING the in-flight request spans they advanced (the
+  fan-in idiom async pours use for their contributing uploads);
+* SLO metrics — TTFT, inter-token latency (one observation per decode
+  STEP), per-request tokens/s + queue wait, KV block-pool occupancy/
+  fragmentation/admission headroom, evictions and rejections by reason;
+* a black-box :class:`~fedml_tpu.core.obs.flight.FlightRecorder` ring of
+  the last N lifecycle/step records, dumped on engine crash or when the
+  :class:`~fedml_tpu.core.obs.flight.Watchdog` trips (no decode progress
+  for ``watchdog_s`` while occupancy > 0, or NaN/inf decode logits).
 """
 
 from __future__ import annotations
 
 import collections
 import logging
+import os
 import queue
 import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Deque, Dict, List, Optional
 
+from ...core.obs import flight as obs_flight
 from ...core.obs import metrics as obs_metrics
 from ...core.obs import trace as obs_trace
 from ...llm.data import EOS
 
 logger = logging.getLogger(__name__)
 
+# decode progress lands on the request span every this-many tokens (an
+# event per token would make span records O(completion) large)
+PROGRESS_EVERY_TOKENS = 16
+# one shared serving.decode_steps span per this-many decode steps
+DECODE_SPAN_STEPS = 32
+
 
 class _Request:
     __slots__ = ("ids", "max_new", "temperature", "seed", "adapter_idx",
                  "deadline_ts", "future", "span", "out_ids", "slot",
-                 "submitted_ts")
+                 "submitted_ts", "queue_span", "decode_span", "admit_ts",
+                 "decode_ts")
 
     def __init__(self, ids, max_new, temperature, seed, adapter_idx,
                  deadline_ts, span):
@@ -51,13 +76,19 @@ class _Request:
         self.out_ids: List[int] = []
         self.slot: Optional[int] = None
         self.submitted_ts = time.time()
+        self.queue_span = None
+        self.decode_span = None
+        self.admit_ts: Optional[float] = None   # queue end (prefill start)
+        self.decode_ts: Optional[float] = None  # first token (decode start)
 
 
 class BatchingEngine:
     """Continuous-batching front over one :class:`DecodeScheduler`."""
 
     def __init__(self, scheduler, default_deadline_s: float = 0.0,
-                 rate_window_s: float = 2.0):
+                 rate_window_s: float = 2.0, watchdog_s: float = 30.0,
+                 flight_records: int = 256,
+                 flight_dir: Optional[str] = None):
         self.scheduler = scheduler
         self.default_deadline_s = float(default_deadline_s)
         self.rate_window_s = float(rate_window_s)
@@ -66,6 +97,26 @@ class BatchingEngine:
         self._inflight: Dict[int, _Request] = {}
         self._tokens: Deque = collections.deque()   # (ts, n) for tokens/s
         self._running = True
+        # --- black box + watchdog ------------------------------------------
+        self.flight = obs_flight.FlightRecorder(
+            "serving_engine", capacity=int(flight_records))
+        self._flight_path = None
+        if flight_dir:
+            # the fallback dir is args.log_file_dir, whose schema default
+            # is '~/...' — without expansion the dump lands in a literal
+            # './~/' directory and the post-mortem artifact goes missing
+            self._flight_path = os.path.join(
+                os.path.expanduser(flight_dir),
+                f"flight_serving_engine_{os.getpid()}.jsonl")
+        self.last_progress_ts = time.time()
+        self.watchdog = obs_flight.Watchdog(
+            "serving_engine", self._watchdog_probe, recorder=self.flight,
+            stall_s=float(watchdog_s), dump_path=self._flight_path)
+        self.watchdog.start()
+        # shared decode-step block span (bare handle, worker thread only)
+        self._steps_span = None
+        self._steps_in_span = 0
+        self._span_tokens = 0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-batch-engine")
         self._thread.start()
@@ -74,13 +125,21 @@ class BatchingEngine:
     def submit(self, prompt_ids, max_new_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0,
                adapter_idx: int = 0,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               parent: Any = None) -> Future:
         """Enqueue one request; the future resolves to ``{"ids",
-        "finish_reason", "prompt_tokens", "completion_tokens"}``."""
+        "finish_reason", "prompt_tokens", "completion_tokens"}``.
+
+        ``parent`` optionally parents the request trace (a Span,
+        SpanContext, or raw traceparent string — e.g. an inbound HTTP
+        header); with no parent the request joins the submitting
+        thread's current span (the HTTP surface's ``serving.http``) or
+        roots a fresh trace."""
         if not self._running:
+            obs_metrics.record_llm_reject("engine_stopped")
             raise RuntimeError("engine stopped")
         span = obs_trace.tracer.start_span(
-            "serving.request", root=True,
+            "serving.request", parent=parent,
             attrs={"prompt_tokens": len(prompt_ids),
                    "adapter_idx": int(adapter_idx)})
         dl = self.default_deadline_s if deadline_s is None \
@@ -95,8 +154,7 @@ class BatchingEngine:
             err = ValueError(
                 f"prompt of {len(req.ids)} tokens >= max_seq_len "
                 f"{self.scheduler.cfg.max_seq_len}")
-            req.span.set_attr("error", "prompt_too_long").end()
-            req.future.set_exception(err)
+            self._reject(req, "prompt_too_long", err)
             return req.future
         ccfg = self.scheduler.cache_cfg
         need = ccfg.blocks_needed(min(len(req.ids) + req.max_new,
@@ -108,11 +166,26 @@ class BatchingEngine:
                 f"request needs {need} KV blocks, pool has only "
                 f"{ccfg.num_blocks} (raise num_blocks or shrink the "
                 "request)")
-            req.span.set_attr("error", "kv_pool_too_small").end()
-            req.future.set_exception(err)
+            self._reject(req, "kv_pool_too_small", err)
             return req.future
+        req.queue_span = obs_trace.tracer.start_span(
+            "serving.queue", parent=span)
+        # stitch: the queue phase starts when the request does — the
+        # microseconds between the two start_span calls must not read as
+        # unattributed wall in the waterfall
+        if req.queue_span.span_id is not None:
+            req.queue_span.start_ts = span.start_ts
+        self.flight.note("submit", prompt_tokens=len(req.ids),
+                         max_new=req.max_new, adapter_idx=req.adapter_idx,
+                         trace_id=span.trace_id)
         self._q.put(req)
         return req.future
+
+    def _reject(self, req: _Request, reason: str, err: Exception) -> None:
+        obs_metrics.record_llm_reject(reason)
+        self.flight.note("reject", reason=reason)
+        req.span.set_attr("error", reason).end()
+        req.future.set_exception(err)
 
     def queue_depth(self) -> int:
         return self._q.qsize() + len(self._pending)
@@ -125,6 +198,7 @@ class BatchingEngine:
                 self._admit()
                 self._evict_deadlines()
                 if not self._inflight:
+                    self._close_steps_span()  # idle: don't span the wait
                     if not self._pending:
                         try:
                             self._pending.append(self._q.get(timeout=0.05))
@@ -135,14 +209,20 @@ class BatchingEngine:
                         # request) with nothing in flight: don't busy-spin
                         time.sleep(0.005)
                     continue
+                self.last_progress_ts = time.time()  # entering the step:
+                # only a step that HANGS past stall_s reads as a stall,
+                # not a slow first-compile that returns
                 t0 = time.perf_counter()
                 toks = self.scheduler.step()
                 self._observe_step(len(toks), time.perf_counter() - t0)
                 self._collect(toks)
             except Exception:  # noqa: BLE001 — serving loop must survive
                 logger.exception("batch engine step failed")
+                self.flight.note("engine_crash")
+                self.flight.dump(self._flight_path, reason="crash")
                 self._fail_all(RuntimeError("batch engine step failed"))
         # drain on shutdown
+        self._close_steps_span()
         self._fail_all(RuntimeError("engine stopped"))
 
     def _drain_queue(self) -> None:
@@ -159,24 +239,53 @@ class BatchingEngine:
                 self._pending.popleft()
                 obs_metrics.record_llm_evict("deadline_queued")
                 req.span.add_event("evict", reason="deadline_queued")
+                self.flight.note("evict", reason="deadline_queued")
                 self._finish(req, "length")
                 continue
             if not self.scheduler.can_admit(len(req.ids), req.max_new):
                 return
             self._pending.popleft()
+            dequeue_ts = time.time()
+            if req.queue_span is not None:
+                req.queue_span.end()
+                req.queue_span = None
+            prefill_span = obs_trace.tracer.start_span(
+                "serving.prefill", parent=req.span,
+                attrs={"prompt_tokens": len(req.ids)})
+            if prefill_span.span_id is not None:
+                prefill_span.start_ts = dequeue_ts  # stitch to queue end
             try:
                 slot, first = self.scheduler.admit(
                     req.ids, adapter_idx=req.adapter_idx,
                     temperature=req.temperature, seed=req.seed,
                     max_new_tokens=req.max_new)
             except Exception as e:  # noqa: BLE001
+                prefill_span.set_attr("error", type(e).__name__).end()
                 req.span.set_attr("error", type(e).__name__).end()
                 req.future.set_exception(e)
                 continue
+            now = time.time()
+            self.last_progress_ts = now  # a slow prefill is not a stall
+            prefill_span.set_attr("slot", slot)
             req.slot = slot
+            req.admit_ts = dequeue_ts
+            req.decode_ts = now
             req.span.add_event("admit", slot=slot)
+            # first token exists the moment prefill returns: TTFT is
+            # submit -> here (queue wait + chunked prefill, Orca's SLO)
+            req.span.set_attr("ttft_s", round(now - req.submitted_ts, 6))
+            obs_metrics.record_llm_ttft(now - req.submitted_ts)
             obs_metrics.record_llm_admit()
+            self._note_kv_pool()
+            self.flight.note(
+                "admit", slot=slot,
+                queue_wait_s=round(dequeue_ts - req.submitted_ts, 6))
             self._inflight[slot] = req
+            req.decode_span = obs_trace.tracer.start_span(
+                "serving.decode", parent=req.span, attrs={"slot": slot})
+            if req.decode_span.span_id is not None:
+                req.decode_span.start_ts = now  # stitch to prefill end
+            prefill_span.end()
             self._note_tokens(1)
             if not self._append_token(req, first):
                 self._retire(req)
@@ -187,6 +296,10 @@ class BatchingEngine:
             self._finish(req, "stop")
             return False
         req.out_ids.append(int(token))
+        if (len(req.out_ids) % PROGRESS_EVERY_TOKENS == 0
+                and req.decode_span is not None):
+            req.decode_span.add_event("decode.progress",
+                                      tokens=len(req.out_ids))
         if (len(req.out_ids) >= req.max_new
                 or (req.slot is not None
                     and self.scheduler.slot_position(req.slot) + 1
@@ -210,6 +323,7 @@ class BatchingEngine:
             if req.deadline_ts is not None and now > req.deadline_ts:
                 obs_metrics.record_llm_evict("deadline")
                 req.span.add_event("evict", reason="deadline", slot=slot)
+                self.flight.note("evict", reason="deadline", slot=slot)
                 self._finish(req, "length")
                 self._retire(req)
 
@@ -218,13 +332,35 @@ class BatchingEngine:
             self._inflight.pop(req.slot, None)
             self.scheduler.release(req.slot)
             req.slot = None
+            self._note_kv_pool()
 
     def _finish(self, req: _Request, reason: str) -> None:
         if req.future.done():
             return
+        now = time.time()
         req.span.set_attr("completion_tokens", len(req.out_ids))
         req.span.set_attr("finish_reason", reason)
+        if req.admit_ts is not None:
+            queue_wait = req.admit_ts - req.submitted_ts
+            decode_wall = max(now - (req.decode_ts or req.admit_ts), 1e-9)
+            tps = len(req.out_ids) / decode_wall
+            req.span.set_attr("queue_wait_s", round(queue_wait, 6))
+            req.span.set_attr("tokens_per_s", round(tps, 2))
+            obs_metrics.record_llm_request(tps, queue_wait)
+        # the request span ends FIRST: the still-open phase span's end_ts
+        # then lands at-or-after the request's, and the report's clipping
+        # attributes the request window tail to it instead of leaving the
+        # span-emission write latency unexplained
         req.span.end()
+        if req.queue_span is not None:  # evicted before admission
+            req.queue_span.end()
+            req.queue_span = None
+        if req.decode_span is not None:
+            req.decode_span.set_attr("completion_tokens", len(req.out_ids))
+            req.decode_span.end()
+            req.decode_span = None
+        self.flight.note("finish", reason=reason,
+                         completion_tokens=len(req.out_ids))
         req.future.set_result({
             "ids": list(req.out_ids), "finish_reason": reason,
             "prompt_tokens": len(req.ids),
@@ -235,13 +371,21 @@ class BatchingEngine:
         for req in list(self._inflight.values()):
             self._retire(req)
             if not req.future.done():
-                req.span.set_attr("error", "engine_failure").end()
+                self._end_spans_on_error(req)
                 req.future.set_exception(err)
         for req in list(self._pending):
             if not req.future.done():
-                req.span.set_attr("error", "engine_failure").end()
+                self._end_spans_on_error(req)
                 req.future.set_exception(err)
         self._pending.clear()
+
+    @staticmethod
+    def _end_spans_on_error(req: _Request) -> None:
+        for sp in (req.queue_span, req.decode_span):
+            if sp is not None:
+                sp.set_attr("error", "engine_failure").end()
+        req.queue_span = req.decode_span = None
+        req.span.set_attr("error", "engine_failure").end()
 
     # ------------------------------------------------------------ metrics --
     def _note_tokens(self, n: int) -> None:
@@ -257,14 +401,122 @@ class BatchingEngine:
                     if ts >= now - self.rate_window_s)
         return total / self.rate_window_s
 
+    def _note_kv_pool(self) -> None:
+        st = self.scheduler.kv_pool_stats()
+        obs_metrics.record_llm_kv_pool(
+            st["used_blocks"], st["free_blocks"],
+            st["headroom_requests"], st["fragmentation"])
+
     def _observe_step(self, tokens_out: int, wall_s: float) -> None:
+        self.last_progress_ts = time.time()
         obs_metrics.record_llm_serving_step(
             tokens_out=tokens_out,
             occupancy=self.scheduler.active_count(),
             queue_depth=self.queue_depth(),
             tokens_per_s=self.tokens_per_s())
+        # one ITL observation per STEP: every in-flight request
+        # experienced this inter-token gap (per-step, not per-slot, so
+        # the hot loop stays one bisect regardless of occupancy)
+        obs_metrics.record_llm_itl(wall_s)
+        self.flight.note("step", tokens=tokens_out,
+                         occupancy=self.scheduler.active_count(),
+                         queue_depth=self.queue_depth(),
+                         wall_s=round(wall_s, 6),
+                         finite=bool(self.scheduler.last_step_finite))
+        self._advance_steps_span(tokens_out)
+
+    # shared decode-step block spans: the engine's side of the request
+    # trace — each block span LINKS the request spans it advanced, the
+    # same fan-in idiom async pours use for their contributing uploads
+    def _advance_steps_span(self, tokens_out: int) -> None:
+        if self._steps_span is None:
+            self._steps_span = obs_trace.tracer.start_span(
+                "serving.decode_steps", root=True)
+            self._steps_in_span = 0
+            self._span_tokens = 0
+            for req in self._inflight.values():
+                self._steps_span.add_link(req.span, slot=req.slot)
+        else:
+            # requests admitted since the block opened fan in too
+            linked = {ln["span_id"]
+                      for ln in getattr(self._steps_span, "links", ())}
+            for req in self._inflight.values():
+                ctx = req.span.context
+                if ctx is not None and ctx.span_id not in linked:
+                    self._steps_span.add_link(req.span, slot=req.slot)
+        self._steps_in_span += 1
+        self._span_tokens += tokens_out
+        if self._steps_in_span >= DECODE_SPAN_STEPS:
+            self._close_steps_span()
+
+    def _close_steps_span(self) -> None:
+        if self._steps_span is None:
+            return
+        self._steps_span.set_attr("steps", self._steps_in_span)
+        self._steps_span.set_attr("tokens", self._span_tokens)
+        self._steps_span.end()
+        self._steps_span = None
+
+    # ------------------------------------------------------------- health --
+    def _watchdog_probe(self) -> Dict[str, Any]:
+        return {"occupancy": self.scheduler.active_count(),
+                "queue_depth": self.queue_depth(),
+                "last_progress_ts": self.last_progress_ts,
+                "poisoned": not self.scheduler.last_step_finite}
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness summary for ``/healthz``: ``status`` is ``ok`` until
+        the watchdog has tripped without progress since."""
+        now = time.time()
+        age = now - self.last_progress_ts
+        status = "ok"
+        if not self._running:
+            status = "stopped"
+        elif not self.scheduler.last_step_finite:
+            status = "nan_logits"
+        elif (self.watchdog.stall_s > 0
+              and self.scheduler.active_count() > 0
+              and age > self.watchdog.stall_s):
+            status = "stalled"
+        return {"status": status,
+                "occupancy": self.scheduler.active_count(),
+                "queue_depth": self.queue_depth(),
+                "last_step_age_s": round(age, 3),
+                "steps_run": int(self.scheduler.steps_run),
+                "tokens_per_s": round(self.tokens_per_s(), 2),
+                "watchdog_trips": int(self.watchdog.trips),
+                "flight_records": len(self.flight)}
+
+    def debug_state(self) -> Dict[str, Any]:
+        """``/debug/state`` payload: the scheduler's slot matrix +
+        block-table summary and a snapshot of the waiting queue."""
+        # the engine thread mutates _pending concurrently; copying a
+        # deque mid-mutation raises RuntimeError in CPython, and exactly
+        # a busy queue is when the operator wants this endpoint
+        for _ in range(8):
+            try:
+                head = list(self._pending)[:32]
+                break
+            except RuntimeError:
+                continue
+        else:
+            head = []
+        pending = [{"prompt_tokens": len(r.ids), "max_new": r.max_new,
+                    "adapter_idx": r.adapter_idx,
+                    "waiting_s": round(time.time() - r.submitted_ts, 3)}
+                   for r in head]
+        return {"engine": self.health(),
+                "scheduler": self.scheduler.debug_state(),
+                "queue": {"depth": self.queue_depth(),
+                          "pending_head": pending}}
 
     # ------------------------------------------------------------- control --
     def stop(self) -> None:
         self._running = False
+        self.watchdog.stop()
         self._thread.join(timeout=5.0)
+        # serving has no round boundary: without this final snapshot a
+        # short session's TTFT/ITL histograms never reach the run log
+        # (the wall-clock flusher only covers sessions longer than its
+        # cadence)
+        obs_metrics.flush_final()

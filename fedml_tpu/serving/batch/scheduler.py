@@ -72,6 +72,9 @@ class DecodeScheduler:
         self._seed = np.zeros(s, np.int32)
         self._aidx = np.zeros(s, np.int32)
         self.steps_run = 0
+        # True until a decode step observes NaN/inf in an active slot's
+        # logits — the watchdog's poison signal
+        self.last_step_finite = True
         self._build_programs()
 
     # ------------------------------------------------------------ programs --
@@ -108,13 +111,18 @@ class DecodeScheduler:
                 positions=q_pos[:, None], kv_view=views,
                 adapters=adapters, lora_scale=scale)
             row = logits[:, 0]
+            # black-box poison flag: one scalar riding the same transfer
+            # as the tokens — the watchdog reads it for free (an inactive
+            # slot's row may be garbage; only active rows count)
+            finite = jnp.all(jnp.where(active[:, None],
+                                       jnp.isfinite(row), True))
             nxt = jax.vmap(sample)(row, temps, seeds, pos + 1)
             for i, (kc, vc) in enumerate(kvs):
                 kp = kp.at[i].set(kvc.scatter_token(
                     kp[i], tables, pos, kc[:, 0], active, bs, trash))
                 vp = vp.at[i].set(kvc.scatter_token(
                     vp[i], tables, pos, vc[:, 0], active, bs, trash))
-            return nxt, kp, vp
+            return nxt, finite, kp, vp
 
         def prefill_chunk(params, stack, kp, vp, table_row, tokens, p0,
                           n_valid, aidx):
@@ -220,13 +228,14 @@ class DecodeScheduler:
         jnp = self._jnp
         if not self._active.any():
             return {}
-        nxt, self._kp, self._vp = self._step_fn(
+        nxt, finite, self._kp, self._vp = self._step_fn(
             self.params, self._stack(), self._kp, self._vp,
             jnp.asarray(self._tables), jnp.asarray(self._pos),
             jnp.asarray(self._active), jnp.asarray(self._aidx),
             jnp.asarray(self._last), jnp.asarray(self._temp),
             jnp.asarray(self._seed))
         toks = np.asarray(nxt)
+        self.last_step_finite = bool(finite)
         self.steps_run += 1
         out: Dict[int, int] = {}
         for slot in np.flatnonzero(self._active):
@@ -238,3 +247,47 @@ class DecodeScheduler:
 
     def slot_position(self, slot: int) -> int:
         return int(self._pos[slot])
+
+    # ------------------------------------------------------- observability --
+    def kv_pool_stats(self) -> Dict[str, Any]:
+        """Paged-pool state for the SLO gauges: used/free blocks, how
+        many WORST-CASE (max_seq_len) requests the free list can still
+        admit, and internal fragmentation — the reserved-but-unwritten
+        fraction of allocated blocks (admission reserves prompt+max_new
+        up front, so a short generation strands block tail capacity
+        until release)."""
+        ccfg = self.cache_cfg
+        free = self.alloc.free_blocks
+        used = ccfg.num_blocks - free
+        per_req = ccfg.blocks_needed(ccfg.max_seq_len)
+        written = int(self._pos[self._active].sum()) if used else 0
+        capacity = used * ccfg.block_size
+        frag = 1.0 - written / capacity if capacity else 0.0
+        return {"used_blocks": used, "free_blocks": free,
+                "headroom_requests": free // per_req,
+                "fragmentation": round(max(frag, 0.0), 4)}
+
+    def debug_state(self) -> Dict[str, Any]:
+        """The slot matrix + block-table summary, host-side mirrors only
+        (no device sync) — the ``/debug/state`` payload."""
+        slots = []
+        for s in range(self.slots):
+            row = {"slot": s, "active": bool(self._active[s])}
+            if self._active[s]:
+                table = self._tables[s]
+                row.update({
+                    "position": int(self._pos[s]),
+                    "adapter_idx": int(self._aidx[s]),
+                    "temperature": float(self._temp[s]),
+                    "blocks": int((table != self.cache_cfg.trash_block)
+                                  .sum())})
+            slots.append(row)
+        return {"slots": slots, "steps_run": int(self.steps_run),
+                "last_step_finite": bool(self.last_step_finite),
+                "kv_pool": self.kv_pool_stats(),
+                "geometry": {
+                    "num_slots": self.slots,
+                    "block_size": self.cache_cfg.block_size,
+                    "num_blocks": self.cache_cfg.num_blocks,
+                    "max_seq_len": self.cfg.max_seq_len,
+                    "prefill_chunk": self.prefill_chunk}}

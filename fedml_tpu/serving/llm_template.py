@@ -120,11 +120,30 @@ class CausalLMPredictor(FedMLPredictor):
             prefill_chunk=int(opts.get("prefill_chunk", 32)))
         self._engine = BatchingEngine(
             scheduler,
-            default_deadline_s=float(opts.get("deadline_s", 0.0)))
+            default_deadline_s=float(opts.get("deadline_s", 0.0)),
+            watchdog_s=float(opts.get("watchdog_s", 30.0)),
+            flight_records=int(opts.get("flight_records", 256)),
+            flight_dir=opts.get("flight_dir"))
 
     @property
     def adapter_bank(self):
         return self._bank
+
+    @property
+    def engine(self):
+        return self._engine
+
+    def health(self) -> Dict[str, Any]:
+        """``/healthz`` payload: the engine's watchdog view in batch
+        mode; the single path is stateless, so up == ok."""
+        if self._engine is not None:
+            return self._engine.health()
+        return {"status": "ok", "mode": "single"}
+
+    def debug_state(self) -> Dict[str, Any]:
+        if self._engine is not None:
+            return self._engine.debug_state()
+        return {"mode": "single", "max_seq_len": self.max_seq_len}
 
     def close(self) -> None:
         if self._engine is not None:
@@ -155,6 +174,13 @@ class CausalLMPredictor(FedMLPredictor):
                                             0.0)),
                 "request_timeout_s": float(
                     getattr(args, "serving_request_timeout_s", 120.0)),
+                "watchdog_s": float(getattr(args, "serving_watchdog_s",
+                                            30.0)),
+                "flight_records": int(getattr(args,
+                                              "serving_flight_records",
+                                              256)),
+                "flight_dir": (getattr(args, "serving_flight_dir", None)
+                               or getattr(args, "log_file_dir", None)),
             })
             adapter_dir = getattr(args, "llm_adapter_dir", None)
             if adapter_dir and kw.get("adapter_bank") is None:
@@ -240,6 +266,10 @@ class CausalLMPredictor(FedMLPredictor):
                 "loaded (full fine-tune artifact without llm_adapter_dir)")
         aidx = (self._bank.index(adapter) if adapter is not None
                 else self._default_aidx)
+        from ..core.obs import metrics as obs_metrics
+        obs_metrics.record_llm_adapter(
+            adapter if adapter is not None
+            else ("default" if self._default_aidx else "base"))
         fut = self._engine.submit(ids, max_new_tokens=int(max_new_tokens),
                                   temperature=temp, seed=seed,
                                   adapter_idx=aidx)

@@ -46,6 +46,14 @@ def main() -> None:
     from . import CheckpointPredictor, FedMLInferenceRunner
 
     args = SimpleNamespace(**spec["args"])
+    # a replica is a first-class observability citizen: its own JSONL
+    # sink (run_<id>.jsonl, distinguished by pid-suffixed run_id so
+    # sibling replicas never interleave one file), the obs knobs from
+    # the spec's flat config, and — in batch mode — the engine's flight
+    # recorder dumping on SIGTERM (the platform's shutdown signal)
+    from fedml_tpu.core import mlops
+    args.run_id = f"{getattr(args, 'run_id', '0')}_replica{os.getpid()}"
+    mlops.init(args)
     if spec.get("kind") == "causal_lm":
         # LLM template replica: chat route mounted, artifact + bundle
         # rebuilt from the spec's flat config
@@ -53,6 +61,10 @@ def main() -> None:
         predictor = CausalLMPredictor.from_artifact(
             args, spec["params_path"])
         runner = ChatCompletionRunner(predictor)
+        if predictor.engine is not None:
+            from fedml_tpu.core.obs import flight as obs_flight
+            obs_flight.install_signal_dump(
+                predictor.engine.flight, predictor.engine._flight_path)
     else:
         predictor = CheckpointPredictor.from_files(
             args, spec["params_path"], int(spec["output_dim"]))

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Compare two bench result files and gate on regressions.
+
+Pre-merge usage (documented in README "Benchmarks"): run ``bench.py``
+before and after a change, then
+
+    python scripts/bench_diff.py BENCH_r05.json /tmp/bench_new.json
+    python scripts/bench_diff.py old.json new.json --threshold 0.05
+
+Accepted file shapes (auto-detected per file):
+
+* a ``BENCH_r*.json`` wrapper (``{"tail": "<bench stdout>"}``) — metric
+  lines are parsed out of the captured stdout;
+* raw ``bench.py`` stdout (one ``{"metric": ..., "value": ...}`` JSON
+  object per line, non-JSON lines ignored);
+* a single JSON object/array of such metric objects.
+
+For every metric present in both files the tool prints the old/new
+values and the delta; nested ``legs`` dicts (e.g. the serving sweep's
+per-concurrency entries) are flattened to ``metric.leg.field`` rows.
+Direction is inferred from the metric name — ``*_s`` / ``*seconds`` /
+``*bytes*`` / ``*latency*`` are lower-is-better, everything else
+(rounds/hour, tokens/s, MFU, accuracy) higher-is-better. Exits 1 when
+any metric regresses past ``--threshold`` (relative), so a CI step can
+gate merges on the bench trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# matched against the LAST dotted component (the leg field for
+# flattened rows); throughput-ish markers win over the `_s` suffix so
+# "tokens_per_s" reads as higher-is-better while "p99_latency_s" and
+# "time_to_90pct_s" read as lower-is-better
+HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup")
+LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles",
+                 "time_to", "step_time", "wall", "round_s")
+
+
+def _wrapper_rc(path: str) -> Optional[int]:
+    """The recorded exit code of a ``BENCH_r*.json`` wrapper, if any.
+    A bench that crashed partway still leaves parseable metric lines in
+    its tail — comparing only those would let the gate pass a change
+    that broke the bench itself."""
+    try:
+        obj = json.loads(open(path).read())
+    except ValueError:
+        return None
+    if isinstance(obj, dict) and "tail" in obj and "rc" in obj:
+        try:
+            return int(obj["rc"])
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def _metric_objects(path: str) -> List[dict]:
+    with open(path) as f:
+        text = f.read()
+    # wrapper file: {"tail": "<stdout>"} (the BENCH_r*.json layout)
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict) and "tail" in obj:
+            text = obj["tail"]
+        elif isinstance(obj, dict) and "metric" in obj:
+            return [obj]
+        elif isinstance(obj, list):
+            return [o for o in obj
+                    if isinstance(o, dict) and "metric" in o]
+    except ValueError:
+        pass
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    return out
+
+
+def flatten(path: str) -> Dict[str, float]:
+    """File -> ``{row_name: value}``: the headline value per metric plus
+    every numeric field of a nested ``legs`` dict."""
+    rows: Dict[str, float] = {}
+    for rec in _metric_objects(path):
+        name = str(rec["metric"])
+        v = rec.get("value")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            rows[name] = float(v)
+        legs = rec.get("legs")
+        if isinstance(legs, dict):
+            for leg, ent in legs.items():
+                if isinstance(ent, (int, float)) \
+                        and not isinstance(ent, bool):
+                    rows[f"{name}.{leg}"] = float(ent)
+                elif isinstance(ent, dict):
+                    for k, lv in ent.items():
+                        if isinstance(lv, (int, float)) \
+                                and not isinstance(lv, bool):
+                            rows[f"{name}.{leg}.{k}"] = float(lv)
+    return rows
+
+
+def lower_is_better(name: str) -> bool:
+    probe = name.rsplit(".", 1)[-1].lower()
+    if any(m in probe for m in HIGHER_MARKERS):
+        return False
+    return probe.endswith("_s") \
+        or any(m in probe for m in LOWER_MARKERS)
+
+
+def diff(old: Dict[str, float], new: Dict[str, float],
+         threshold: float, out=sys.stdout) -> int:
+    common = sorted(set(old) & set(new))
+    if not common:
+        print("no common metrics between the two files", file=out)
+        return 2
+    hdr = (f"{'metric':<58} {'old':>12} {'new':>12} {'delta%':>8}  "
+           f"verdict")
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    regressions: List[Tuple[str, float]] = []
+    for name in common:
+        o, n = old[name], new[name]
+        if o == 0:
+            rel = 0.0 if n == 0 else float("inf")
+        else:
+            rel = (n - o) / abs(o)
+        lower = lower_is_better(name)
+        regressed = rel > threshold if lower else rel < -threshold
+        improved = rel < -threshold if lower else rel > threshold
+        verdict = ("REGRESSED" if regressed
+                   else "improved" if improved else "")
+        if regressed:
+            regressions.append((name, rel))
+        print(f"{name:<58} {o:>12.4g} {n:>12.4g} {100 * rel:>7.1f}%  "
+              f"{verdict}", file=out)
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        print(f"\nonly in old ({len(only_old)}): "
+              + ", ".join(only_old[:8])
+              + (" ..." if len(only_old) > 8 else ""), file=out)
+    if only_new:
+        print(f"only in new ({len(only_new)}): " + ", ".join(only_new[:8])
+              + (" ..." if len(only_new) > 8 else ""), file=out)
+    if regressions:
+        print(f"\nFAIL: {len(regressions)} metric(s) regressed past "
+              f"{100 * threshold:.0f}%:", file=out)
+        for name, rel in regressions:
+            print(f"  {name}: {100 * rel:+.1f}%", file=out)
+        return 1
+    print(f"\nOK: no regression past {100 * threshold:.0f}% across "
+          f"{len(common)} compared metrics", file=out)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("old", help="baseline bench file (e.g. BENCH_r05.json)")
+    ap.add_argument("new", help="candidate bench file")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression gate (default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    rc_fail = 0
+    for label, path in (("old", args.old), ("new", args.new)):
+        rc = _wrapper_rc(path)
+        if rc:
+            print(f"FAIL: {label} bench file {path} records a non-zero "
+                  f"bench exit code (rc={rc}) — its metrics are not "
+                  "trustworthy", file=sys.stderr)
+            rc_fail = 1
+    verdict = diff(flatten(args.old), flatten(args.new), args.threshold)
+    return verdict or rc_fail
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Serving-plane flight report: per-request waterfalls + SLO percentiles.
+
+The serving sibling of ``trace_report.py``: reads run JSONLs (the mlops
+sink's ``run_<id>.jsonl`` — pass the replica's file, or every process's
+for a gateway session; spans carry trace/span IDs so trees reassemble
+across files), rebuilds each ``serving.request`` trace, and prints
+
+* one waterfall row per request — wall time split into queue wait /
+  chunked prefill / decode (the engine's ``serving.queue`` /
+  ``serving.prefill`` / ``serving.decode`` child spans), TTFT,
+  per-request tokens/s, finish reason, and the attributed fraction
+  (the ≥95% acceptance bar: unattributed time is wall no span explains);
+* a TTFT/ITL/queue-wait percentile table — TTFT and queue wait exact
+  from the request spans, inter-token latency from the last
+  ``metrics_snapshot``'s ``llm_inter_token_seconds`` histogram
+  (linear interpolation within buckets).
+
+    python scripts/serving_report.py ~/.cache/fedml_tpu/logs/run_0.jsonl
+    python scripts/serving_report.py run.jsonl --min-attr 0.95
+    python scripts/serving_report.py run.jsonl --trace 4f2a
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+# request-lifecycle phases, in waterfall order (keep in sync with
+# fedml_tpu/core/obs/schema.py SERVING_SPAN_NAMES)
+PHASES = ("serving.queue", "serving.prefill", "serving.decode")
+
+
+def load_records(paths: List[str]) -> Tuple[List[dict], List[dict]]:
+    spans, snapshots = [], []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                kind = rec.get("kind")
+                if kind == "span":
+                    spans.append(rec)
+                elif kind == "metrics_snapshot":
+                    snapshots.append(rec)
+    return spans, snapshots
+
+
+def union_len(intervals: List[Tuple[float, float]]) -> float:
+    total, end = 0.0, -float("inf")
+    for s, e in sorted(intervals):
+        if e <= end:
+            continue
+        total += e - max(s, end)
+        end = e
+    return total
+
+
+def exact_pct(values: List[float], q: float) -> float:
+    """Nearest-rank percentile over raw values."""
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, int(q * (len(vs) - 1) + 0.5))]
+
+
+def hist_pct(buckets: List[float], counts: List[int], q: float
+             ) -> Optional[float]:
+    """Approximate percentile from per-bucket counts (len(buckets)+1,
+    +Inf last) by linear interpolation inside the winning bucket."""
+    total = sum(counts)
+    if not total:
+        return None
+    target = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        hi = buckets[i] if i < len(buckets) else buckets[-1]
+        if cum + c >= target and c > 0:
+            frac = (target - cum) / c
+            return lo + frac * (hi - lo)
+        cum += c
+        lo = hi
+    return buckets[-1]
+
+
+def analyze_request(root: dict, children: List[dict]) -> Dict[str, Any]:
+    lo, hi = float(root["start_ts"]), float(root["end_ts"])
+    wall = max(hi - lo, 1e-12)
+    phase_s: Dict[str, float] = {}
+    covered: List[Tuple[float, float]] = []
+    for c in children:
+        s = max(float(c["start_ts"]), lo)
+        e = min(float(c["end_ts"]), hi)
+        if e <= s:
+            continue
+        covered.append((s, e))
+        phase_s[c["name"]] = phase_s.get(c["name"], 0.0) + (e - s)
+    attrs = root.get("attrs", {}) or {}
+    return {
+        "trace_id": root["trace_id"],
+        "wall_s": wall,
+        "phases": phase_s,
+        "attributed_frac": min(union_len(covered) / wall, 1.0),
+        "prompt_tokens": attrs.get("prompt_tokens"),
+        "completion_tokens": attrs.get("completion_tokens"),
+        "finish_reason": attrs.get("finish_reason",
+                                   attrs.get("error", "?")),
+        "ttft_s": attrs.get("ttft_s"),
+        "queue_wait_s": attrs.get("queue_wait_s"),
+        "tokens_per_s": attrs.get("tokens_per_s"),
+    }
+
+
+def last_itl_histogram(snapshots: List[dict]
+                       ) -> Optional[Tuple[List[float], List[int]]]:
+    for snap in reversed(snapshots):
+        inst = (snap.get("metrics") or {}).get("llm_inter_token_seconds")
+        if inst and inst.get("values"):
+            v = inst["values"][0]
+            return list(v["buckets"]), list(v["counts"])
+    return None
+
+
+def print_report(spans: List[dict], snapshots: List[dict],
+                 only_trace: Optional[str], min_attr: float,
+                 out=sys.stdout) -> int:
+    by_parent: Dict[str, List[dict]] = defaultdict(list)
+    for s in spans:
+        if s.get("parent_id"):
+            by_parent[s["parent_id"]].append(s)
+    requests = [s for s in spans
+                if s.get("name") == "serving.request"
+                and (only_trace is None
+                     or s["trace_id"].startswith(only_trace))]
+    if not requests:
+        print("no serving.request spans found", file=out)
+        return 1
+    requests.sort(key=lambda s: s["start_ts"])
+    rows = [analyze_request(r, by_parent.get(r["span_id"], []))
+            for r in requests]
+
+    hdr = (f"{'request':<22} {'wall_s':>8} {'queue':>8} {'prefill':>8} "
+           f"{'decode':>8} {'ttft_s':>7} {'tok/s':>7} {'finish':>8} "
+           f"{'attr%':>6}  trace")
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    worst = 1.0
+    for a in rows:
+        worst = min(worst, a["attributed_frac"])
+        p = a["phases"]
+        label = (f"{a['prompt_tokens'] or '?'}tok"
+                 f"->{a['completion_tokens'] if a['completion_tokens'] is not None else '?'}tok")
+        ttft = a["ttft_s"]
+        tps = a["tokens_per_s"]
+        print(f"{label:<22} {a['wall_s']:>8.4f} "
+              f"{p.get('serving.queue', 0.0):>8.4f} "
+              f"{p.get('serving.prefill', 0.0):>8.4f} "
+              f"{p.get('serving.decode', 0.0):>8.4f} "
+              f"{ttft if ttft is not None else float('nan'):>7.3f} "
+              f"{tps if tps is not None else float('nan'):>7.1f} "
+              f"{str(a['finish_reason']):>8} "
+              f"{100.0 * a['attributed_frac']:>5.1f}%  "
+              f"{a['trace_id'][:12]}", file=out)
+
+    # --- SLO percentile table ------------------------------------------
+    print(file=out)
+    ttfts = [a["ttft_s"] for a in rows if a["ttft_s"] is not None]
+    waits = [a["queue_wait_s"] for a in rows
+             if a["queue_wait_s"] is not None]
+    walls = [a["wall_s"] for a in rows]
+    qs = (0.50, 0.90, 0.99)
+    header = f"{'SLO':<26} " + " ".join(f"p{int(q * 100):>2}".rjust(9)
+                                        for q in qs)
+    print(header, file=out)
+    print("-" * len(header), file=out)
+
+    def slo_row(name: str, vals: Optional[List[float]],
+                approx: bool = False) -> None:
+        if not vals:
+            print(f"{name:<26} " + " ".join(["      n/a"] * len(qs)),
+                  file=out)
+            return
+        cells = " ".join(f"{exact_pct(vals, q):>9.4f}" for q in qs)
+        print(f"{name:<26}{'~' if approx else ' '}{cells}", file=out)
+
+    slo_row("ttft_s (exact, spans)", ttfts)
+    slo_row("queue_wait_s (exact)", waits)
+    slo_row("request_wall_s (exact)", walls)
+    itl = last_itl_histogram(snapshots)
+    if itl is not None:
+        buckets, counts = itl
+        cells = []
+        for q in qs:
+            v = hist_pct(buckets, counts, q)
+            cells.append(f"{v:>9.5f}" if v is not None else "      n/a")
+        print(f"{'itl_s (histogram)':<26}~" + " ".join(cells), file=out)
+    else:
+        print(f"{'itl_s (histogram)':<26}  no metrics_snapshot with "
+              "llm_inter_token_seconds", file=out)
+
+    n = len(rows)
+    mean_attr = sum(a["attributed_frac"] for a in rows) / n
+    print(f"\n{n} requests; attribution mean {100 * mean_attr:.1f}%, "
+          f"min {100 * worst:.1f}%", file=out)
+    if min_attr > 0 and worst < min_attr:
+        print(f"FAIL: minimum attribution {100 * worst:.1f}% < "
+              f"{100 * min_attr:.0f}% — request wall no span explains",
+              file=out)
+        return 2
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("logs", nargs="+",
+                    help="run JSONL file(s) — pass every process's log")
+    ap.add_argument("--trace", default=None,
+                    help="only requests in this trace id (prefix match)")
+    ap.add_argument("--min-attr", type=float, default=0.0,
+                    help="exit 2 if any request's attributed fraction "
+                         "is below this (e.g. 0.95)")
+    args = ap.parse_args(argv)
+    spans, snapshots = load_records(args.logs)
+    return print_report(spans, snapshots, args.trace, args.min_attr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ import pytest
 
 from fedml_tpu.arguments import Arguments
 from fedml_tpu.core import mlops, obs
+from fedml_tpu.core.obs import flight as obs_flight
 from fedml_tpu.core.obs import metrics as obs_metrics
 from fedml_tpu.core.obs import profiler as obs_profiler
 from fedml_tpu.core.obs import schema as obs_schema
@@ -273,6 +274,129 @@ class TestMetrics:
             obs_metrics.set_enabled(True)
 
 
+class TestWallClockFlusher:
+    def test_flushes_without_round_boundaries(self, tmp_path):
+        """Serving / cross-device / agents never call log_round_info:
+        the wall-clock cadence must snapshot their metrics anyway."""
+        path = _init_sink(tmp_path, "wall_f", obs_metrics_flush_s=0.3)
+        obs_metrics.REGISTRY.counter("t_wall_total").inc(3)
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if _read_records(path, "metrics_snapshot"):
+                break
+            time.sleep(0.05)
+        snaps = _read_records(path, "metrics_snapshot")
+        assert snaps, "no wall-clock metrics_snapshot within 5 s"
+        assert "t_wall_total" in snaps[-1]["metrics"]
+        assert not obs_schema.validate_record(snaps[-1])
+
+    def test_idle_process_stays_silent(self, tmp_path):
+        """No instrument change since the last snapshot → no re-emission
+        (a fleet of idle replicas must not spam identical snapshots)."""
+        path = _init_sink(tmp_path, "wall_idle", obs_metrics_flush_s=0.2)
+        obs_metrics.REGISTRY.counter("t_idle_total").inc()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not _read_records(
+                path, "metrics_snapshot"):
+            time.sleep(0.05)
+        n = len(_read_records(path, "metrics_snapshot"))
+        assert n >= 1
+        time.sleep(0.7)   # several cadences with zero activity
+        assert len(_read_records(path, "metrics_snapshot")) == n
+
+    def test_zero_disables(self, tmp_path):
+        path = _init_sink(tmp_path, "wall_off", obs_metrics_flush_s=0)
+        obs_metrics.REGISTRY.counter("t_off_total").inc()
+        time.sleep(0.4)
+        assert not _read_records(path, "metrics_snapshot")
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_dump_validates(self, tmp_path):
+        _init_sink(tmp_path, "fl_ring")
+        rec = obs_flight.FlightRecorder("t_engine", capacity=8)
+        for i in range(20):
+            rec.note("step", tokens=i, occupancy=2)
+        assert len(rec) == 8   # bounded: only the last moments survive
+        path = rec.dump(str(tmp_path / "flight.jsonl"))
+        lines = open(path).read().splitlines()
+        assert len(lines) == 8
+        problems = obs_schema.validate_lines(lines)
+        assert not problems, problems
+        recs = [json.loads(l) for l in lines]
+        assert [r["seq"] for r in recs] == sorted(r["seq"] for r in recs)
+        assert recs[-1]["data"]["tokens"] == 19  # newest kept
+        assert all(r["component"] == "t_engine" for r in recs)
+
+    def test_empty_ring_dumps_nothing(self, tmp_path):
+        rec = obs_flight.FlightRecorder("t_empty")
+        assert rec.dump(str(tmp_path / "nope.jsonl")) is None
+        assert not os.path.exists(tmp_path / "nope.jsonl")
+
+    def test_log_health_record_validates(self, tmp_path):
+        path = _init_sink(tmp_path, "fl_health")
+        mlops.log_health("serving_engine", "stalled",
+                         detail={"occupancy": 3})
+        rec = _read_records(path, "health")[-1]
+        assert not obs_schema.validate_record(rec)
+        assert rec["component"] == "serving_engine"
+        assert rec["status"] == "stalled"
+
+
+class TestWatchdog:
+    def _state(self, **kw):
+        base = {"occupancy": 2, "last_progress_ts": time.time(),
+                "poisoned": False}
+        base.update(kw)
+        return base
+
+    def test_stall_trip_dump_and_rearm(self, tmp_path):
+        path = _init_sink(tmp_path, "wd_stall")
+        rec = obs_flight.FlightRecorder("t_wd", capacity=4)
+        rec.note("step", tokens=1)
+        state = self._state(last_progress_ts=time.time() - 99)
+        dump = str(tmp_path / "wd_flight.jsonl")
+        wd = obs_flight.Watchdog("t_wd", lambda: state, recorder=rec,
+                                 stall_s=1.0, dump_path=dump)
+        assert wd.check() == "stalled"
+        assert wd.trips == 1
+        # same episode: no re-trip, no second dump spam
+        assert wd.check() is None
+        # the black box landed and validates line by line
+        assert not obs_schema.validate_lines(
+            open(dump).read().splitlines())
+        # progress resumes -> re-arms -> a NEW stall trips again
+        state["last_progress_ts"] = time.time()
+        assert wd.check() is None
+        state["last_progress_ts"] = time.time() - 99
+        assert wd.check() == "stalled"
+        assert wd.trips == 2
+        # trips flowed to the registry and the health record stream
+        c = obs_metrics.REGISTRY.counter("obs_watchdog_trips_total",
+                                         labels=("component", "reason"))
+        assert c.value(component="t_wd", reason="stalled") >= 2
+        healths = _read_records(path, "health")
+        assert healths and healths[-1]["status"] == "stalled"
+        assert not obs_schema.validate_record(healths[-1])
+
+    def test_nan_trips_even_with_progress(self):
+        state = self._state(poisoned=True)
+        wd = obs_flight.Watchdog("t_nan", lambda: state, stall_s=1.0)
+        assert wd.check() == "nan_logits"
+
+    def test_idle_engine_never_trips(self):
+        state = self._state(occupancy=0,
+                            last_progress_ts=time.time() - 999)
+        wd = obs_flight.Watchdog("t_idle", lambda: state, stall_s=1.0)
+        assert wd.check() is None
+
+    def test_probe_failure_is_survivable(self):
+        def boom():
+            raise RuntimeError("probe exploded")
+        wd = obs_flight.Watchdog("t_boom", boom, stall_s=1.0)
+        assert wd.check() is None  # no trip, no raise
+
+
 class TestProfiler:
     def test_peak_table_and_mfu_math(self):
         class Dev:
@@ -408,6 +532,7 @@ class TestSchemaReplay:
                             target_n=2)
         mlops.log_training_status("RUNNING")
         mlops.log_model_info(0, "/tmp/x")
+        mlops.log_health("serving_engine", "ok", detail={"occupancy": 0})
         mlops.log({"acc": 0.5}, step=0)
         with mlops.event("probe", round_idx=0):
             pass
@@ -573,7 +698,9 @@ class TestOverhead:
         mlops.init(off_args)
         block()
         on_t, off_t = [], []
-        for _ in range(5):
+        for _ in range(8):   # min-of-8: this box's scheduler noise spans
+            # 2-3x on a bad minute; more interleaved pairs beat a wider
+            # tolerance (the 2% bound is the acceptance criterion)
             mlops.init(off_args)
             t0 = time.perf_counter()
             block()
@@ -587,6 +714,64 @@ class TestOverhead:
         assert best_on <= best_off * 1.02 + 0.004, (
             f"tracking-on dispatch {best_on:.4f}s vs off {best_off:.4f}s "
             f"(> 2% + 4ms): on={on_t} off={off_t}")
+
+
+class TestBenchDiff:
+    def _mod(self):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "scripts"))
+        import bench_diff
+        return bench_diff
+
+    def _write(self, tmp_path, name, lines):
+        p = tmp_path / name
+        p.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+        return str(p)
+
+    def test_direction_inference_and_gate(self, tmp_path):
+        bd = self._mod()
+        old = self._write(tmp_path, "old.jsonl", [
+            {"metric": "x_rounds_per_hour", "value": 100.0},
+            {"metric": "x_time_to_90pct_s", "value": 10.0},
+            {"metric": "llm_serving_tokens_per_s", "value": 500.0,
+             "legs": {"batched_c8": {"tokens_per_s": 400.0,
+                                     "p99_latency_s": 0.5}}}])
+        # throughput up + latency down = all improvements -> rc 0
+        good = self._write(tmp_path, "good.jsonl", [
+            {"metric": "x_rounds_per_hour", "value": 150.0},
+            {"metric": "x_time_to_90pct_s", "value": 8.0},
+            {"metric": "llm_serving_tokens_per_s", "value": 600.0,
+             "legs": {"batched_c8": {"tokens_per_s": 480.0,
+                                     "p99_latency_s": 0.4}}}])
+        io_ = io.StringIO()
+        assert bd.diff(bd.flatten(old), bd.flatten(good), 0.10,
+                       out=io_) == 0
+        # throughput DOWN past threshold -> rc 1, named in the summary
+        bad = self._write(tmp_path, "bad.jsonl", [
+            {"metric": "x_rounds_per_hour", "value": 50.0},
+            {"metric": "x_time_to_90pct_s", "value": 10.0}])
+        io_ = io.StringIO()
+        assert bd.diff(bd.flatten(old), bd.flatten(bad), 0.10,
+                       out=io_) == 1
+        assert "x_rounds_per_hour" in io_.getvalue()
+        assert "REGRESSED" in io_.getvalue()
+
+    def test_reads_bench_wrapper_tail(self, tmp_path):
+        bd = self._mod()
+        wrapper = tmp_path / "BENCH_x.json"
+        wrapper.write_text(json.dumps({
+            "rc": 0, "tail": 'noise\n'
+            + json.dumps({"metric": "m_rounds_per_hour",
+                          "value": 7.0}) + "\n"}))
+        assert bd.flatten(str(wrapper)) == {"m_rounds_per_hour": 7.0}
+
+    def test_disjoint_files_exit_2(self, tmp_path):
+        bd = self._mod()
+        a = self._write(tmp_path, "a.jsonl", [{"metric": "a", "value": 1}])
+        b = self._write(tmp_path, "b.jsonl", [{"metric": "b", "value": 1}])
+        assert bd.diff(bd.flatten(a), bd.flatten(b), 0.1,
+                       out=io.StringIO()) == 2
 
 
 class TestTraceReport:

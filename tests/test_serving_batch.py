@@ -526,14 +526,17 @@ class TestSeeds:
 
 class TestGatewayTail:
     def test_metrics_expose_p50_p99_and_legacy_unpack(self):
+        """The dedupe regression pin: gateway tail stats come from the
+        ONE shared core/obs LatencyWindow, and the legacy ``(qps, mean)``
+        tuple-unpack of metrics() still works."""
         from fedml_tpu.serving.autoscale import Gateway
         gw = Gateway.__new__(Gateway)
         gw.window_s = 60.0
         gw._lock = threading.Lock()
-        from collections import deque
+        gw._window = obs_metrics.LatencyWindow(window_s=60.0)
         now = time.time()
-        lats = [0.01] * 98 + [0.5, 2.0]
-        gw._events = deque((now, l) for l in lats)
+        for l in [0.01] * 98 + [0.5, 2.0]:
+            gw._window.observe(l, ts=now)
         m = gw.metrics()
         assert m.p50 == 0.01
         assert m.p99 == 0.5           # nearest-rank tail the mean hides
@@ -541,6 +544,19 @@ class TestGatewayTail:
         qps, lat = m                  # legacy tuple unpack still works
         assert (qps, lat) == (m.qps, m.latency_s)
         assert m.signal("p99") == m.p99
+
+    def test_gateway_window_is_the_shared_implementation(self):
+        """One source of truth: a live Gateway's window IS the core/obs
+        LatencyWindow (no parallel percentile code path to drift)."""
+        from fedml_tpu.serving.autoscale import Gateway
+
+        class _RS:
+            def ports(self):
+                return []
+        gw = Gateway(_RS(), window_s=3.0)
+        assert isinstance(gw._window, obs_metrics.LatencyWindow)
+        assert gw._window.window_s == 3.0
+        assert gw.metrics().count == 0
 
     def test_autoscaler_feeds_declared_latency_signal(self):
         from fedml_tpu.serving.autoscale import (Autoscaler,
@@ -742,3 +758,402 @@ class TestConcurrencySoak:
             assert xla_compile_counter.delta() == 0
         finally:
             batched.close()
+
+
+# -------------------------------- serving observability plane (ISSUE 10) ----
+
+class _WedgeScheduler:
+    """Duck-typed scheduler whose step() blocks until released — the
+    deliberately wedged engine the watchdog/flight-recorder acceptance
+    test needs, without burning a compile."""
+
+    def __init__(self):
+        from types import SimpleNamespace
+        self.cfg = SimpleNamespace(max_seq_len=64)
+        self.cache_cfg = SimpleNamespace(
+            num_blocks=16, max_seq_len=64,
+            blocks_needed=lambda n: 1)
+        self.release_evt = threading.Event()
+        self.last_step_finite = True
+        self.steps_run = 0
+        self._active = 0
+
+    def can_admit(self, prompt_len, max_new):
+        return self._active == 0
+
+    def admit(self, ids, **kw):
+        from fedml_tpu.llm.data import EOS
+        self._active = 1
+        return 0, EOS + 4   # slot 0, a non-EOS first token
+
+    def release(self, slot):
+        self._active = 0
+
+    def step(self):
+        self.steps_run += 1
+        self.release_evt.wait(timeout=30)
+        return {}
+
+    def active_count(self):
+        return self._active
+
+    def slot_position(self, slot):
+        return 5
+
+    def kv_pool_stats(self):
+        return {"used_blocks": 1, "free_blocks": 15,
+                "headroom_requests": 3, "fragmentation": 0.5}
+
+    def debug_state(self):
+        return {"slots": [{"slot": 0, "active": bool(self._active)}],
+                "kv_pool": self.kv_pool_stats()}
+
+
+class TestServingTraces:
+    def _report_mod(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "scripts"))
+        import serving_report
+        return serving_report
+
+    def test_e2e_traces_schema_valid_and_95pct_attributed(
+            self, predictors, tmp_path):
+        """The acceptance pin: an 8-concurrent-request session (one
+        deadline eviction) produces schema-valid traces whose waterfalls
+        attribute >=95% of each request's submit->finish wall to named
+        spans, reconstructed by scripts/serving_report.py."""
+        import json
+        import os
+        from fedml_tpu.core import mlops
+        from fedml_tpu.core.obs import schema as obs_schema
+        _, batched = predictors
+        eng = batched.engine
+        mlops.init(Arguments(log_file_dir=str(tmp_path), run_id="trc"))
+        try:
+            # the evictee first (so it owns a slot): long budget, short
+            # leash -> deadline eviction mid-decode
+            evict_fut = eng.submit(list(range(4, 22)), max_new_tokens=40,
+                                   deadline_s=0.05)
+            with cf.ThreadPoolExecutor(7) as ex:
+                gens = [ex.submit(batched.generate,
+                                  f"trace request number {i}",
+                                  max_new_tokens=10)
+                        for i in range(7)]
+                outs = [g.result(timeout=60) for g in gens]
+            evicted = evict_fut.result(timeout=60)
+            time.sleep(0.3)   # let the engine close its decode_steps span
+        finally:
+            mlops.init(Arguments(enable_tracking=False))
+        assert len(outs) == 7
+        assert evicted["finish_reason"] == "length"
+        assert evicted["completion_tokens"] < 40   # leash cut it short
+
+        path = os.path.join(str(tmp_path), "run_trc.jsonl")
+        lines = open(path).read().splitlines()
+        problems = obs_schema.validate_lines(lines)
+        assert not problems, problems[:10]
+        spans = [json.loads(l) for l in lines
+                 if json.loads(l).get("kind") == "span"]
+        serving_names = {s["name"] for s in spans
+                         if s["name"].startswith("serving.")}
+        assert serving_names <= obs_schema.SERVING_SPAN_NAMES, \
+            serving_names - obs_schema.SERVING_SPAN_NAMES
+        reqs = [s for s in spans if s["name"] == "serving.request"]
+        assert len(reqs) == 8
+        # the evicted request's span carries the evict event
+        assert any(ev["name"] == "evict"
+                   for s in reqs for ev in s.get("events", []))
+        # engine-side fan-in: decode_steps spans LINK the request spans
+        # they advanced (the async-pour idiom)
+        step_spans = [s for s in spans
+                      if s["name"] == "serving.decode_steps"]
+        assert step_spans
+        req_ids = {s["span_id"] for s in reqs}
+        linked = {ln["span_id"] for s in step_spans
+                  for ln in s.get("links", [])}
+        assert linked & req_ids
+        # the waterfall: >=95% of every request's wall attributed
+        sr = self._report_mod()
+        import io
+        out = io.StringIO()
+        spans_l, snaps = sr.load_records([path])
+        rc = sr.print_report(spans_l, snaps, None, 0.95, out=out)
+        assert rc == 0, out.getvalue()
+        assert "ttft_s" in out.getvalue()
+
+    def test_http_traceparent_joins_request_trace(self, predictors,
+                                                  tmp_path):
+        """An inbound W3C traceparent header parents the whole serving
+        lifecycle — serving.http AND the engine's serving.request land
+        in the caller's trace — and the response echoes the context."""
+        import json
+        import os
+        import urllib.request
+        from fedml_tpu.core import mlops
+        _, batched = predictors
+        runner = ChatCompletionRunner(batched)
+        port = runner.start()
+        trace_id = "ab" * 16
+        mlops.init(Arguments(log_file_dir=str(tmp_path), run_id="tp"))
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                data=json.dumps({
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": f"00-{trace_id}-{'cd' * 8}-01"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                echoed = r.headers.get("traceparent")
+                assert r.status == 200
+        finally:
+            runner.stop()
+            mlops.init(Arguments(enable_tracking=False))
+        assert echoed and echoed.split("-")[1] == trace_id
+        path = os.path.join(str(tmp_path), "run_tp.jsonl")
+        spans = [json.loads(l) for l in open(path) if l.strip()]
+        spans = [s for s in spans if s.get("kind") == "span"]
+        by_name = {}
+        for s in spans:
+            if s["trace_id"] == trace_id:
+                by_name.setdefault(s["name"], []).append(s)
+        assert "serving.http" in by_name, {s["name"] for s in spans}
+        assert "serving.request" in by_name
+        http_sp = by_name["serving.http"][0]
+        assert http_sp["parent_id"] == "cd" * 8  # the caller's span
+        assert by_name["serving.request"][0]["parent_id"] \
+            == http_sp["span_id"]
+
+
+class TestWatchdogFlightRecorder:
+    def test_wedged_engine_dumps_schema_valid_black_box(self, tmp_path):
+        """The acceptance pin: a deliberately wedged engine (step blocks
+        forever with occupancy > 0) trips the watchdog, and the flight-
+        recorder JSONL dump validates line by line."""
+        import json
+        import os
+        from fedml_tpu.core import mlops
+        from fedml_tpu.core.obs import schema as obs_schema
+        from fedml_tpu.serving.batch.engine import BatchingEngine
+        mlops.init(Arguments(log_file_dir=str(tmp_path), run_id="wedge"))
+        sched = _WedgeScheduler()
+        eng = BatchingEngine(sched, watchdog_s=0.3, flight_records=64,
+                             flight_dir=str(tmp_path))
+        try:
+            eng.submit([5, 6, 7], max_new_tokens=8)
+            deadline = time.time() + 15.0
+            while time.time() < deadline and eng.watchdog.trips == 0:
+                time.sleep(0.05)
+            assert eng.watchdog.trips >= 1, "watchdog never tripped"
+            assert eng.watchdog.last_trip_reason == "stalled"
+            assert eng.health()["status"] == "stalled"
+            dump = eng._flight_path
+            assert dump and os.path.exists(dump)
+            lines = open(dump).read().splitlines()
+            assert lines
+            problems = obs_schema.validate_lines(lines)
+            assert not problems, problems[:10]
+            events = [json.loads(l)["event"] for l in lines]
+            assert "submit" in events
+            assert "admit" in events
+            assert "watchdog_trip" in events
+            # the trip also landed as a health record in the run log
+            health = [json.loads(l) for l in open(
+                os.path.join(str(tmp_path), "run_wedge.jsonl"))
+                if '"health"' in l]
+            health = [h for h in health if h.get("kind") == "health"]
+            assert health and health[-1]["status"] == "stalled"
+        finally:
+            sched.release_evt.set()
+            eng.stop()
+            mlops.init(Arguments(enable_tracking=False))
+
+    def test_nan_logits_trip_and_health(self, tmp_path):
+        """NaN/inf in decode logits is a poisoned step: progress exists
+        but the output is garbage — the watchdog must still trip."""
+        from fedml_tpu.core import mlops
+        from fedml_tpu.serving.batch.engine import BatchingEngine
+        mlops.init(Arguments(log_file_dir=str(tmp_path), run_id="nan"))
+        sched = _WedgeScheduler()
+        sched.release_evt.set()   # steps return immediately
+        eng = BatchingEngine(sched, watchdog_s=0.0,  # drive check() by hand
+                             flight_records=16, flight_dir=str(tmp_path))
+        try:
+            sched.last_step_finite = False
+            assert eng.health()["status"] == "nan_logits"
+            assert eng.watchdog.check() == "nan_logits"
+            assert eng.watchdog.trips == 1
+        finally:
+            eng.stop()
+            mlops.init(Arguments(enable_tracking=False))
+
+    def test_decode_step_reports_nonfinite_logits(self, lora_setup):
+        """The real scheduler's poison flag: poisoned base params make
+        last_step_finite go False on the very next decode step."""
+        import jax.numpy as jnp
+        import jax
+        from fedml_tpu.serving.batch import DecodeScheduler
+        args, bundle, params, tok = lora_setup
+        sched = DecodeScheduler(bundle.module, bundle.cfg,
+                                bundle.base_params, None,
+                                slots=2, block_size=16, prefill_chunk=8)
+        sched.admit([5, 6, 7], max_new_tokens=4)
+        sched.step()
+        assert sched.last_step_finite
+        poisoned = jax.tree_util.tree_map(
+            lambda l: jnp.full_like(l, jnp.nan), sched.params)
+        sched.params = poisoned
+        sched.step()
+        assert not sched.last_step_finite
+
+
+class TestLiveEndpoints:
+    def test_metrics_healthz_debug_scrape_during_live_session(
+            self, predictors):
+        """The acceptance pin: during a live batched session, /metrics
+        serves Prometheus text including the TTFT and ITL histograms;
+        /healthz answers ok; /debug/state shows the slot matrix."""
+        import json
+        import urllib.request
+        _, batched = predictors
+        runner = ChatCompletionRunner(batched)
+        port = runner.start()
+        try:
+            def post(i):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    data=json.dumps({
+                        "messages": [{"role": "user",
+                                      "content": f"scrape test {i}"}],
+                        "max_tokens": 24}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return json.load(r)
+
+            with cf.ThreadPoolExecutor(4) as ex:
+                inflight = [ex.submit(post, i) for i in range(4)]
+                # scrape WHILE requests are in flight
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "text/plain")
+                    text = r.read().decode()
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=10) as r:
+                    health = json.load(r)
+                    assert r.status == 200
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/state",
+                        timeout=10) as r:
+                    debug = json.load(r)
+                outs = [f.result(timeout=60) for f in inflight]
+            assert all(o["object"] == "chat.completion" for o in outs)
+            # the SLO surface is live Prometheus text
+            assert "# TYPE llm_ttft_seconds histogram" in text
+            assert "llm_ttft_seconds_bucket" in text
+            assert "# TYPE llm_inter_token_seconds histogram" in text
+            assert "llm_inter_token_seconds_bucket" in text
+            assert "llm_kv_blocks_used" in text
+            assert "llm_queue_depth" in text
+            assert health["status"] == "ok"
+            assert "steps_run" in health
+            slots = debug["scheduler"]["slots"]
+            assert len(slots) == 4   # the fixture's slot matrix
+            assert "kv_pool" in debug["scheduler"]
+            assert "depth" in debug["queue"]
+        finally:
+            runner.stop()
+
+    def test_healthz_503_when_wedged(self, tmp_path):
+        import json
+        import urllib.error
+        import urllib.request
+        from fedml_tpu.serving import FedMLInferenceRunner
+        from fedml_tpu.serving.batch.engine import BatchingEngine
+
+        class _P:
+            def __init__(self, eng):
+                self.eng = eng
+
+            def predict(self, request):
+                return {}
+
+            def ready(self):
+                return True
+
+            def health(self):
+                return self.eng.health()
+
+            def debug_state(self):
+                return self.eng.debug_state()
+
+        sched = _WedgeScheduler()
+        eng = BatchingEngine(sched, watchdog_s=0.2,
+                             flight_dir=str(tmp_path))
+        runner = FedMLInferenceRunner(_P(eng))
+        port = runner.start()
+        try:
+            eng.submit([5, 6], max_new_tokens=4)
+            deadline = time.time() + 15.0
+            while time.time() < deadline and eng.watchdog.trips == 0:
+                time.sleep(0.05)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10)
+            assert ei.value.code == 503
+            assert json.load(ei.value)["status"] == "stalled"
+        finally:
+            sched.release_evt.set()
+            runner.stop()
+            eng.stop()
+
+
+class TestServingOverheadGate:
+    def test_tracing_metrics_on_within_three_percent_c8(
+            self, predictors, tmp_path):
+        """The CI gate the ISSUE pins: batched tokens/s with tracing +
+        metrics ON within 3% of OFF on the concurrency-8 block. One
+        engine serves both modes (hooks read process config at call
+        time), trials alternate to cancel drift, min-of-N compared with
+        a 50 ms scheduler-noise floor."""
+        from fedml_tpu.core import mlops
+        _, batched = predictors
+
+        def block():
+            with cf.ThreadPoolExecutor(8) as ex:
+                futs = [ex.submit(batched.generate,
+                                  f"overhead gate req {i}",
+                                  max_new_tokens=24)
+                        for i in range(8)]
+                outs = [f.result(timeout=120) for f in futs]
+            assert all(o["completion_tokens"] > 0 for o in outs)
+
+        on_args = Arguments(log_file_dir=str(tmp_path), run_id="s_ovh")
+        off_args = Arguments(enable_tracking=False, obs_tracing=False,
+                             obs_metrics=False)
+        try:
+            mlops.init(on_args)
+            block()                     # warmup both modes
+            mlops.init(off_args)
+            block()
+            on_t, off_t = [], []
+            for _ in range(6):
+                mlops.init(off_args)
+                t0 = time.perf_counter()
+                block()
+                off_t.append(time.perf_counter() - t0)
+                mlops.init(on_args)
+                t0 = time.perf_counter()
+                block()
+                on_t.append(time.perf_counter() - t0)
+        finally:
+            mlops.init(Arguments(enable_tracking=False))
+        best_on, best_off = min(on_t), min(off_t)
+        assert best_on <= best_off * 1.03 + 0.05, (
+            f"tracing+metrics cost {best_on:.4f}s vs {best_off:.4f}s "
+            f"(> 3% + 50ms) at c8: on={on_t} off={off_t}")
