@@ -204,6 +204,35 @@ _SCHEMA: Dict[str, Any] = {
     "serving_watchdog_s": 30.0,
     "serving_flight_records": 256,
     "serving_flight_dir": None,
+    # serving fault tolerance (crash-only recovery; ISSUE 11). A watchdog
+    # trip (decode stall / NaN logits) triggers a controlled reset:
+    # in-flight requests are snapshotted, the slot matrix + paged KV pool
+    # rebuilt (same geometry — zero recompiles), and the snapshots
+    # requeued for deterministic recompute-from-prompt. The reset budget
+    # is serving_max_resets per serving_reset_window_s; exhausted, the
+    # engine stays unhealthy (/healthz 503) and dumps its flight ring.
+    "serving_max_resets": 3,
+    "serving_reset_window_s": 300.0,
+    # per-request requeue cap: past it the request resolves with
+    # finish_reason "preempted" (partial output) instead of looping
+    "serving_max_requeues": 2,
+    # graceful degradation: preempt-and-requeue the YOUNGEST slot when
+    # the queue head has starved this long without admission (0 = off)
+    "serving_preempt_after_s": 0.0,
+    # load shedding: submit fails fast with 503 + Retry-After once the
+    # queue is this deep (0 = off — the pre-ISSUE-11 unbounded queue)
+    "serving_shed_queue_depth": 0,
+    # chaos_serving_* — seeded serving-plane fault injection (core/chaos
+    # serving kinds; all OFF by default). *_prob knobs draw per-index
+    # from the (chaos_seed, kind, index) stream; *_at_step/_at_request
+    # are the deterministic single-shot variants tests pin.
+    "chaos_serving_stall_prob": 0.0,     # per-decode-step stall draw
+    "chaos_serving_stall_s": 0.0,        # injected stall length
+    "chaos_serving_stall_at_step": None,  # stall exactly at this step
+    "chaos_serving_nan_prob": 0.0,       # per-step NaN-logit poison draw
+    "chaos_serving_nan_at_step": None,   # poison exactly at this step
+    "chaos_serving_conn_drop_prob": 0.0,  # gateway->replica connect drop
+    "chaos_serving_crash_at_request": None,  # replica dies on request N
     "llm_adapter_dir": None,           # adapter-bank manifest dir to serve
     # federated-LoRA adapter export: after run_federated_llm, write the
     # global + per-silo personalized adapters as named artifacts the

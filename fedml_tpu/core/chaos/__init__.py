@@ -10,6 +10,7 @@ defaults the simulator programs and the cross-silo wire are unchanged.
 from .interceptor import ChaosCommManager
 from .plan import (ChaosCrash, FaultLedger, FaultPlan, LinkDecision,
                    RoundFaults)
+from .serving import ServingChaosInjector
 
 __all__ = ["ChaosCommManager", "ChaosCrash", "FaultLedger", "FaultPlan",
-           "LinkDecision", "RoundFaults"]
+           "LinkDecision", "RoundFaults", "ServingChaosInjector"]

@@ -34,6 +34,16 @@ logger = logging.getLogger(__name__)
 _TAG_DROP = 11
 _TAG_STRAGGLE = 13
 _TAG_LINK = 17
+_TAG_SERVE_STEP = 19    # per-decode-step engine faults (stall / NaN)
+_TAG_SERVE_GW = 23      # per-request gateway->replica connection drops
+
+
+def _none_or_int(v: Any) -> Optional[int]:
+    # NOT `v in (None, "", False)`: 0 == False in Python, and step/request
+    # index 0 is a legal fault position (crash on the FIRST request)
+    if v is None or v == "" or v is False:
+        return None
+    return int(v)
 
 
 class ChaosCrash(RuntimeError):
@@ -86,7 +96,17 @@ class FaultPlan:
                  straggler_prob: float = 0.0, straggler_work: float = 0.5,
                  link_loss_prob: float = 0.0, link_dup_prob: float = 0.0,
                  link_delay_prob: float = 0.0, link_delay_s: float = 0.0,
-                 crash_at_round: Optional[int] = None):
+                 crash_at_round: Optional[int] = None,
+                 serving_stall_prob: float = 0.0,
+                 serving_stall_s: float = 0.0,
+                 serving_stall_at_step: Optional[int] = None,
+                 serving_nan_prob: float = 0.0,
+                 serving_nan_at_step: Optional[int] = None,
+                 serving_conn_drop_prob: float = 0.0,
+                 serving_crash_at_request: Optional[int] = None):
+        def _opt(v):
+            return None if v is None or int(v) < 0 else int(v)
+
         self.seed = int(seed)
         self.dropout_prob = float(dropout_prob)
         self.straggler_prob = float(straggler_prob)
@@ -95,9 +115,19 @@ class FaultPlan:
         self.link_dup_prob = float(link_dup_prob)
         self.link_delay_prob = float(link_delay_prob)
         self.link_delay_s = max(float(link_delay_s), 0.0)
-        self.crash_at_round = (None if crash_at_round is None
-                               or int(crash_at_round) < 0
-                               else int(crash_at_round))
+        self.crash_at_round = _opt(crash_at_round)
+        # serving fault kinds (the serving plane's analogue of link
+        # faults): injected decode stalls, NaN-logit poison, gateway->
+        # replica connection drops, and replica crash-at-request-N. Every
+        # decision is a pure function of (seed, kind, index); the *_at_*
+        # forms are the deterministic single-shot variants tests pin.
+        self.serving_stall_prob = float(serving_stall_prob)
+        self.serving_stall_s = max(float(serving_stall_s), 0.0)
+        self.serving_stall_at_step = _opt(serving_stall_at_step)
+        self.serving_nan_prob = float(serving_nan_prob)
+        self.serving_nan_at_step = _opt(serving_nan_at_step)
+        self.serving_conn_drop_prob = float(serving_conn_drop_prob)
+        self.serving_crash_at_request = _opt(serving_crash_at_request)
 
     @classmethod
     def from_args(cls, args) -> "FaultPlan":
@@ -107,7 +137,6 @@ class FaultPlan:
         seed = getattr(args, "chaos_seed", None)
         if seed is None:
             seed = getattr(args, "random_seed", 0)
-        crash = getattr(args, "chaos_crash_at_round", None)
         return cls(
             seed=int(seed),
             dropout_prob=float(getattr(args, "chaos_dropout_prob", 0.0)
@@ -124,8 +153,22 @@ class FaultPlan:
                                   or 0.0),
             link_delay_s=float(getattr(args, "chaos_link_delay_s", 0.0)
                                or 0.0),
-            crash_at_round=(None if crash in (None, "", False)
-                            else int(crash)),
+            crash_at_round=_none_or_int(
+                getattr(args, "chaos_crash_at_round", None)),
+            serving_stall_prob=float(
+                getattr(args, "chaos_serving_stall_prob", 0.0) or 0.0),
+            serving_stall_s=float(
+                getattr(args, "chaos_serving_stall_s", 0.0) or 0.0),
+            serving_stall_at_step=_none_or_int(
+                getattr(args, "chaos_serving_stall_at_step", None)),
+            serving_nan_prob=float(
+                getattr(args, "chaos_serving_nan_prob", 0.0) or 0.0),
+            serving_nan_at_step=_none_or_int(
+                getattr(args, "chaos_serving_nan_at_step", None)),
+            serving_conn_drop_prob=float(
+                getattr(args, "chaos_serving_conn_drop_prob", 0.0) or 0.0),
+            serving_crash_at_request=_none_or_int(
+                getattr(args, "chaos_serving_crash_at_request", None)),
         )
 
     # --- enablement ---------------------------------------------------------
@@ -150,8 +193,19 @@ class FaultPlan:
                 or (self.link_delay_prob > 0.0 and self.link_delay_s > 0.0))
 
     @property
+    def injects_serving_faults(self) -> bool:
+        return ((self.serving_stall_prob > 0.0
+                 or self.serving_stall_at_step is not None)
+                and self.serving_stall_s > 0.0) \
+            or self.serving_nan_prob > 0.0 \
+            or self.serving_nan_at_step is not None \
+            or self.serving_conn_drop_prob > 0.0 \
+            or self.serving_crash_at_request is not None
+
+    @property
     def enabled(self) -> bool:
         return (self.injects_availability or self.injects_link_faults
+                or self.injects_serving_faults
                 or self.crash_at_round is not None)
 
     # --- per-decision PRNG --------------------------------------------------
@@ -220,6 +274,50 @@ class FaultPlan:
             delay = self.link_delay_s
         return LinkDecision(copies=copies, delay_s=delay)
 
+    # --- serving faults -----------------------------------------------------
+    def serving_decode_fault(self, step_idx: int) -> Optional[str]:
+        """Fault verdict for the engine's ``step_idx``-th decode step:
+        ``"nan"`` (poisoned logits), ``"stall"`` (the step wedges for
+        ``serving_stall_s``), or None. Pure function of (seed, kind,
+        step_idx): the same plan replays the same fault trace after any
+        engine reset — which is what makes recovery determinism a test
+        instead of a hope. NaN wins a tie (a poisoned step is the louder
+        failure)."""
+        step_idx = int(step_idx)
+        if self.serving_nan_at_step is not None \
+                and step_idx == self.serving_nan_at_step:
+            return "nan"
+        if self.serving_stall_at_step is not None \
+                and step_idx == self.serving_stall_at_step \
+                and self.serving_stall_s > 0.0:
+            return "stall"
+        if self.serving_nan_prob <= 0.0 and (
+                self.serving_stall_prob <= 0.0
+                or self.serving_stall_s <= 0.0):
+            return None
+        u_nan, u_stall = self._rng(_TAG_SERVE_STEP, step_idx).random(2)
+        if self.serving_nan_prob > 0.0 and u_nan < self.serving_nan_prob:
+            return "nan"
+        if (self.serving_stall_prob > 0.0 and self.serving_stall_s > 0.0
+                and u_stall < self.serving_stall_prob):
+            return "stall"
+        return None
+
+    def gateway_drop(self, seq: int) -> bool:
+        """True when the ``seq``-th gateway request should see its
+        replica connection dropped before any byte reaches a predictor
+        (the WAN-flake analogue for the serving wire)."""
+        if self.serving_conn_drop_prob <= 0.0:
+            return False
+        u = self._rng(_TAG_SERVE_GW, seq).random()
+        return bool(u < self.serving_conn_drop_prob)
+
+    def serving_crash_due(self, request_idx: int) -> bool:
+        """True when the replica should crash on its ``request_idx``-th
+        served request (0-based) — the container-kill analogue."""
+        return (self.serving_crash_at_request is not None
+                and int(request_idx) == self.serving_crash_at_request)
+
     # --- crash events -------------------------------------------------------
     def crash_due(self, round_idx: int) -> bool:
         return (self.crash_at_round is not None
@@ -230,7 +328,12 @@ class FaultPlan:
                 f"straggle={self.straggler_prob}@{self.straggler_work}, "
                 f"link=({self.link_loss_prob},{self.link_dup_prob},"
                 f"{self.link_delay_prob}x{self.link_delay_s}s), "
-                f"crash_at={self.crash_at_round})")
+                f"crash_at={self.crash_at_round}, "
+                f"serving=(stall={self.serving_stall_prob}"
+                f"@{self.serving_stall_at_step}x{self.serving_stall_s}s,"
+                f"nan={self.serving_nan_prob}@{self.serving_nan_at_step},"
+                f"drop={self.serving_conn_drop_prob},"
+                f"crash_req={self.serving_crash_at_request}))")
 
 
 class FaultLedger:
@@ -244,6 +347,7 @@ class FaultLedger:
         self._lock = threading.Lock()
         self._rounds: List[Dict[str, Any]] = []
         self._links: List[Dict[str, Any]] = []
+        self._serving: List[Dict[str, Any]] = []
 
     def record_round(self, round_idx: int, injected: Dict[str, Any],
                      observed: Dict[str, Any]) -> None:
@@ -287,6 +391,18 @@ class FaultLedger:
         from .. import mlops
         mlops.log_chaos(link=rec)
 
+    def record_serving(self, kind: str, **detail: Any) -> None:
+        """One injected serving fault (stall / nan / conn_drop / crash)
+        with whatever locates it (step_idx, seq, request_idx). The soak
+        test balances these against the engine's observed recoveries —
+        an injected fault with no matching reset/failover is a tolerance
+        bug."""
+        rec = {"kind": str(kind), **detail}
+        with self._lock:
+            self._serving.append(rec)
+        from .. import mlops
+        mlops.log_chaos(serving=rec)
+
     def rounds(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._rounds)
@@ -295,6 +411,11 @@ class FaultLedger:
         with self._lock:
             return list(self._links)
 
+    def serving_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._serving)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            return {"rounds": list(self._rounds), "links": list(self._links)}
+            return {"rounds": list(self._rounds), "links": list(self._links),
+                    "serving": list(self._serving)}

@@ -142,7 +142,8 @@ def log_chaos(round_idx: Optional[int] = None,
               injected: Optional[Dict[str, Any]] = None,
               observed: Optional[Dict[str, Any]] = None,
               link: Optional[Dict[str, Any]] = None,
-              arrivals: Optional[list] = None) -> None:
+              arrivals: Optional[list] = None,
+              serving: Optional[Dict[str, Any]] = None) -> None:
     """Fault-ledger record from the chaos subsystem: what the
     :class:`~fedml_tpu.core.chaos.FaultPlan` injected this round vs what
     the runtime observed at the aggregation seam (or one link fault event).
@@ -161,6 +162,8 @@ def log_chaos(round_idx: Optional[int] = None,
         rec["observed"] = observed
     if link is not None:
         rec["link"] = link
+    if serving is not None:
+        rec["serving"] = serving
     if arrivals is not None:
         rec["arrivals"] = arrivals
         # pour-shaped records feed the staleness / buffer-occupancy

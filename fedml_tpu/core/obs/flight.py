@@ -57,6 +57,7 @@ class FlightRecorder:
             maxlen=self.capacity)
         self._seq = 0
         self._dumped_paths: List[str] = []
+        self._dump_counts: Dict[str, int] = {}
 
     def note(self, event: str, **data: Any) -> None:
         """Record one lifecycle/step event. Values must be JSON-encodable
@@ -94,7 +95,11 @@ class FlightRecorder:
         """Write the ring to ``path`` (default: ``flight_<component>_
         <pid>.jsonl`` next to the run logs). Returns the path written,
         or None when the ring is empty. Never raises — the dump runs
-        from crash handlers."""
+        from crash handlers.
+
+        Repeat dumps to the same nominal path get a monotonic ``.N``
+        suffix: a second watchdog trip (or a reset after a trip) in one
+        process must never overwrite the first episode's post-mortem."""
         try:
             recs = self.records()
             if not recs:
@@ -103,9 +108,24 @@ class FlightRecorder:
                 base = os.path.expanduser("~/.cache/fedml_tpu/logs")
                 path = os.path.join(
                     base, f"flight_{self.component}_{os.getpid()}.jsonl")
+            root, ext = os.path.splitext(path)
+            # reserve the slot atomically: worker loop (reset dump) and
+            # watchdog thread (trip dump) can dump the SAME recorder
+            # concurrently — racing the count/probe would hand both the
+            # same target (and the same tmp name) and lose one episode
+            with self._lock:
+                n = self._dump_counts.get(path, 0)
+                actual = path if n == 0 else f"{root}.{n}{ext}"
+                # a recorder rebuilt mid-process restarts its count at
+                # 0 — probe the disk so it still never clobbers
+                while os.path.exists(actual):
+                    n += 1
+                    actual = f"{root}.{n}{ext}"
+                self._dump_counts[path] = n + 1
+            path = actual
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
-            tmp = f"{path}.{os.getpid()}.tmp"
+            tmp = f"{path}.{os.getpid()}.{n}.tmp"
             with open(tmp, "w") as f:
                 for rec in recs:
                     f.write(json.dumps(rec) + "\n")

@@ -538,6 +538,37 @@ def record_llm_reject(reason: str) -> None:
                      labels=("reason",)).inc(1, reason=str(reason))
 
 
+def record_llm_reset(reason: str) -> None:
+    """One watchdog-driven engine reset (crash-only recovery): the slot
+    matrix + KV pool were rebuilt and the in-flight snapshots requeued."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_engine_resets_total",
+                     "controlled engine resets (watchdog-driven "
+                     "recovery)", labels=("reason",)).inc(
+                         1, reason=str(reason))
+
+
+def record_llm_requeue(reason: str, n: int = 1) -> None:
+    """Requests snapshotted and requeued for recompute-from-prompt —
+    by an engine reset or a preempt-under-pressure decision."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_requests_requeued_total",
+                     "in-flight requests requeued for recompute",
+                     labels=("reason",)).inc(int(n), reason=str(reason))
+
+
+def record_gateway_failover(reason: str) -> None:
+    """Gateway routed a request away from a replica (dead connect,
+    503-shedding replica, failed health probe)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("serving_gateway_failovers_total",
+                     "requests re-routed off a failed/unhealthy replica",
+                     labels=("reason",)).inc(1, reason=str(reason))
+
+
 def record_watchdog_trip(component: str, reason: str) -> None:
     if not _cfg["enabled"]:
         return

@@ -66,7 +66,8 @@ RECORD_SCHEMAS: Dict[str, Dict[str, FieldSpec]] = {
               "injected": _f(DICT),
               "observed": _f(DICT),
               "link": _f(DICT),
-              "arrivals": _f(LIST)},
+              "arrivals": _f(LIST),
+              "serving": _f(DICT)},
     # mlops.log_selection
     "selection": {"round_idx": _f(INT, required=True),
                   "strategy": _f(STR, required=True),

@@ -41,6 +41,18 @@ PyTree = Any
 _ARTIFACT_MAGIC = b"FMTPU1\n"
 
 
+class Overloaded(RuntimeError):
+    """Load-shed verdict: the serving queue is past its depth bound, so
+    the request is refused AT SUBMIT instead of wedging the queue —
+    overload is a signal, not a hang. ``retry_after_s`` (derived from
+    queue depth and KV admission headroom) rides out as the HTTP 503's
+    ``Retry-After`` header so well-behaved clients back off usefully."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+
+
 def save_model(params: PyTree, path: str) -> str:
     """Persist model params with the wire codec (``dumps_tree``). No
     pickle: artifacts may cross trust boundaries (device uploads, served
@@ -131,11 +143,18 @@ class FedMLInferenceRunner:
 
     def __init__(self, predictor: FedMLPredictor, host: str = "127.0.0.1",
                  port: int = 0,
-                 extra_routes: Optional[dict] = None):
+                 extra_routes: Optional[dict] = None,
+                 chaos=None):
         from ..core.obs import metrics as obs_metrics
         from ..core.obs import trace as obs_trace
 
         self.predictor = predictor
+        # optional ServingChaosInjector: replica crash-at-request-N lands
+        # HERE (the request seam) — hard_crash kills the process (the
+        # subprocess-replica analogue of a container OOM-kill), otherwise
+        # the connection is severed mid-request so in-process tests see
+        # the same client-visible failure without losing the test process
+        self.chaos = chaos
         # POST routes: path -> callable(json_request) -> json_response.
         # /predict is always mounted; templates mount more (e.g. the LLM
         # template's /v1/chat/completions)
@@ -148,13 +167,16 @@ class FedMLInferenceRunner:
                 logger.debug("serving: " + fmt, *args_)
 
             def _reply(self, code: int, payload: Any,
-                       traceparent: Optional[str] = None) -> None:
+                       traceparent: Optional[str] = None,
+                       extra_headers: Optional[dict] = None) -> None:
                 blob = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(blob)))
                 if traceparent:
                     self.send_header("traceparent", traceparent)
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(blob)
 
@@ -187,6 +209,22 @@ class FedMLInferenceRunner:
                 if handler is None:
                     self._reply(404, {"error": "not found"})
                     return
+                if runner.chaos is not None \
+                        and runner.chaos.request_crash_due():
+                    if runner.chaos.hard_crash:  # subprocess replica only
+                        logger.error("chaos: replica crash-at-request "
+                                     "(hard) — exiting")
+                        import os
+                        os._exit(23)
+                    # in-process analogue: sever the connection so the
+                    # client sees exactly what a process kill looks like
+                    logger.error("chaos: replica crash-at-request — "
+                                 "severing connection")
+                    try:
+                        self.connection.close()
+                    except OSError:
+                        pass
+                    return
                 parent = obs_trace.parse_traceparent(
                     self.headers.get("traceparent"))
                 with obs_trace.span("serving.http", parent=parent,
@@ -196,6 +234,18 @@ class FedMLInferenceRunner:
                         request = json.loads(self.rfile.read(n) or b"{}")
                         self._reply(200, handler(request),
                                     traceparent=sp.traceparent())
+                    except Overloaded as e:
+                        # shed (or parked-unhealthy engine), not failed:
+                        # 503 + Retry-After tells the client — and the
+                        # gateway's failover — to go elsewhere
+                        sp.set_attr("error", "overloaded")
+                        self._reply(
+                            503,
+                            {"error": str(e),
+                             "retry_after_s": e.retry_after_s},
+                            traceparent=sp.traceparent(),
+                            extra_headers={"Retry-After": max(
+                                1, int(round(e.retry_after_s)))})
                     except Exception as e:
                         logger.exception("predict failed")
                         sp.set_attr("error", type(e).__name__)

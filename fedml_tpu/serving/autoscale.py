@@ -201,6 +201,9 @@ class ReplicaSet:
         self.max_replicas = int(max_replicas)
         self.replicas: List = []
         self._lock = threading.Lock()
+        # ports the gateway must route around while their replica
+        # finishes in-flight work before a restart (the drain seam)
+        self._draining: set = set()
         self.scale_to(self.min_replicas)
 
     def _new_replica(self):
@@ -220,6 +223,9 @@ class ReplicaSet:
                 cur = len(self.replicas)
                 if cur > n:
                     victim = self.replicas.pop()
+                    # replicas bind ephemeral ports a successor may be
+                    # handed again — a stale drain mark would hide it
+                    self._draining.discard(victim.port)
             if victim is not None:
                 victim.stop()
                 logger.info("replica down (%d left)", len(self))
@@ -236,9 +242,76 @@ class ReplicaSet:
                     continue
             runner.stop()  # target shrank underneath us
 
-    def ports(self) -> List[int]:
+    def ports(self, include_draining: bool = False) -> List[int]:
         with self._lock:
-            return [r.port for r in self.replicas]
+            if include_draining:
+                return [r.port for r in self.replicas]
+            return [r.port for r in self.replicas
+                    if r.port not in self._draining]
+
+    # --- drain / zero-downtime restart ------------------------------------
+    def drain(self, port: int) -> None:
+        """Take ``port`` out of gateway rotation WITHOUT stopping it: the
+        replica finishes its in-flight requests while new traffic routes
+        around it."""
+        with self._lock:
+            self._draining.add(int(port))
+
+    def undrain(self, port: int) -> None:
+        with self._lock:
+            self._draining.discard(int(port))
+
+    def draining(self) -> List[int]:
+        with self._lock:
+            return sorted(self._draining)
+
+    def restart_replica(self, port: int, grace_s: float = 0.5,
+                        ready_wait_s: float = 10.0) -> int:
+        """Drain -> finish-in-flight -> restart, one replica: the
+        zero-downtime reload seam. The victim leaves rotation first, a
+        grace period lets requests already routed to it complete, the
+        replacement comes up READY before the victim dies, and only then
+        is the old process stopped. Returns the fresh replica's port.
+        Subprocess replicas re-read their spec/artifact from disk, so
+        this is also how an updated on-disk model (or adapter bank) goes
+        live with zero dropped requests."""
+        with self._lock:
+            idx = next((i for i, r in enumerate(self.replicas)
+                        if r.port == int(port)), None)
+            if idx is None:
+                raise ValueError(f"no replica on port {port}")
+            victim = self.replicas[idx]
+        self.drain(victim.port)
+        try:
+            if grace_s > 0:
+                time.sleep(grace_s)   # in-flight finishes off-rotation
+            fresh = self._start_ready(wait_s=ready_wait_s)
+        except Exception:
+            self.undrain(victim.port)   # failed swap: keep serving
+            raise
+        with self._lock:
+            if idx < len(self.replicas) and self.replicas[idx] is victim:
+                self.replicas[idx] = fresh
+            else:   # set changed underneath (scale event): keep both
+                self.replicas.append(fresh)
+        self.undrain(victim.port)
+        try:
+            victim.stop()
+        except Exception:
+            logger.exception("drained replica on :%d failed to stop",
+                             victim.port)
+        logger.info("replica :%d drained and restarted as :%d",
+                    victim.port, fresh.port)
+        return fresh.port
+
+    def rolling_restart(self, grace_s: float = 0.5) -> None:
+        """Drain-restart every replica one at a time from the CURRENT
+        factory — the rolling reload the adapter hot-swap flow needs."""
+        for port in list(self.ports(include_draining=True)):
+            try:
+                self.restart_replica(port, grace_s=grace_s)
+            except ValueError:
+                continue   # scaled away mid-rollout
 
     # --- health + rolling update (reference
     # ``device_replica_controller.py``: health-based replacement, one-at-a-
@@ -280,6 +353,7 @@ class ReplicaSet:
             with self._lock:
                 if i < len(self.replicas) and self.replicas[i] is runner:
                     self.replicas[i] = fresh
+                    self._draining.discard(runner.port)  # port may recycle
                     replaced += 1
                 else:  # set changed underneath (scale event): discard
                     fresh.stop()
@@ -327,6 +401,7 @@ class ReplicaSet:
             for r in self.replicas:
                 r.stop()
             self.replicas.clear()
+            self._draining.clear()
 
     def __len__(self) -> int:
         with self._lock:
@@ -356,8 +431,9 @@ class GatewayMetrics:
 
 
 class Gateway:
-    """Round-robin HTTP front over a ReplicaSet that records the
-    QPS/latency series policies consume (reference inference gateway).
+    """Health-aware round-robin HTTP front over a ReplicaSet that records
+    the QPS/latency series policies consume (reference inference
+    gateway).
 
     Windowed tail stats live in ONE place: the ``core/obs``
     :class:`~fedml_tpu.core.obs.metrics.LatencyWindow` (exact
@@ -367,20 +443,102 @@ class Gateway:
     for the ``/metrics`` exposition and JSONL snapshots. An active span
     on the calling thread is forwarded to the replica as a W3C
     ``traceparent`` header, so the replica-side request trace joins the
-    caller's."""
+    caller's.
 
-    def __init__(self, replica_set: ReplicaSet, window_s: float = 5.0):
+    Failover (ISSUE 11): routing consults replica health — a port that
+    failed a connect or answered ``/healthz`` non-200 is quarantined for
+    ``unhealthy_ttl_s`` and routed around; a retry never re-picks the
+    port that just failed while an untried port remains (only once EVERY
+    live port has failed this request does it fall back to re-picking —
+    on a small fleet a transient flake beats refusing outright);
+    draining replicas are excluded by ``ReplicaSet.ports()``. Retries
+    pace themselves on the shared ``communication/backoff`` policy, and
+    a replica 503 (load shed / parked-unhealthy engine) is routed around
+    too — the request never reached a predictor, so re-routing is safe.
+    Read timeouts and other HTTP errors DID reach a replica and surface
+    unchanged."""
+
+    def __init__(self, replica_set: ReplicaSet, window_s: float = 5.0,
+                 unhealthy_ttl_s: float = 2.0, max_failovers: int = 3,
+                 backoff_seed: Optional[int] = None, chaos=None):
         from ..core.obs import metrics as obs_metrics
         self.replica_set = replica_set
         self.window_s = float(window_s)
+        self.unhealthy_ttl_s = float(unhealthy_ttl_s)
+        self.max_failovers = int(max_failovers)
+        self.backoff_seed = backoff_seed
+        self._chaos = chaos      # optional ServingChaosInjector
         self._i = 0
         self._lock = threading.Lock()
         self._window = obs_metrics.LatencyWindow(window_s=self.window_s)
+        self._unhealthy: dict = {}   # port -> quarantine expiry ts
+
+    # --- health cache ------------------------------------------------------
+    def _mark_unhealthy(self, port: int, reason: str) -> None:
+        from ..core.obs import metrics as obs_metrics
+        with self._lock:
+            self._unhealthy[int(port)] = time.time() + self.unhealthy_ttl_s
+        obs_metrics.record_gateway_failover(reason)
+        logger.warning("gateway: replica :%d quarantined (%s)", port,
+                       reason)
+
+    def _is_quarantined(self, port: int) -> bool:
+        with self._lock:
+            exp = self._unhealthy.get(int(port))
+            if exp is None:
+                return False
+            if time.time() >= exp:
+                del self._unhealthy[int(port)]
+                return False
+            return True
+
+    def probe_health(self, port: int, timeout: float = 1.0) -> bool:
+        """GET the replica's ``/healthz``; non-200 (a tripped watchdog,
+        a parked engine) or no answer quarantines the port."""
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=timeout) as r:
+                if r.status == 200:
+                    return True
+        except Exception:  # noqa: BLE001 — any failure = unhealthy
+            pass
+        self._mark_unhealthy(port, "healthz")
+        return False
+
+    def _pick_port(self, tried: set, verify_health: bool) -> Optional[int]:
+        """Next routable port: round-robin over live, non-draining,
+        non-quarantined ports the request has not tried yet. With
+        ``verify_health`` (retry attempts), the candidate's ``/healthz``
+        is consulted before traffic lands on it. Falls back to
+        quarantined-but-untried ports rather than refusing — a wrong
+        quarantine must not 503 the fleet."""
+        ports = self.replica_set.ports()
+        candidates = [p for p in ports
+                      if p not in tried and not self._is_quarantined(p)]
+        if not candidates:
+            candidates = [p for p in ports if p not in tried]
+        if not candidates:
+            # every live port already failed this request once: a
+            # last-resort re-pick (transient connect flake on a small
+            # fleet) beats refusing while retry budget remains
+            candidates = list(ports)
+        while candidates:
+            with self._lock:
+                port = candidates[self._i % len(candidates)]
+                self._i += 1
+            if verify_health and len(candidates) > 1 \
+                    and not self.probe_health(port):
+                candidates.remove(port)
+                continue
+            return port
+        return None
 
     def predict(self, request: dict, timeout: float = 30.0,
                 path: str = "/predict") -> dict:
         """Route one request to a replica; ``path`` selects the replica
         route (e.g. ``/v1/chat/completions`` on LLM replicas)."""
+        from ..core.distributed.communication.backoff import backoff_delays
         from ..core.obs import metrics as obs_metrics
         from ..core.obs import trace as obs_trace
         body = json.dumps(request).encode()
@@ -389,36 +547,64 @@ class Gateway:
         if cur is not None and cur.traceparent():
             headers["traceparent"] = cur.traceparent()
         t0 = time.perf_counter()
-        # one retry on a CONNECTION-PHASE failure only (replica swapped or
-        # crashed between routing and connect — the request never reached
-        # a predictor, so re-routing it is safe). HTTP errors and read
-        # timeouts DID reach a replica and must surface, not double the
-        # load on a saturated fleet.
-        for attempt in range(2):
-            ports = self.replica_set.ports()
-            if not ports:
-                raise RuntimeError("no live replicas")
-            with self._lock:
-                port = ports[self._i % len(ports)]
-                self._i += 1
+        delays = backoff_delays(base_s=0.05, factor=2.0, max_s=0.5,
+                                seed=self.backoff_seed)
+        tried: set = set()
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_failovers + 1):
+            port = self._pick_port(tried, verify_health=attempt > 0)
+            if port is None:
+                break   # every live port tried (or none live)
+            tried.add(port)
+            if self._chaos is not None and self._chaos.connection_drop():
+                # injected gateway->replica connection drop: the fault
+                # the failover path exists for, at its exact seam
+                last_exc = ConnectionError(
+                    f"chaos: injected connection drop to :{port}")
+                self._mark_unhealthy(port, "conn_drop")
+                time.sleep(next(delays))
+                continue
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}{path}", data=body,
                 headers=headers)
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as r:
                     out = json.load(r)
-                break
-            except urllib.error.HTTPError:
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    # shed or parked-unhealthy replica: the request was
+                    # refused before any predictor ran — routing around
+                    # is safe, and the replica asked us to back off
+                    self._mark_unhealthy(port, "http_503")
+                    last_exc = e
+                    retry_after = e.headers.get("Retry-After")
+                    e.close()
+                    delay = next(delays)
+                    if retry_after:
+                        try:
+                            delay = min(float(retry_after), 2.0)
+                        except ValueError:
+                            pass
+                    time.sleep(delay)
+                    continue
                 raise  # the replica answered; its answer stands
             except (urllib.error.URLError, OSError) as e:
                 reason = getattr(e, "reason", e)
-                if (attempt == 1
-                        or not isinstance(reason, ConnectionError)):
-                    raise
-        dt = time.perf_counter() - t0
-        obs_metrics.record_gateway_latency(dt)
-        self._window.observe(dt)
-        return out
+                if not isinstance(reason, ConnectionError):
+                    raise   # read timeout etc: reached a replica
+                # connection-phase failure: never re-pick this port for
+                # THIS request (satellite 1), quarantine it for others
+                self._mark_unhealthy(port, "connect")
+                last_exc = e
+                time.sleep(next(delays))
+                continue
+            dt = time.perf_counter() - t0
+            obs_metrics.record_gateway_latency(dt)
+            self._window.observe(dt)
+            return out
+        if last_exc is not None:
+            raise last_exc
+        raise RuntimeError("no live replicas")
 
     def metrics(self) -> GatewayMetrics:
         """Trailing-window :class:`GatewayMetrics` from the shared

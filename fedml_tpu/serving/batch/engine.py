@@ -8,8 +8,33 @@ request is admitted into it — no batch barriers, no head-of-line
 blocking behind long generations (Orca's core idea).
 
 Deadlines: a request past its deadline is EVICTED at the next step
-boundary and resolves with what it has, ``finish_reason: "length"`` —
-tail-latency control the autoscaler's p99 policies can rely on.
+boundary and resolves with what it has, ``finish_reason: "deadline"`` —
+tail-latency control the autoscaler's p99 policies can rely on, and a
+reason clients can tell apart from an honest ``"length"`` budget stop.
+
+Fault tolerance (crash-only recovery, Candea & Fox): a watchdog trip
+(NaN/inf logits, or a decode stall the loop can observe — an injected
+chaos stall, or any wedge between steps) triggers a CONTROLLED RESET
+instead of a permanent 503 — every in-flight request is snapshotted
+(prompt, tokens so far, remaining budget, seed, adapter index), the slot
+matrix + paged KV pool + scheduler state are rebuilt (same geometry,
+zero recompiles), and the snapshots are requeued at the queue front for
+recompute-from-prompt. A step that hard-hangs INSIDE the XLA dispatch
+cannot be interrupted from this thread: /healthz stays 503 "stalled"
+and recovery is the replica level's job (gateway routes around it,
+``ReplicaSet.health_check``/drain-restart replaces the process) — and a
+slow step that eventually RETURNS is progress, so its stale trip is
+deliberately dropped rather than resetting a healthy engine. Sampling is stateless per (seed, position), so a replayed
+sampled decode regenerates bit-identical tokens. Resets are budgeted
+(``max_resets`` per ``reset_window_s``); past the budget the engine
+stays unhealthy, dumps its flight ring, and resolves survivors with
+``finish_reason: "preempted"`` (partial progress) or the same
+Overloaded 503 a fresh submit gets (zero tokens — an empty "success"
+would dodge the gateway's failover). Graceful degradation: when the queue
+head starves past ``preempt_after_s`` the YOUNGEST slot is preempted and
+requeued (it keeps its progress), and past ``shed_queue_depth`` submits
+fail fast with :class:`~fedml_tpu.serving.Overloaded` (HTTP 503 +
+``Retry-After``) instead of wedging.
 
 Observability (the full request lifecycle through the ``core/obs``
 planes):
@@ -61,7 +86,7 @@ class _Request:
     __slots__ = ("ids", "max_new", "temperature", "seed", "adapter_idx",
                  "deadline_ts", "future", "span", "out_ids", "slot",
                  "submitted_ts", "queue_span", "decode_span", "admit_ts",
-                 "decode_ts")
+                 "decode_ts", "requeues", "admit_seq", "queue_wait_start")
 
     def __init__(self, ids, max_new, temperature, seed, adapter_idx,
                  deadline_ts, span):
@@ -80,6 +105,12 @@ class _Request:
         self.decode_span = None
         self.admit_ts: Optional[float] = None   # queue end (prefill start)
         self.decode_ts: Optional[float] = None  # first token (decode start)
+        self.requeues = 0       # reset/preempt recompute cycles so far
+        self.admit_seq = -1     # admission order stamp; max = youngest
+        # starvation clock: when THIS queue wait began (reset on every
+        # requeue, else a once-preempted request instantly reads as
+        # starved and preempts its preemptor — ping-pong)
+        self.queue_wait_start = self.submitted_ts
 
 
 class BatchingEngine:
@@ -88,7 +119,10 @@ class BatchingEngine:
     def __init__(self, scheduler, default_deadline_s: float = 0.0,
                  rate_window_s: float = 2.0, watchdog_s: float = 30.0,
                  flight_records: int = 256,
-                 flight_dir: Optional[str] = None):
+                 flight_dir: Optional[str] = None,
+                 max_resets: int = 3, reset_window_s: float = 300.0,
+                 max_requeues: int = 2, preempt_after_s: float = 0.0,
+                 shed_queue_depth: int = 0, chaos=None):
         self.scheduler = scheduler
         self.default_deadline_s = float(default_deadline_s)
         self.rate_window_s = float(rate_window_s)
@@ -97,6 +131,21 @@ class BatchingEngine:
         self._inflight: Dict[int, _Request] = {}
         self._tokens: Deque = collections.deque()   # (ts, n) for tokens/s
         self._running = True
+        # --- fault tolerance ----------------------------------------------
+        self.max_resets = int(max_resets)
+        self.reset_window_s = float(reset_window_s)
+        self.max_requeues = int(max_requeues)
+        self.preempt_after_s = float(preempt_after_s)
+        self.shed_queue_depth = int(shed_queue_depth)
+        self._chaos = chaos      # optional ServingChaosInjector
+        self._reset_requested: Optional[str] = None   # watchdog -> loop
+        self._reset_times: List[float] = []
+        self._last_reset_ts = 0.0
+        self.resets_total = 0
+        self._failed: Optional[str] = None   # reset budget exhausted
+        self._admit_counter = 0
+        self._req_wall_ema: Optional[float] = None   # Retry-After input
+        self._last_fault_step = -1   # one plan consult per step index
         # --- black box + watchdog ------------------------------------------
         self.flight = obs_flight.FlightRecorder(
             "serving_engine", capacity=int(flight_records))
@@ -111,7 +160,8 @@ class BatchingEngine:
         self.last_progress_ts = time.time()
         self.watchdog = obs_flight.Watchdog(
             "serving_engine", self._watchdog_probe, recorder=self.flight,
-            stall_s=float(watchdog_s), dump_path=self._flight_path)
+            stall_s=float(watchdog_s), dump_path=self._flight_path,
+            on_trip=self._on_watchdog_trip)
         self.watchdog.start()
         # shared decode-step block span (bare handle, worker thread only)
         self._steps_span = None
@@ -138,6 +188,32 @@ class BatchingEngine:
         if not self._running:
             obs_metrics.record_llm_reject("engine_stopped")
             raise RuntimeError("engine stopped")
+        if self._failed is not None:
+            # typed 503, not a bare RuntimeError: the HTTP runner maps
+            # Overloaded to 503 + Retry-After, which is what lets the
+            # gateway quarantine this replica and route around it — a
+            # 500 here would surface to the client as a replica answer
+            from .. import Overloaded
+            obs_metrics.record_llm_reject("engine_failed")
+            raise Overloaded(
+                f"engine unhealthy (reset budget exhausted after "
+                f"{self._failed}); drain and restart the replica",
+                retry_after_s=30.0)
+        if self.shed_queue_depth > 0:
+            depth = self.queue_depth()
+            if depth >= self.shed_queue_depth:
+                # overload is a SIGNAL: fail fast with a Retry-After
+                # hint derived from queue depth and KV admission
+                # headroom instead of wedging the caller in the queue
+                from .. import Overloaded
+                retry_after = self._retry_after_s(depth)
+                obs_metrics.record_llm_reject("overloaded")
+                self.flight.note("shed", queue_depth=depth,
+                                 retry_after_s=round(retry_after, 3))
+                raise Overloaded(
+                    f"queue depth {depth} >= shed bound "
+                    f"{self.shed_queue_depth}",
+                    retry_after_s=retry_after)
         span = obs_trace.tracer.start_span(
             "serving.request", parent=parent,
             attrs={"prompt_tokens": len(prompt_ids),
@@ -194,6 +270,17 @@ class BatchingEngine:
     def _loop(self) -> None:
         while self._running:
             try:
+                if self._reset_requested is not None:
+                    self._recover(self._reset_requested)
+                    continue
+                if self._failed is not None:
+                    # unhealthy but alive: answer /healthz, resolve any
+                    # racing submits, never wedge a caller
+                    self._drain_queue()
+                    while self._pending:
+                        self._resolve_parked(self._pending.popleft())
+                    time.sleep(0.05)
+                    continue
                 self._drain_queue()
                 self._admit()
                 self._evict_deadlines()
@@ -209,11 +296,22 @@ class BatchingEngine:
                         # request) with nothing in flight: don't busy-spin
                         time.sleep(0.005)
                     continue
+                if self._chaos is not None and not self._inject_chaos():
+                    continue   # injected fault aborted this step
                 self.last_progress_ts = time.time()  # entering the step:
                 # only a step that HANGS past stall_s reads as a stall,
                 # not a slow first-compile that returns
                 t0 = time.perf_counter()
                 toks = self.scheduler.step()
+                if not self.scheduler.last_step_finite:
+                    # poisoned step: the tokens are garbage — discard
+                    # them and run the controlled reset (requeue +
+                    # recompute); a persistent poison source exhausts the
+                    # reset budget and parks the engine unhealthy
+                    self.flight.note("poisoned_step",
+                                     step=int(self.scheduler.steps_run))
+                    self._recover("nan_logits")
+                    continue
                 self._observe_step(len(toks), time.perf_counter() - t0)
                 self._collect(toks)
             except Exception:  # noqa: BLE001 — serving loop must survive
@@ -225,6 +323,44 @@ class BatchingEngine:
         self._close_steps_span()
         self._fail_all(RuntimeError("engine stopped"))
 
+    def _inject_chaos(self) -> bool:
+        """Consult the serving fault plan for the NEXT decode step.
+        Returns False when the injected fault aborted the step (stall
+        interrupted by a watchdog-requested reset, or NaN poison).
+
+        One consult per step INDEX: a reset doesn't advance
+        ``steps_run`` (the aborted step never ran), so without the
+        latch the same scheduled fault would re-fire on every recovery
+        attempt and a single injected NaN would read as a permanent
+        poison source."""
+        step_idx = int(self.scheduler.steps_run)
+        if step_idx == self._last_fault_step:
+            return True
+        self._last_fault_step = step_idx
+        kind = self._chaos.decode_fault(step_idx)
+        if kind is None:
+            return True
+        if kind == "nan":
+            # poison the step flag exactly like non-finite logits would:
+            # the loop's finite check turns this into a controlled reset
+            self.flight.note("chaos_nan",
+                             step=int(self.scheduler.steps_run))
+            self.scheduler.last_step_finite = False
+            self._recover("nan_logits")
+            return False
+        # stall: wedge interruptibly — last_progress_ts stops moving, the
+        # watchdog trips, and its reset request cuts the stall short the
+        # way a process restart would. A stall shorter than the watchdog
+        # leash just rides out (tolerated without a reset).
+        stall_s = self._chaos.stall_s()
+        self.flight.note("chaos_stall", step=int(self.scheduler.steps_run),
+                         stall_s=stall_s)
+        deadline = time.time() + stall_s
+        while (time.time() < deadline and self._running
+               and self._reset_requested is None):
+            time.sleep(0.01)
+        return self._reset_requested is None and self._running
+
     def _drain_queue(self) -> None:
         while True:
             try:
@@ -235,15 +371,31 @@ class BatchingEngine:
     def _admit(self) -> None:
         while self._pending:
             req = self._pending[0]
-            if req.deadline_ts is not None and time.time() > req.deadline_ts:
+            now = time.time()
+            if req.deadline_ts is not None and now > req.deadline_ts:
                 self._pending.popleft()
                 obs_metrics.record_llm_evict("deadline_queued")
                 req.span.add_event("evict", reason="deadline_queued")
                 self.flight.note("evict", reason="deadline_queued")
+                self._finish(req, "deadline")
+                continue
+            # recompute-from-prompt: a requeued request re-prefills its
+            # prompt PLUS the tokens it already generated — sampling is
+            # stateless per (seed, absolute position), so the remaining
+            # decode replays bit-identically; the budget shrinks by the
+            # prefix it keeps
+            admit_ids = req.ids + req.out_ids
+            remaining = req.max_new - len(req.out_ids)
+            if remaining <= 0:   # requeued at exactly its budget edge
+                self._pending.popleft()
                 self._finish(req, "length")
                 continue
-            if not self.scheduler.can_admit(len(req.ids), req.max_new):
-                return
+            if not self.scheduler.can_admit(len(admit_ids), remaining):
+                if not self._maybe_preempt_for(req, now):
+                    return
+                if not self.scheduler.can_admit(len(admit_ids),
+                                                remaining):
+                    return
             self._pending.popleft()
             dequeue_ts = time.time()
             if req.queue_span is not None:
@@ -251,14 +403,14 @@ class BatchingEngine:
                 req.queue_span = None
             prefill_span = obs_trace.tracer.start_span(
                 "serving.prefill", parent=req.span,
-                attrs={"prompt_tokens": len(req.ids)})
+                attrs={"prompt_tokens": len(admit_ids)})
             if prefill_span.span_id is not None:
                 prefill_span.start_ts = dequeue_ts  # stitch to queue end
             try:
                 slot, first = self.scheduler.admit(
-                    req.ids, adapter_idx=req.adapter_idx,
+                    admit_ids, adapter_idx=req.adapter_idx,
                     temperature=req.temperature, seed=req.seed,
-                    max_new_tokens=req.max_new)
+                    max_new_tokens=remaining)
             except Exception as e:  # noqa: BLE001
                 prefill_span.set_attr("error", type(e).__name__).end()
                 req.span.set_attr("error", type(e).__name__).end()
@@ -267,18 +419,26 @@ class BatchingEngine:
             now = time.time()
             self.last_progress_ts = now  # a slow prefill is not a stall
             prefill_span.set_attr("slot", slot)
+            first_admit = req.decode_ts is None
             req.slot = slot
-            req.admit_ts = dequeue_ts
-            req.decode_ts = now
-            req.span.add_event("admit", slot=slot)
-            # first token exists the moment prefill returns: TTFT is
-            # submit -> here (queue wait + chunked prefill, Orca's SLO)
-            req.span.set_attr("ttft_s", round(now - req.submitted_ts, 6))
-            obs_metrics.record_llm_ttft(now - req.submitted_ts)
+            self._admit_counter += 1
+            req.admit_seq = self._admit_counter
+            if first_admit:
+                req.admit_ts = dequeue_ts
+                req.decode_ts = now
+                # first token exists the moment prefill returns: TTFT is
+                # submit -> here (queue wait + chunked prefill, Orca's
+                # SLO). A RE-admission keeps the original TTFT — the
+                # user saw their first token before the reset.
+                req.span.set_attr("ttft_s",
+                                  round(now - req.submitted_ts, 6))
+                obs_metrics.record_llm_ttft(now - req.submitted_ts)
+            req.span.add_event("admit", slot=slot,
+                               recompute=not first_admit)
             obs_metrics.record_llm_admit()
             self._note_kv_pool()
             self.flight.note(
-                "admit", slot=slot,
+                "admit", slot=slot, recompute=not first_admit,
                 queue_wait_s=round(dequeue_ts - req.submitted_ts, 6))
             self._inflight[slot] = req
             req.decode_span = obs_trace.tracer.start_span(
@@ -289,6 +449,38 @@ class BatchingEngine:
             self._note_tokens(1)
             if not self._append_token(req, first):
                 self._retire(req)
+
+    def _maybe_preempt_for(self, starved: _Request, now: float) -> bool:
+        """Graceful degradation: when the queue head has starved past
+        ``preempt_after_s``, preempt-and-requeue the YOUNGEST slot (it
+        keeps its generated prefix and recomputes later) instead of
+        letting the head deadline-expire in the queue. Returns True when
+        a slot was freed."""
+        if (self.preempt_after_s <= 0 or not self._inflight
+                or now - starved.queue_wait_start < self.preempt_after_s):
+            return False
+        # ping-pong between two long requests is bounded by the per-
+        # request requeue budget: a victim past it resolves "preempted"
+        # instead of cycling forever
+        victim = max(self._inflight.values(), key=lambda r: r.admit_seq)
+        obs_metrics.record_llm_evict("preempted")
+        victim.span.add_event("preempt", slot=victim.slot,
+                              for_queue_wait_s=round(
+                                  now - starved.queue_wait_start, 3))
+        self.flight.note("preempt", slot=victim.slot,
+                         tokens_kept=len(victim.out_ids))
+        self._inflight.pop(victim.slot, None)
+        self.scheduler.release(victim.slot)
+        victim.slot = None
+        self._note_kv_pool()
+        if self._requeue(victim, "pressure"):
+            # _requeue appendlefts; the starved head must stay at
+            # the front — rotate the victim to just behind it
+            self._pending.popleft()           # the victim
+            head = self._pending.popleft()    # the starved request
+            self._pending.appendleft(victim)
+            self._pending.appendleft(head)
+        return True
 
     def _append_token(self, req: _Request, token: int) -> bool:
         """Append one generated token; False when the request finished."""
@@ -324,8 +516,153 @@ class BatchingEngine:
                 obs_metrics.record_llm_evict("deadline")
                 req.span.add_event("evict", reason="deadline", slot=slot)
                 self.flight.note("evict", reason="deadline", slot=slot)
-                self._finish(req, "length")
+                self._finish(req, "deadline")
                 self._retire(req)
+
+    # ----------------------------------------------------------- recovery --
+    def _on_watchdog_trip(self, reason: str) -> None:
+        """Watchdog thread → worker loop: request a controlled reset.
+        The flag (not the recovery itself) crosses the thread boundary;
+        the worker owns every piece of scheduler state."""
+        if self.max_resets > 0 and self._failed is None:
+            self._reset_requested = reason
+
+    def _recover(self, reason: str) -> None:
+        """The controlled reset: snapshot in-flight requests, rebuild the
+        scheduler (slot matrix + paged KV pool, same compiled programs),
+        requeue the snapshots at the queue FRONT for recompute-from-
+        prompt. Bounded by ``max_resets`` per ``reset_window_s``."""
+        self._reset_requested = None
+        now = time.time()
+        # drop a STALE watchdog trip that raced a recovery the loop
+        # already ran: if the condition the trip fired on no longer
+        # holds (logits finite again / progress since resumed), a second
+        # reset would only burn budget and requeue healthy work
+        if reason == "nan_logits" and self.scheduler.last_step_finite:
+            return
+        if reason == "stalled" \
+                and now - self.last_progress_ts < self.watchdog.stall_s:
+            return
+        self._reset_times = [t for t in self._reset_times
+                             if now - t < self.reset_window_s]
+        if len(self._reset_times) >= self.max_resets:
+            self._give_up(reason)
+            return
+        self._reset_times.append(now)
+        self._last_reset_ts = now
+        self.resets_total += 1
+        obs_metrics.record_llm_reset(reason)
+        self.flight.note("engine_reset", reason=reason,
+                         resets_in_window=len(self._reset_times),
+                         inflight=len(self._inflight))
+        # post-mortem of this episode first — the dump path gets a
+        # monotonic suffix, so earlier episodes survive on disk
+        self.flight.dump(self._flight_path, reason=f"reset:{reason}")
+        self._close_steps_span()
+        # youngest requeued first so the OLDEST lands at the queue head
+        # (each _requeue appendlefts): admission order is preserved
+        victims = sorted(self._inflight.values(),
+                         key=lambda r: r.admit_seq, reverse=True)
+        self._inflight.clear()
+        requeued = 0
+        for req in victims:
+            req.slot = None
+            if self._requeue(req, reason):
+                requeued += 1
+        self.scheduler.reset()
+        self._note_kv_pool()
+        self.flight.note("engine_reset_done", requeued=requeued)
+        self.last_progress_ts = time.time()   # progress resumed: re-arm
+
+    def _requeue(self, req: _Request, reason: str) -> bool:
+        """Snapshot one in-flight request back into the pending queue
+        (front, caller preserves order) for recompute-from-prompt; a
+        request past its requeue budget resolves ``"preempted"`` with
+        the tokens it has. Returns True when requeued."""
+        if req.decode_span is not None:
+            req.decode_span.set_attr("requeued", reason)
+            req.decode_span.set_attr("completion_tokens",
+                                     len(req.out_ids))
+            req.decode_span.end()
+            req.decode_span = None
+        if req.requeues >= self.max_requeues:
+            obs_metrics.record_llm_evict("requeue_exhausted")
+            req.span.add_event("evict", reason="requeue_exhausted")
+            self.flight.note("evict", reason="requeue_exhausted",
+                             requeues=req.requeues)
+            self._finish(req, "preempted")
+            return False
+        req.requeues += 1
+        obs_metrics.record_llm_requeue(reason)
+        req.span.add_event("requeue", reason=reason,
+                           requeues=req.requeues,
+                           tokens_kept=len(req.out_ids))
+        self.flight.note("requeue", reason=reason,
+                         requeues=req.requeues,
+                         tokens_kept=len(req.out_ids))
+        # the re-wait is queue time again — open a fresh queue span so
+        # the waterfall attributes the recovery gap instead of losing it
+        req.queue_span = obs_trace.tracer.start_span(
+            "serving.queue", parent=req.span)
+        req.queue_wait_start = time.time()
+        self._pending.appendleft(req)
+        return True
+
+    def _give_up(self, reason: str) -> None:
+        """Reset budget exhausted: park the engine unhealthy (/healthz
+        503), dump the ring, and resolve every survivor ``"preempted"``
+        — degraded, never wedged."""
+        self._failed = reason
+        logger.error("batch engine: reset budget exhausted (%d resets "
+                     "in %.0fs window) after %s — staying unhealthy",
+                     len(self._reset_times), self.reset_window_s, reason)
+        self.flight.note("engine_failed", reason=reason,
+                         resets_in_window=len(self._reset_times))
+        self.flight.dump(self._flight_path,
+                         reason="reset_budget_exhausted")
+        self._close_steps_span()
+        for req in list(self._inflight.values()):
+            self._retire(req)
+            self._resolve_parked(req)
+        self._drain_queue()
+        while self._pending:
+            self._resolve_parked(self._pending.popleft())
+
+    def _resolve_parked(self, req: _Request) -> None:
+        """Close out one request on a parked engine: partial progress
+        resolves ``"preempted"`` (the tokens it has are real work worth
+        returning), but a ZERO-token request gets the same Overloaded
+        503 a fresh submit would — an empty 200 "success" would read as
+        a served completion and the gateway would never fail it over."""
+        if req.future.done():
+            return
+        if req.out_ids:
+            self._finish(req, "preempted")
+            return
+        from .. import Overloaded
+        obs_metrics.record_llm_reject("engine_failed")
+        self.flight.note("reject", reason="engine_failed")
+        self._end_spans_on_error(req)
+        req.future.set_exception(Overloaded(
+            f"engine unhealthy (reset budget exhausted after "
+            f"{self._failed}); drain and restart the replica",
+            retry_after_s=30.0))
+
+    def _retry_after_s(self, depth: int) -> float:
+        """Retry-After from the live gauges: how long until the queue
+        ahead of a new arrival drains, estimated as (depth / admission
+        headroom) request-walls. Headroom comes from the KV pool's
+        worst-case admission gauge; the wall EMA from finished
+        requests."""
+        wall = self._req_wall_ema if self._req_wall_ema else 1.0
+        try:
+            headroom = max(
+                int(self.scheduler.kv_pool_stats()["headroom_requests"]),
+                1)
+        except Exception:  # noqa: BLE001 — shedding must never raise
+            headroom = 1
+        waves = max(float(depth) / float(headroom), 1.0)
+        return min(max(waves * wall, 0.5), 60.0)
 
     def _retire(self, req: _Request) -> None:
         if req.slot is not None:
@@ -347,6 +684,11 @@ class BatchingEngine:
             req.span.set_attr("queue_wait_s", round(queue_wait, 6))
             req.span.set_attr("tokens_per_s", round(tps, 2))
             obs_metrics.record_llm_request(tps, queue_wait)
+            # request-wall EMA feeds the load-shed Retry-After estimate
+            wall = now - req.submitted_ts
+            self._req_wall_ema = (wall if self._req_wall_ema is None
+                                  else 0.3 * wall
+                                  + 0.7 * self._req_wall_ema)
         # the request span ends FIRST: the still-open phase span's end_ts
         # then lands at-or-after the request's, and the report's clipping
         # attributes the request window tail to it instead of leaving the
@@ -472,20 +814,28 @@ class BatchingEngine:
         status = "ok"
         if not self._running:
             status = "stopped"
+        elif self._failed is not None:
+            status = "failed"
         elif not self.scheduler.last_step_finite:
             status = "nan_logits"
         elif (self.watchdog.stall_s > 0
               and self.scheduler.active_count() > 0
               and age > self.watchdog.stall_s):
             status = "stalled"
-        return {"status": status,
-                "occupancy": self.scheduler.active_count(),
-                "queue_depth": self.queue_depth(),
-                "last_step_age_s": round(age, 3),
-                "steps_run": int(self.scheduler.steps_run),
-                "tokens_per_s": round(self.tokens_per_s(), 2),
-                "watchdog_trips": int(self.watchdog.trips),
-                "flight_records": len(self.flight)}
+        out = {"status": status,
+               "occupancy": self.scheduler.active_count(),
+               "queue_depth": self.queue_depth(),
+               "last_step_age_s": round(age, 3),
+               "steps_run": int(self.scheduler.steps_run),
+               "tokens_per_s": round(self.tokens_per_s(), 2),
+               "watchdog_trips": int(self.watchdog.trips),
+               "resets": int(self.resets_total),
+               "reset_budget_remaining": max(
+                   self.max_resets - len(self._reset_times), 0),
+               "flight_records": len(self.flight)}
+        if self._failed is not None:
+            out["failed_reason"] = self._failed
+        return out
 
     def debug_state(self) -> Dict[str, Any]:
         """``/debug/state`` payload: the scheduler's slot matrix +

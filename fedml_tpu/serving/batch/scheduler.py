@@ -72,10 +72,34 @@ class DecodeScheduler:
         self._seed = np.zeros(s, np.int32)
         self._aidx = np.zeros(s, np.int32)
         self.steps_run = 0
+        self.resets = 0
         # True until a decode step observes NaN/inf in an active slot's
         # logits — the watchdog's poison signal
         self.last_step_finite = True
         self._build_programs()
+
+    # ------------------------------------------------------------- reset --
+    def reset(self) -> None:
+        """Crash-only recovery (Candea & Fox): discard every piece of
+        per-request state — block allocator, slot mirrors, paged KV
+        pools — and come back empty, WITHOUT touching the compiled
+        programs. Geometry is unchanged, so the rebuilt pools slot
+        straight into the cached executables: a reset costs two pool
+        allocations and zero recompiles. ``steps_run`` keeps counting
+        (the chaos plan's step index is monotonic across resets);
+        ``resets`` counts the episodes for /healthz."""
+        self.alloc = kvc.BlockAllocator(self.cache_cfg)
+        self._kp, self._vp = kvc.init_pools(self.cache_cfg,
+                                            self.cfg.compute_dtype)
+        self._active[:] = False
+        self._tables[:] = self.cache_cfg.trash_block
+        self._pos[:] = 0
+        self._last[:] = 0
+        self._temp[:] = 0.0
+        self._seed[:] = 0
+        self._aidx[:] = 0
+        self.last_step_finite = True
+        self.resets += 1
 
     # ------------------------------------------------------------ programs --
     def _build_programs(self) -> None:
@@ -283,6 +307,7 @@ class DecodeScheduler:
                                   .sum())})
             slots.append(row)
         return {"slots": slots, "steps_run": int(self.steps_run),
+                "resets": int(self.resets),
                 "last_step_finite": bool(self.last_step_finite),
                 "kv_pool": self.kv_pool_stats(),
                 "geometry": {

@@ -123,7 +123,13 @@ class CausalLMPredictor(FedMLPredictor):
             default_deadline_s=float(opts.get("deadline_s", 0.0)),
             watchdog_s=float(opts.get("watchdog_s", 30.0)),
             flight_records=int(opts.get("flight_records", 256)),
-            flight_dir=opts.get("flight_dir"))
+            flight_dir=opts.get("flight_dir"),
+            max_resets=int(opts.get("max_resets", 3)),
+            reset_window_s=float(opts.get("reset_window_s", 300.0)),
+            max_requeues=int(opts.get("max_requeues", 2)),
+            preempt_after_s=float(opts.get("preempt_after_s", 0.0)),
+            shed_queue_depth=int(opts.get("shed_queue_depth", 0)),
+            chaos=opts.get("chaos"))
 
     @property
     def adapter_bank(self):
@@ -181,7 +187,23 @@ class CausalLMPredictor(FedMLPredictor):
                                               256)),
                 "flight_dir": (getattr(args, "serving_flight_dir", None)
                                or getattr(args, "log_file_dir", None)),
+                "max_resets": int(getattr(args, "serving_max_resets", 3)),
+                "reset_window_s": float(
+                    getattr(args, "serving_reset_window_s", 300.0)),
+                "max_requeues": int(
+                    getattr(args, "serving_max_requeues", 2)),
+                "preempt_after_s": float(
+                    getattr(args, "serving_preempt_after_s", 0.0)),
+                "shed_queue_depth": int(
+                    getattr(args, "serving_shed_queue_depth", 0)),
             })
+            # seeded serving chaos (engine-side stall/NaN injection);
+            # None unless a chaos_serving_* knob is live, so the default
+            # decode loop never consults a plan
+            if kw["batch_opts"].get("chaos") is None:
+                from ..core.chaos import ServingChaosInjector
+                kw["batch_opts"]["chaos"] = \
+                    ServingChaosInjector.from_args(args)
             adapter_dir = getattr(args, "llm_adapter_dir", None)
             if adapter_dir and kw.get("adapter_bank") is None:
                 from .batch import AdapterBank
@@ -325,6 +347,12 @@ class CausalLMPredictor(FedMLPredictor):
             temperature=request.get("temperature"),
             seed=None if seed is None else int(seed),
             adapter=self._resolve_adapter(request))
+        # OpenAI's finish_reason enum has no server-side eviction values:
+        # "stop" stays "stop", every server-cut reason ("length",
+        # "deadline", "preempted") maps to "length" for client compat,
+        # with the native reason preserved in finish_reason_detail so a
+        # caller can tell "budget spent" from "truncated by the server"
+        native = out["finish_reason"]
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
@@ -333,7 +361,8 @@ class CausalLMPredictor(FedMLPredictor):
             "choices": [{
                 "index": 0,
                 "message": {"role": "assistant", "content": out["text"]},
-                "finish_reason": out["finish_reason"],
+                "finish_reason": "stop" if native == "stop" else "length",
+                "finish_reason_detail": native,
             }],
             "usage": {
                 "prompt_tokens": out["prompt_tokens"],
@@ -350,10 +379,11 @@ class ChatCompletionRunner(FedMLInferenceRunner):
     base runner)."""
 
     def __init__(self, predictor: CausalLMPredictor, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, chaos=None):
         super().__init__(predictor, host=host, port=port,
                          extra_routes={
-                             "/v1/chat/completions": predictor.chat})
+                             "/v1/chat/completions": predictor.chat},
+                         chaos=chaos)
 
 
 def serve_chat(args, params_path: str, host: str = "127.0.0.1",

@@ -54,13 +54,18 @@ def main() -> None:
     from fedml_tpu.core import mlops
     args.run_id = f"{getattr(args, 'run_id', '0')}_replica{os.getpid()}"
     mlops.init(args)
+    # serving chaos in a SUBPROCESS replica is allowed to be lethal:
+    # crash-at-request-N exits this process for real (the gateway's
+    # health-aware failover + the set's health check are what recover)
+    from fedml_tpu.core.chaos import ServingChaosInjector
+    chaos = ServingChaosInjector.from_args(args, hard_crash=True)
     if spec.get("kind") == "causal_lm":
         # LLM template replica: chat route mounted, artifact + bundle
         # rebuilt from the spec's flat config
         from .llm_template import CausalLMPredictor, ChatCompletionRunner
         predictor = CausalLMPredictor.from_artifact(
             args, spec["params_path"])
-        runner = ChatCompletionRunner(predictor)
+        runner = ChatCompletionRunner(predictor, chaos=chaos)
         if predictor.engine is not None:
             from fedml_tpu.core.obs import flight as obs_flight
             obs_flight.install_signal_dump(
@@ -68,7 +73,7 @@ def main() -> None:
     else:
         predictor = CheckpointPredictor.from_files(
             args, spec["params_path"], int(spec["output_dim"]))
-        runner = FedMLInferenceRunner(predictor)
+        runner = FedMLInferenceRunner(predictor, chaos=chaos)
     port = runner.start()
     port_file = spec.get("port_file")
     if port_file:

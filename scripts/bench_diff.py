@@ -35,10 +35,14 @@ from typing import Dict, List, Optional, Tuple
 # matched against the LAST dotted component (the leg field for
 # flattened rows); throughput-ish markers win over the `_s` suffix so
 # "tokens_per_s" reads as higher-is-better while "p99_latency_s" and
-# "time_to_90pct_s" read as lower-is-better
-HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup")
+# "time_to_90pct_s" read as lower-is-better. goodput/success cover the
+# serving chaos leg; resets/trips/faults count recovery EPISODES —
+# fewer is better (same plan, less damage).
+HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
+                  "goodput", "success")
 LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles",
-                 "time_to", "step_time", "wall", "round_s")
+                 "time_to", "step_time", "wall", "round_s",
+                 "resets", "trips", "faults")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

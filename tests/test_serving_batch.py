@@ -388,7 +388,10 @@ class TestEngine:
                 for _ in range(2)]
         assert same[0] == same[1]
 
-    def test_deadline_eviction_finishes_with_length(self, lora_setup):
+    def test_deadline_eviction_finishes_with_deadline(self, lora_setup):
+        """Satellite (ISSUE 11): a deadline eviction resolves with
+        finish_reason "deadline" — clients can tell "budget spent"
+        ("length") apart from "truncated by the server"."""
         _, bundle, params, tok = lora_setup
         batched = CausalLMPredictor(
             bundle, params, tokenizer=tok, mode="batch",
@@ -402,7 +405,7 @@ class TestEngine:
                 max_new_tokens=60, temperature=0.5, seed=9,
                 deadline_s=0.05)
             out = fut.result(timeout=30)
-            assert out["finish_reason"] == "length"
+            assert out["finish_reason"] == "deadline"
             assert out["completion_tokens"] < 60
             after = obs_metrics.REGISTRY.counter(
                 "llm_requests_evicted_total",
@@ -847,7 +850,7 @@ class TestServingTraces:
         finally:
             mlops.init(Arguments(enable_tracking=False))
         assert len(outs) == 7
-        assert evicted["finish_reason"] == "length"
+        assert evicted["finish_reason"] == "deadline"
         assert evicted["completion_tokens"] < 40   # leash cut it short
 
         path = os.path.join(str(tmp_path), "run_trc.jsonl")

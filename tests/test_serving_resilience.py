@@ -1,0 +1,739 @@
+"""Self-healing LLM serving (ISSUE 11): watchdog-driven engine recovery,
+preempt-and-requeue backpressure, load shedding, health-aware gateway
+failover, and the seeded serving chaos plane.
+
+Quick gate: the recovery/requeue/shed/failover mechanics on stub
+schedulers + the recovery-determinism pin on the real tiny model. Slow:
+the c8 crash+stall+NaN chaos soak (every request completes, ledger
+balanced, compile-once) and the subprocess replica-crash path.
+"""
+
+import concurrent.futures as cf
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.chaos import (FaultLedger, FaultPlan,
+                                  ServingChaosInjector)
+from fedml_tpu.core.obs import metrics as obs_metrics
+from fedml_tpu.llm.federated import build_llm
+from fedml_tpu.serving import FedMLInferenceRunner, Overloaded
+from fedml_tpu.serving.batch.engine import BatchingEngine
+from fedml_tpu.serving.llm_template import (CausalLMPredictor,
+                                            ChatCompletionRunner)
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+
+def _args(**kw):
+    base = dict(dataset="llm_synthetic", model="causal_lm",
+                client_num_in_total=2, client_num_per_round=2,
+                comm_round=1, epochs=1, batch_size=4, learning_rate=1e-3,
+                random_seed=3, llm_hidden_size=32, llm_num_layers=2,
+                llm_num_heads=2, llm_intermediate_size=64,
+                llm_max_seq_len=64, lora_rank=4)
+    base.update(kw)
+    return Arguments(**base)
+
+
+@pytest.fixture(scope="module")
+def lora_setup():
+    import jax
+    args = _args()
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return args, bundle, params, tok
+
+
+# ---------------------------------------------------------------- stubs ----
+
+class _FakeScheduler:
+    """Deterministic in-memory scheduler: token t for (seed, position) is
+    a pure function, exactly like the real stateless sampler — so the
+    requeue/recompute path can be exercised without a compile. Faults are
+    driven by flipping ``poison_next``."""
+
+    EOS_NEVER = True
+
+    def __init__(self, slots=2, max_seq_len=100000, num_blocks=1024,
+                 step_delay=0.0):
+        from types import SimpleNamespace
+        self.cfg = SimpleNamespace(max_seq_len=max_seq_len)
+        self.cache_cfg = SimpleNamespace(
+            num_blocks=num_blocks, max_seq_len=max_seq_len,
+            blocks_needed=lambda n: max(1, (n + 15) // 16))
+        self.slots = slots
+        self.step_delay = float(step_delay)
+        self._slots = {}       # slot -> dict(ids, pos, seed)
+        self.steps_run = 0
+        self.resets = 0
+        self.last_step_finite = True
+        self.poison_next = 0   # poison this many upcoming steps
+        self.step_barrier = None   # optional Event: block steps
+
+    # admission ----------------------------------------------------------
+    def can_admit(self, prompt_len, max_new):
+        return len(self._slots) < self.slots
+
+    def admit(self, ids, *, adapter_idx=0, temperature=0.0, seed=0,
+              max_new_tokens=64):
+        slot = min(s for s in range(self.slots) if s not in self._slots)
+        self._slots[slot] = {"ids": list(ids), "pos": len(ids),
+                             "seed": int(seed)}
+        return slot, self._token(int(seed), len(ids))
+
+    def release(self, slot):
+        self._slots.pop(slot, None)
+
+    @staticmethod
+    def _token(seed, position):
+        from fedml_tpu.llm.data import EOS
+        tok = (seed * 31 + position * 7) % 200 + EOS + 1
+        return tok
+
+    # stepping -----------------------------------------------------------
+    def step(self):
+        if self.step_barrier is not None:
+            self.step_barrier.wait(timeout=30)
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        self.steps_run += 1
+        if self.poison_next > 0:
+            self.poison_next -= 1
+            self.last_step_finite = False
+            return {}
+        out = {}
+        for slot, st in self._slots.items():
+            st["pos"] += 1
+            out[slot] = self._token(st["seed"], st["pos"])
+        return out
+
+    def active_count(self):
+        return len(self._slots)
+
+    def slot_position(self, slot):
+        return self._slots[slot]["pos"]
+
+    def reset(self):
+        self._slots.clear()
+        self.last_step_finite = True
+        self.resets += 1
+
+    def kv_pool_stats(self):
+        return {"used_blocks": len(self._slots), "free_blocks": 8,
+                "headroom_requests": max(self.slots - len(self._slots), 1),
+                "fragmentation": 0.0}
+
+    def debug_state(self):
+        return {"slots": sorted(self._slots), "kv_pool":
+                self.kv_pool_stats()}
+
+
+def _drain(fut, timeout=30):
+    return fut.result(timeout=timeout)
+
+
+# -------------------------------------------------- engine recovery ----
+
+class TestEngineRecovery:
+    def test_nan_step_triggers_reset_and_requests_complete(self):
+        """A poisoned step (NaN logits) triggers a controlled reset: the
+        scheduler is rebuilt, in-flight requests are requeued, and they
+        finish with the same tokens an undisturbed run produces."""
+        sched = _FakeScheduler(slots=2)
+        eng = BatchingEngine(sched, watchdog_s=0.0, max_resets=3,
+                             max_requeues=2)
+        try:
+            ref_sched = _FakeScheduler(slots=2)
+            ref = BatchingEngine(ref_sched, watchdog_s=0.0)
+            a = _drain(ref.submit([5, 6, 7], max_new_tokens=8, seed=11))
+            ref.stop()
+
+            sched.poison_next = 1   # the first step emits garbage
+            fut = eng.submit([5, 6, 7], max_new_tokens=8, seed=11)
+            out = _drain(fut)
+            assert out["finish_reason"] == "length"
+            assert out["ids"] == a["ids"]        # bit-identical replay
+            assert sched.resets == 1
+            assert eng.resets_total == 1
+            assert eng.health()["status"] == "ok"   # recovered
+        finally:
+            eng.stop()
+
+    def test_reset_budget_exhausted_parks_unhealthy(self, tmp_path):
+        """Persistent poison exhausts the reset budget: survivors resolve
+        "preempted", /healthz goes (and stays) non-ok, the flight ring is
+        dumped, and new submits are rejected."""
+        sched = _FakeScheduler(slots=2)
+        eng = BatchingEngine(sched, watchdog_s=0.0, max_resets=2,
+                             max_requeues=10, flight_dir=str(tmp_path))
+        try:
+            sched.poison_next = 10 ** 6   # poison every step forever
+            fut = eng.submit([5, 6, 7], max_new_tokens=8)
+            out = _drain(fut)
+            assert out["finish_reason"] == "preempted"
+            deadline = time.time() + 5
+            while time.time() < deadline \
+                    and eng.health()["status"] != "failed":
+                time.sleep(0.02)
+            h = eng.health()
+            assert h["status"] == "failed"
+            assert h["failed_reason"] == "nan_logits"
+            assert h["reset_budget_remaining"] == 0
+            with pytest.raises(RuntimeError, match="unhealthy"):
+                eng.submit([1, 2, 3], max_new_tokens=4)
+            dumps = [p for p in os.listdir(str(tmp_path))
+                     if p.startswith("flight_serving_engine")]
+            assert dumps, "give-up never dumped the flight ring"
+        finally:
+            eng.stop()
+
+    def test_requeue_exhausted_resolves_preempted_with_prefix(self):
+        """A request that keeps getting caught in resets past its requeue
+        budget resolves "preempted" with the tokens it has, not an
+        exception and not a silent "length"."""
+        sched = _FakeScheduler(slots=1, step_delay=0.005)
+        eng = BatchingEngine(sched, watchdog_s=0.0, max_resets=10,
+                             max_requeues=1)
+        try:
+            fut = eng.submit([5, 6], max_new_tokens=200)
+            time.sleep(0.1)       # let some tokens land
+            sched.poison_next = 1
+            time.sleep(0.2)       # reset 1: requeue (budget 1)
+            sched.poison_next = 1
+            out = _drain(fut)
+            assert out["finish_reason"] == "preempted"
+            assert out["completion_tokens"] < 200
+        finally:
+            eng.stop()
+
+    def test_injected_stall_recovers_via_watchdog(self, tmp_path):
+        """The watchdog-driven path end to end: a chaos-injected decode
+        stall stops progress, the watchdog trips, the trip requests a
+        reset, and the stalled request completes after recompute."""
+        plan = FaultPlan(seed=7, serving_stall_at_step=3,
+                         serving_stall_s=30.0)
+        ledger = FaultLedger()
+        inj = ServingChaosInjector(plan, ledger=ledger)
+        sched = _FakeScheduler(slots=2)
+        eng = BatchingEngine(sched, watchdog_s=0.3, max_resets=3,
+                             flight_dir=str(tmp_path), chaos=inj)
+        try:
+            fut = eng.submit([5, 6, 7], max_new_tokens=12, seed=4)
+            out = _drain(fut, timeout=20)
+            assert out["finish_reason"] == "length"
+            assert out["completion_tokens"] == 12
+            assert eng.resets_total >= 1
+            assert eng.watchdog.trips >= 1
+            kinds = [e["kind"] for e in ledger.serving_events()]
+            assert "stall" in kinds          # injected-vs-observed
+            assert eng.health()["status"] == "ok"
+        finally:
+            eng.stop()
+
+    def test_flight_dumps_never_overwrite(self, tmp_path):
+        """Satellite: two recovery episodes in one process leave TWO
+        post-mortem files (monotonic suffix), not one overwritten."""
+        sched = _FakeScheduler(slots=1)
+        eng = BatchingEngine(sched, watchdog_s=0.0, max_resets=5,
+                             flight_dir=str(tmp_path))
+        try:
+            for _ in range(2):
+                fut = eng.submit([5, 6], max_new_tokens=4)
+                sched.poison_next = 1
+                _drain(fut)
+                time.sleep(0.05)
+            assert eng.resets_total == 2
+            dumps = sorted(p for p in os.listdir(str(tmp_path))
+                           if p.startswith("flight_serving_engine"))
+            assert len(dumps) >= 2, dumps
+        finally:
+            eng.stop()
+
+
+# ------------------------------------------- backpressure / shedding ----
+
+class TestBackpressure:
+    def test_preempt_youngest_when_head_starves(self):
+        """Admission starvation preempts the YOUNGEST slot: the starved
+        head admits, the victim requeues (keeping its prefix) and still
+        completes with its full budget."""
+        sched = _FakeScheduler(slots=1, step_delay=0.002)
+        eng = BatchingEngine(sched, watchdog_s=0.0,
+                             preempt_after_s=0.2, max_requeues=3)
+        try:
+            young = eng.submit([9, 9], max_new_tokens=500, seed=1)
+            time.sleep(0.1)   # young owns the only slot
+            starved = eng.submit([5, 6], max_new_tokens=6, seed=2)
+            out = _drain(starved, timeout=10)
+            assert out["finish_reason"] == "length"
+            assert out["completion_tokens"] == 6
+            out_young = _drain(young, timeout=30)
+            assert out_young["completion_tokens"] == 500
+            reqs = obs_metrics.REGISTRY.counter(
+                "llm_requests_requeued_total",
+                labels=("reason",)).value(reason="pressure")
+            assert reqs >= 1
+        finally:
+            eng.stop()
+
+    def test_shed_at_submit_with_retry_after(self):
+        """Past shed_queue_depth, submit fails fast with Overloaded and
+        a positive Retry-After — never a wedged queue."""
+        sched = _FakeScheduler(slots=1)
+        sched.step_barrier = threading.Event()   # wedge decode politely
+        eng = BatchingEngine(sched, watchdog_s=0.0, shed_queue_depth=2)
+        try:
+            futs = [eng.submit([5, 6], max_new_tokens=4)
+                    for _ in range(3)]   # 1 in flight + 2 queued
+            deadline = time.time() + 5
+            while time.time() < deadline and eng.queue_depth() < 2:
+                time.sleep(0.01)
+            with pytest.raises(Overloaded) as ei:
+                eng.submit([7, 8], max_new_tokens=4)
+            assert ei.value.retry_after_s > 0
+        finally:
+            sched.step_barrier.set()
+            for f in futs:
+                _drain(f)
+            eng.stop()
+
+    def test_shed_maps_to_http_503_with_retry_after(self):
+        """The runner maps Overloaded to 503 + Retry-After so overload
+        is a protocol signal, not a 500."""
+        sched = _FakeScheduler(slots=1)
+        sched.step_barrier = threading.Event()
+        eng = BatchingEngine(sched, watchdog_s=0.0, shed_queue_depth=1)
+
+        class _P:
+            def predict(self, request):
+                return _drain(eng.submit([5, 6], max_new_tokens=4),
+                              timeout=30)
+
+            def ready(self):
+                return True
+
+        runner = FedMLInferenceRunner(_P())
+        port = runner.start()
+        try:
+            first = eng.submit([5, 6], max_new_tokens=4)   # holds the slot
+            deadline = time.time() + 5
+            while time.time() < deadline and not eng._inflight:
+                time.sleep(0.01)
+            blocker = eng.submit([5, 6], max_new_tokens=4)  # queued: at bound
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            assert json.load(ei.value)["retry_after_s"] > 0
+        finally:
+            sched.step_barrier.set()
+            _drain(first)
+            _drain(blocker)
+            runner.stop()
+            eng.stop()
+
+
+# -------------------------------------------------- gateway failover ----
+
+class _Echo:
+    def __init__(self, tag="ok"):
+        self.tag = tag
+
+    def predict(self, request):
+        return {"tag": self.tag}
+
+    def ready(self):
+        return True
+
+
+class _DeadReplica:
+    """A replica whose port nothing listens on — the dead-port stub the
+    retry-re-pick regression test needs."""
+
+    def __init__(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        self.port = s.getsockname()[1]
+        s.close()   # nothing listens: connects are refused
+
+    def stop(self):
+        pass
+
+
+class TestGatewayFailover:
+    def test_retry_never_repicks_the_failed_port(self):
+        """Satellite regression: with dead replicas ahead of the live one
+        in rotation, every request still lands — the retry excludes every
+        port that already failed instead of round-robining back onto
+        it."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+        rs = ReplicaSet(lambda: _Echo(), min_replicas=1, max_replicas=4)
+        dead = [_DeadReplica(), _DeadReplica()]
+        with rs._lock:
+            rs.replicas = dead + rs.replicas   # dead ports rotate first
+        gw = Gateway(rs, window_s=2.0, max_failovers=3, backoff_seed=0)
+        try:
+            for _ in range(4):   # every rotation offset
+                assert gw.predict({"x": 1}, timeout=5)["tag"] == "ok"
+        finally:
+            with rs._lock:
+                rs.replicas = [r for r in rs.replicas
+                               if not isinstance(r, _DeadReplica)]
+            rs.stop()
+
+    def test_all_ports_dead_raises_the_connect_error(self):
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+        rs = ReplicaSet.__new__(ReplicaSet)
+        rs._lock = threading.Lock()
+        rs._draining = set()
+        rs.replicas = [_DeadReplica()]
+        gw = Gateway(rs, window_s=2.0, backoff_seed=0)
+        with pytest.raises((urllib.error.URLError, OSError)):
+            gw.predict({"x": 1}, timeout=5)
+
+    def test_unhealthy_replica_is_routed_around(self):
+        """A replica whose /healthz says non-ok (tripped watchdog) is
+        quarantined after one failure and traffic flows to its healthy
+        sibling."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+
+        class _Sick(_Echo):
+            def predict(self, request):
+                raise RuntimeError("wedged")   # 500s every request
+
+            def health(self):
+                return {"status": "stalled"}
+
+        rs = ReplicaSet(lambda: _Echo(), min_replicas=2, max_replicas=2)
+        gw = Gateway(rs, window_s=2.0, backoff_seed=0,
+                     unhealthy_ttl_s=30.0)
+        try:
+            sick_port = rs.ports()[0]
+            gw.probe_health(sick_port)   # healthy now: no quarantine
+            assert not gw._is_quarantined(sick_port)
+            # swap a sick predictor onto replica 0's runner
+            rs.replicas[0].predictor = _Sick()
+            rs.replicas[0].routes["/predict"] = \
+                rs.replicas[0].predictor.predict
+            assert not gw.probe_health(sick_port)   # healthz 503 now
+            assert gw._is_quarantined(sick_port)
+            live = rs.ports()[1]
+            for _ in range(4):   # all traffic lands on the healthy one
+                assert gw.predict({"x": 1}, timeout=5)["tag"] == "ok"
+        finally:
+            rs.stop()
+
+    def test_draining_replica_leaves_rotation_then_restart(self):
+        """The drain -> finish-in-flight -> restart seam: a draining port
+        vanishes from ports(), the gateway keeps serving, restart swaps
+        in a fresh ready replica with zero failed requests."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+        rs = ReplicaSet(lambda: _Echo(), min_replicas=2, max_replicas=3)
+        gw = Gateway(rs, window_s=2.0, backoff_seed=0)
+        try:
+            victim = rs.ports()[0]
+            rs.drain(victim)
+            assert victim not in rs.ports()
+            assert victim in rs.ports(include_draining=True)
+            for _ in range(4):
+                assert gw.predict({"x": 1}, timeout=5)["tag"] == "ok"
+            rs.undrain(victim)
+            fresh = rs.restart_replica(victim, grace_s=0.05)
+            assert fresh != victim
+            assert victim not in rs.ports()
+            assert fresh in rs.ports()
+            for _ in range(4):
+                assert gw.predict({"x": 1}, timeout=5)["tag"] == "ok"
+        finally:
+            rs.stop()
+
+    def test_zero_is_a_legal_fault_index(self):
+        """Regression: 0 == False in Python — crash-at-request-0 /
+        nan-at-step-0 configured via args must not read as 'unset'."""
+        class _A:
+            chaos_seed = 1
+            chaos_serving_crash_at_request = 0
+            chaos_serving_nan_at_step = 0
+        plan = FaultPlan.from_args(_A())
+        assert plan.serving_crash_due(0)
+        assert plan.serving_decode_fault(0) == "nan"
+        assert plan.injects_serving_faults
+
+    def test_parked_engine_503_is_routed_around(self):
+        """A replica whose engine parked unhealthy (reset budget
+        exhausted) answers 503 via the Overloaded mapping — the gateway
+        quarantines it and serves from the healthy sibling instead of
+        surfacing a 500."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+
+        class _Parked(_Echo):
+            def predict(self, request):
+                raise Overloaded("engine unhealthy (reset budget "
+                                 "exhausted)", retry_after_s=30.0)
+
+        rs = ReplicaSet(lambda: _Echo(), min_replicas=2, max_replicas=2)
+        gw = Gateway(rs, window_s=2.0, backoff_seed=0,
+                     unhealthy_ttl_s=30.0)
+        try:
+            rs.replicas[0].predictor = _Parked()
+            rs.replicas[0].routes["/predict"] = \
+                rs.replicas[0].predictor.predict
+            for _ in range(4):
+                assert gw.predict({"x": 1}, timeout=5)["tag"] == "ok"
+            assert gw._is_quarantined(rs.ports()[0])
+        finally:
+            rs.stop()
+
+    def test_chaos_connection_drops_are_retried_and_ledgered(self):
+        """Seeded gateway->replica connection drops fail over instead of
+        surfacing, and land in the fault ledger."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+        plan = FaultPlan(seed=5, serving_conn_drop_prob=0.4)
+        ledger = FaultLedger()
+        inj = ServingChaosInjector(plan, ledger=ledger)
+        rs = ReplicaSet(lambda: _Echo(), min_replicas=2, max_replicas=2)
+        gw = Gateway(rs, window_s=2.0, backoff_seed=0, chaos=inj,
+                     unhealthy_ttl_s=0.05)
+        try:
+            for _ in range(12):
+                assert gw.predict({"x": 1}, timeout=5)["tag"] == "ok"
+            drops = [e for e in ledger.serving_events()
+                     if e["kind"] == "conn_drop"]
+            assert drops   # the seeded plan fired at least once
+        finally:
+            rs.stop()
+
+
+# --------------------------------- recovery determinism (real model) ----
+
+class TestRecoveryDeterminism:
+    def test_seeded_sampled_request_replays_bit_identical(self,
+                                                          lora_setup):
+        """Acceptance pin: a seeded SAMPLED request interrupted mid-decode
+        by an injected engine reset replays bit-identical remaining
+        tokens after requeue — stateless (seed, position) sampling makes
+        recompute-from-prompt exact."""
+        _, bundle, params, tok = lora_setup
+        reference = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 16, "prefill_chunk": 8})
+        try:
+            want = reference.generate("replay me exactly",
+                                      max_new_tokens=24,
+                                      temperature=1.3, seed=42)
+        finally:
+            reference.close()
+        plan = FaultPlan(seed=1, serving_nan_at_step=6)
+        inj = ServingChaosInjector(plan)
+        disturbed = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 16, "prefill_chunk": 8,
+                        "max_resets": 4, "chaos": inj})
+        try:
+            got = disturbed.generate("replay me exactly",
+                                     max_new_tokens=24,
+                                     temperature=1.3, seed=42)
+            eng = disturbed.engine
+            assert eng.resets_total >= 1, \
+                "the injected NaN never triggered a reset"
+            assert got["text"] == want["text"]
+            assert got["completion_tokens"] == want["completion_tokens"]
+            assert got["finish_reason"] == want["finish_reason"]
+        finally:
+            disturbed.close()
+
+
+# ----------------------------------------------------- chat mapping ----
+
+class TestFinishReasonMapping:
+    def test_openai_route_maps_server_cuts_to_length_with_detail(
+            self, lora_setup):
+        """The OpenAI route keeps the client-compatible enum and carries
+        the native reason in finish_reason_detail."""
+        _, bundle, params, tok = lora_setup
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 16, "prefill_chunk": 8,
+                        "deadline_s": 0.03})
+        try:
+            out = pred.chat({"messages": [
+                {"role": "user", "content": "a very long story"}],
+                "max_tokens": 64})
+            choice = out["choices"][0]
+            assert choice["finish_reason"] in ("stop", "length")
+            assert choice["finish_reason_detail"] in (
+                "stop", "length", "deadline", "preempted")
+            if choice["finish_reason_detail"] in ("deadline", "preempted"):
+                assert choice["finish_reason"] == "length"
+        finally:
+            pred.close()
+
+
+# ---------------------------------------------------- chaos soak (c8) ----
+
+@pytest.mark.slow
+class TestServingChaosSoak:
+    def test_c8_crash_stall_nan_soak_all_complete_compile_once(
+            self, lora_setup, xla_compile_counter):
+        """The acceptance pin: under a seeded crash+stall+NaN plan, an
+        8-concurrent session completes EVERY request with zero
+        client-visible failures, the ledger balances injected faults
+        against observed resets, and recovery costs zero steady-state
+        recompiles."""
+        _, bundle, params, tok = lora_setup
+        plan = FaultPlan(seed=13, serving_nan_prob=0.02,
+                         serving_stall_prob=0.02, serving_stall_s=30.0)
+        ledger = FaultLedger()
+        inj = ServingChaosInjector(plan, ledger=ledger)
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 4, "block_size": 16, "prefill_chunk": 8,
+                        "watchdog_s": 0.3, "max_resets": 64,
+                        "max_requeues": 8, "chaos": inj})
+        eng = pred.engine
+        try:
+            pred.generate("warm", max_new_tokens=2)   # compile warmup
+            xla_compile_counter.reset()
+
+            def one(i):
+                return pred.generate(
+                    f"soak request {i} {'pad ' * (i % 5)}",
+                    max_new_tokens=10,
+                    temperature=(0.0 if i % 2 else 1.1), seed=i)
+
+            with cf.ThreadPoolExecutor(8) as ex:
+                outs = list(ex.map(one, range(24)))
+            assert len(outs) == 24
+            # zero client-visible failures: every request resolves with
+            # a natural finish (the plan's faults were all recovered)
+            assert all(o["finish_reason"] in ("stop", "length")
+                       for o in outs), [o["finish_reason"] for o in outs]
+            # the plan actually fired, and every injected engine fault
+            # is balanced by an observed recovery episode
+            injected = [e for e in ledger.serving_events()
+                        if e["kind"] in ("nan", "stall")]
+            assert injected, "seeded plan injected nothing — dead soak"
+            assert eng.resets_total >= 1
+            assert eng.resets_total <= len(injected)
+            assert eng.health()["status"] == "ok"
+            # recovery rebuilt pools/slots with the SAME geometry: zero
+            # steady-state recompiles
+            assert xla_compile_counter.delta() == 0
+        finally:
+            pred.close()
+
+    def test_gateway_masks_replica_crash_and_conn_drops(self, lora_setup):
+        """Zero client-visible failures under replica crash + connection
+        drops: an in-process replica severs its connection at request N
+        (the process-kill analogue) and the seeded plan drops gateway
+        connects — the health-aware failover retries every one onto the
+        healthy sibling, so ALL requests complete."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+        _, bundle, params, tok = lora_setup
+
+        built = []
+
+        def factory():
+            pred = CausalLMPredictor(
+                bundle, params, tokenizer=tok, mode="batch",
+                batch_opts={"slots": 2, "block_size": 16,
+                            "prefill_chunk": 8})
+            built.append(pred)
+            return pred
+
+        crash_inj = ServingChaosInjector(
+            FaultPlan(seed=2, serving_crash_at_request=3))
+
+        class _CrashyRunner(ChatCompletionRunner):
+            def __init__(self, predictor):
+                # the FIRST replica gets the crash plan; siblings are
+                # healthy (one injector fires once across the fleet)
+                super().__init__(predictor,
+                                 chaos=crash_inj if not hasattr(
+                                     _CrashyRunner, "_armed") else None)
+                _CrashyRunner._armed = True
+
+        drop_inj = ServingChaosInjector(
+            FaultPlan(seed=5, serving_conn_drop_prob=0.2),
+            ledger=FaultLedger())
+        rs = ReplicaSet(predictor_factory=factory, min_replicas=2,
+                        max_replicas=2, runner_cls=_CrashyRunner)
+        gw = Gateway(rs, window_s=5.0, backoff_seed=0, chaos=drop_inj,
+                     unhealthy_ttl_s=0.2, max_failovers=4)
+        req = {"messages": [{"role": "user", "content": "ping"}],
+               "max_tokens": 6}
+        try:
+            outs = []
+            with cf.ThreadPoolExecutor(4) as ex:
+                futs = [ex.submit(gw.predict, dict(req),
+                                  timeout=30,
+                                  path="/v1/chat/completions")
+                        for _ in range(12)]
+                outs = [f.result(timeout=60) for f in futs]
+            assert len(outs) == 12
+            assert all(o["object"] == "chat.completion" for o in outs)
+            crashed = crash_inj.ledger.serving_events()
+            assert any(e["kind"] == "replica_crash" for e in crashed), \
+                "the crash never fired — dead scenario"
+        finally:
+            rs.stop()
+            for p in built:
+                p.close()
+
+    def test_subprocess_replica_crash_heals_and_serves(self, tmp_path,
+                                                       lora_setup):
+        """Replica crash-at-request-N (hard, os._exit in the subprocess):
+        the gateway surfaces no garbage, the health check replaces the
+        corpse, and the fleet keeps serving."""
+        import jax
+        from fedml_tpu.serving import save_model
+        from fedml_tpu.serving.autoscale import (
+            Gateway, ReplicaSet, subprocess_replica_factory)
+        args, bundle, params, tok = lora_setup
+        params_path = os.path.join(str(tmp_path), "model.fmtpu")
+        save_model(params, params_path)
+        crash_args = Arguments(**{**{k: v for k, v in
+                                     vars(args).items()
+                                     if not k.startswith("_")},
+                                  "chaos_serving_crash_at_request": 1})
+        factory = subprocess_replica_factory(
+            crash_args, params_path, output_dim=1,
+            workdir=str(tmp_path), kind="causal_lm")
+        rs = ReplicaSet(replica_factory=factory, min_replicas=1,
+                        max_replicas=2)
+        gw = Gateway(rs, window_s=5.0, backoff_seed=0)
+        req = {"messages": [{"role": "user", "content": "ping"}],
+               "max_tokens": 4}
+        try:
+            out = gw.predict(req, path="/v1/chat/completions", timeout=60)
+            assert out["object"] == "chat.completion"   # request 0 fine
+            # request 1 crashes the replica process mid-request; the
+            # gateway must fail cleanly (no hang, no garbage)
+            try:
+                gw.predict(req, path="/v1/chat/completions", timeout=20)
+            except Exception:
+                pass
+            deadline = time.time() + 60
+            healed = 0
+            while time.time() < deadline and not healed:
+                healed = rs.health_check()
+                if not healed:
+                    time.sleep(0.25)
+            assert healed >= 1, "dead replica never replaced"
+            out = gw.predict(req, path="/v1/chat/completions", timeout=60)
+            assert out["object"] == "chat.completion"
+        finally:
+            rs.stop()
