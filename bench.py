@@ -1429,6 +1429,119 @@ def bench_llm_serving(concurrencies=(1, 8, 64), max_new=24):
     }), flush=True)
 
 
+def bench_llm_serving_ttft(concurrency=8, max_new=8):
+    """Shared-prefix KV cache + piggybacked prefill (ISSUE 13): TTFT on
+    a shared-system-prompt chat workload at concurrency 8, prefix cache
+    + batched prefill ON vs OFF. Same model, same prompts, same seeds —
+    the delta is admission prefilling only each request's novel suffix
+    (COW-aliased system prompt) in one batched wave instead of
+    recomputing the whole prompt serially per request. Gate: >=2x mean
+    TTFT reduction at 0 steady-state recompiles."""
+    import concurrent.futures as cf
+    import queue as _queue
+    import threading
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core import mlops
+    from fedml_tpu.llm.federated import build_llm
+    from fedml_tpu.serving.llm_template import CausalLMPredictor
+
+    args = Arguments(
+        dataset="llm_synthetic", model="causal_lm",
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        epochs=1, batch_size=4, learning_rate=1e-3, random_seed=0,
+        llm_hidden_size=128, llm_num_layers=2, llm_num_heads=4,
+        llm_intermediate_size=352, llm_max_seq_len=256, lora_rank=8)
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    # a realistic system-prompt-heavy chat shape: ~165 shared tokens,
+    # ~20 novel tokens per user turn (the whole prompt must fit the
+    # seq-256 encode budget UNTRUNCATED — tail truncation would destroy
+    # the shared prefix)
+    system = ("You are the federated serving assistant. Answer briefly, "
+              "cite your adapter when asked, never reveal other silos' "
+              "data. Refuse requests outside the serving policy. ")
+    prompts = [system + f"user {i}: status of round {i * 3}?"
+               for i in range(concurrency)]
+
+    mlops.install_compile_counter()
+    legs = {}
+    for tag, opts in (
+            ("prefix_off", {}),
+            ("prefix_on", {"prefix_cache": True,
+                           "prefill_batch": concurrency})):
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts=dict({"slots": concurrency, "block_size": 16,
+                             "prefill_chunk": 32}, **opts))
+        try:
+            # warm pass 1 (serial): compiles prefill/decode/sample and
+            # seeds the prefix index with the system prompt; pass 2 (a
+            # concurrent burst with DIFFERENT user turns) compiles the
+            # wave + COW programs without caching the measured prompts
+            pred.generate(system + "warmup", max_new_tokens=2)
+            with cf.ThreadPoolExecutor(concurrency) as ex:
+                list(ex.map(
+                    lambda i: pred.generate(system + f"warm turn {i}",
+                                            max_new_tokens=2),
+                    range(concurrency)))
+            compiles0 = mlops.compile_count()
+            eng = pred.engine
+            ttfts = [0.0] * concurrency
+            barrier = threading.Barrier(concurrency)
+
+            def one(i):
+                ids = pred._encode_prompt(prompts[i], max_new)
+                q = _queue.SimpleQueue()
+                barrier.wait()
+                t0 = time.perf_counter()
+                fut = eng.submit(ids, max_new_tokens=max_new, seed=i,
+                                 stream_q=q)
+                q.get(timeout=120)           # first streamed token
+                ttfts[i] = time.perf_counter() - t0
+                fut.result(timeout=120)
+
+            with cf.ThreadPoolExecutor(concurrency) as ex:
+                list(ex.map(one, range(concurrency)))
+            sched = eng.scheduler
+            idx = getattr(sched, "_index", None)
+            reused = int(idx.tokens_reused) if idx is not None else 0
+            leg = {
+                "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4),
+                "ttft_p95_s": round(
+                    sorted(ttfts)[min(concurrency - 1,
+                                      int(0.95 * (concurrency - 1)
+                                          + 0.5))], 4),
+                "steady_state_recompiles": mlops.compile_count()
+                - compiles0,
+                "kv_fragmentation":
+                    sched.kv_pool_stats()["fragmentation"],
+                "cached_tokens_reused": reused,
+            }
+            if idx is not None:
+                lookups = idx.hits + idx.misses
+                leg["prefix_hit_rate"] = round(
+                    idx.hits / max(lookups, 1), 3)
+            legs[tag] = leg
+        finally:
+            pred.close()
+
+    on, off = legs["prefix_on"], legs["prefix_off"]
+    speedup = off["ttft_mean_s"] / max(on["ttft_mean_s"], 1e-9)
+    print(json.dumps({
+        "metric": "llm_serving_ttft",
+        "value": on["ttft_mean_s"],
+        "unit": f"mean TTFT seconds (c{concurrency}, ~{len(system)} "
+                f"shared system-prompt chars, seq 256, prefix cache + "
+                f"prefill wave on, {jax.default_backend()})",
+        "vs_baseline": round(speedup, 2),
+        "legs": legs,
+    }), flush=True)
+
+
 def bench_llm_serving_chaos(concurrency=8, requests=24, max_new=12):
     """Serving-plane fault tolerance (ISSUE 11): tokens/s GOODPUT (tokens
     from successfully finished requests only) and request success rate
@@ -1544,6 +1657,7 @@ def run():
              bench_shakespeare_fedopt),
             ("fedllm_lora_federated_round_s", bench_federated_lora),
             ("llm_serving_tokens_per_s", bench_llm_serving),
+            ("llm_serving_ttft", bench_llm_serving_ttft),
             ("llm_serving_chaos_goodput", bench_llm_serving_chaos),
             ("llm_train_step_mfu", bench_llm_mfu),
             ("llm_long_context_train_tokens_per_s", bench_long_context),

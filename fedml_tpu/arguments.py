@@ -233,6 +233,24 @@ _SCHEMA: Dict[str, Any] = {
     "chaos_serving_nan_at_step": None,   # poison exactly at this step
     "chaos_serving_conn_drop_prob": 0.0,  # gateway->replica connect drop
     "chaos_serving_crash_at_request": None,  # replica dies on request N
+    # serving perf levers (ISSUE 13) — ALL off by default: wire bytes and
+    # decode tokens stay bit-identical to the pre-ISSUE-13 path.
+    # shared-prefix KV cache: refcounted copy-on-write aliasing of
+    # fully-matched read-only prompt blocks — a system-prompt-heavy chat
+    # workload prefills only its novel suffix (aliasing changes where KV
+    # lives, never its values: greedy decode stays bit-identical)
+    "llm_prefix_cache": False,
+    # piggybacked prefill: batch an admission wave's chunks through one
+    # [B, C] program (B = this width; 0/1 = serial) so K admits cost
+    # ~one pass over the longest novel suffix instead of K serial passes
+    "llm_prefill_batch": 0,
+    # SSE token streaming on /v1/chat/completions for requests carrying
+    # "stream": true (off = the flag is ignored, byte-identical wire)
+    "llm_stream": False,
+    # adapter hot-swap: poll llm_adapter_dir every this-many seconds and
+    # swap changed/new exports live (0 = off); in-flight requests keep
+    # the adapter version they started with
+    "llm_adapter_watch_s": 0.0,
     "llm_adapter_dir": None,           # adapter-bank manifest dir to serve
     # federated-LoRA adapter export: after run_federated_llm, write the
     # global + per-silo personalized adapters as named artifacts the

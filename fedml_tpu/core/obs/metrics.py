@@ -498,12 +498,14 @@ def record_llm_request(tokens_per_s: float, queue_wait_s: float) -> None:
 
 
 def record_llm_kv_pool(used_blocks: int, free_blocks: int,
-                       headroom_requests: int, fragmentation: float
-                       ) -> None:
+                       headroom_requests: int, fragmentation: float,
+                       aliased_blocks: Optional[int] = None,
+                       cached_blocks: Optional[int] = None) -> None:
     """Paged-KV pool state: occupancy, free list, how many WORST-CASE
-    requests the admission reserve could still take, and internal
+    requests the admission reserve could still take, internal
     fragmentation (reserved-but-unwritten fraction of allocated
-    blocks)."""
+    blocks), and — with the shared-prefix cache on — how many blocks
+    are currently shared (refcount >= 2) or held warm by the index."""
     if not _cfg["enabled"]:
         return
     REGISTRY.gauge("llm_kv_blocks_used",
@@ -517,6 +519,70 @@ def record_llm_kv_pool(used_blocks: int, free_blocks: int,
     REGISTRY.gauge("llm_kv_fragmentation",
                    "reserved-but-unwritten fraction of allocated KV "
                    "blocks").set(float(fragmentation))
+    if aliased_blocks is not None:
+        REGISTRY.gauge("llm_kv_aliased_blocks",
+                       "physical KV blocks shared by more than one "
+                       "reference (prefix aliasing)").set(
+                           int(aliased_blocks))
+    if cached_blocks is not None:
+        REGISTRY.gauge("llm_kv_cached_blocks",
+                       "KV blocks pinned warm by the prefix index").set(
+                           int(cached_blocks))
+
+
+def record_llm_prefix_cache(cached_tokens: int, novel_tokens: int) -> None:
+    """Prefix-cache admission outcome: tokens reused from resident
+    blocks vs tokens actually prefilled. The hit-rate the bench gates is
+    ``cached_total / (cached_total + prefilled_total)``."""
+    if not _cfg["enabled"]:
+        return
+    c = REGISTRY.counter("llm_prefix_lookups_total",
+                         "prefix-cache lookups at admission",
+                         labels=("outcome",))
+    c.inc(1, outcome="hit" if cached_tokens > 0 else "miss")
+    REGISTRY.counter("llm_prefix_cached_tokens_total",
+                     "prompt tokens served from cached KV blocks "
+                     "(never prefilled)").inc(int(cached_tokens))
+    REGISTRY.counter("llm_prefix_prefilled_tokens_total",
+                     "prompt tokens actually prefilled").inc(
+                         int(novel_tokens))
+
+
+def record_llm_prefix_evictions(n: int) -> None:
+    """Cached prefix blocks evicted under KV pool pressure."""
+    if not _cfg["enabled"] or not n:
+        return
+    REGISTRY.counter("llm_prefix_evictions_total",
+                     "prefix-cache entries evicted for admission "
+                     "headroom").inc(int(n))
+
+
+def record_llm_prefill_wave(wave_size: int) -> None:
+    """One piggybacked-prefill admission wave of ``wave_size`` requests
+    (1 = a serial admission)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.histogram("llm_prefill_wave_requests",
+                       "admissions batched into one prefill wave",
+                       buckets=OCCUPANCY_BUCKETS).observe(int(wave_size))
+
+
+def record_llm_stream_request() -> None:
+    """One request served as an SSE token stream."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_stream_requests_total",
+                     "requests served as SSE token streams").inc(1)
+
+
+def record_llm_adapter_swap(name: str) -> None:
+    """Adapter hot-swap: a watched export went live as a bank row write
+    (zero restart, zero recompile)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_adapter_swaps_total",
+                     "adapter-bank hot-swaps from the watched export "
+                     "dir", labels=("adapter",)).inc(1, adapter=str(name))
 
 
 def record_llm_adapter(name: str) -> None:

@@ -22,17 +22,47 @@ one-token work per slot and S requests share one compiled program:
 Block allocation/free is host-side bookkeeping (a free list); admission
 reserves the request's worst-case block count up front so decode can never
 hit out-of-memory mid-stream.
+
+Shared-prefix caching (vLLM block sharing / SGLang RadixAttention):
+blocks are REFCOUNTED — a fully-written prompt block can be aliased into
+another slot's table (both tables point at the same physical block) and
+``free()`` only returns a block to the free list when its last reference
+drops. The :class:`PrefixIndex` is the host-side map from prompt content
+(exact ``(parent_block, token_tuple)`` chain keys — no hash collisions
+can alias wrong content) to resident physical blocks; it holds its own
+reference on every cached block so a released slot's prompt prefix stays
+warm for the next request, and under pool pressure cold chains are
+cascade-evicted (a child whose parent is gone could never be matched
+again, so the whole subtree goes at once). Aliased blocks are READ-ONLY
+by construction: a slot's novel prefill and decode writes land only at
+positions past its matched prefix, i.e. in blocks it exclusively owns;
+the one partially-reusable block is copied first (:func:`copy_block_rows`
+— copy-on-write) and only its tail is prefilled.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 PyTree = Any
+
+
+def _stable_items(d: Dict, tries: int = 8) -> List[Tuple[Any, Any]]:
+    """Snapshot a dict the engine worker mutates concurrently: /metrics
+    and /debug/state read refcounts and index metadata from HTTP handler
+    threads, and iterating a dict whose size changes mid-iteration
+    raises RuntimeError in CPython — exactly under the load the operator
+    is trying to inspect. Retry a few times; an empty read beats a 500."""
+    for _ in range(tries):
+        try:
+            return list(d.items())
+        except RuntimeError:
+            continue
+    return []
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,40 +146,274 @@ def scatter_chunk(pool_layer: jnp.ndarray, table_row: jnp.ndarray,
     return pool_layer.at[blk, pos % block_size].set(values)
 
 
+def scatter_chunk_batch(pool_layer: jnp.ndarray, table_rows: jnp.ndarray,
+                        positions: jnp.ndarray, values: jnp.ndarray,
+                        valid: jnp.ndarray, block_size: int,
+                        trash_block: int) -> jnp.ndarray:
+    """Write B slots' prefill chunks in ONE scatter (piggybacked prefill).
+
+    table_rows ``[B, max_blocks]``; positions ``[B, C]``; values
+    ``[B, C, H, D]``; valid ``[B, C]``. Rows in an admission wave own
+    disjoint fresh blocks (aliased prefix blocks are never written —
+    every valid position is past its row's matched prefix), so the
+    flattened scatter has no cross-row conflicts; invalid rows land in
+    the trash block."""
+    b, c = positions.shape
+    pos = jnp.clip(positions, 0, table_rows.shape[1] * block_size - 1)
+    blk = jnp.take_along_axis(table_rows, pos // block_size, axis=1)
+    blk = jnp.where(valid, blk, trash_block)
+    flat = values.reshape((b * c,) + values.shape[2:])
+    return pool_layer.at[blk.reshape(-1), (pos % block_size).reshape(-1)
+                         ].set(flat)
+
+
+def copy_block_rows(pool: jnp.ndarray, src, dst, n_rows) -> jnp.ndarray:
+    """Copy the first ``n_rows`` rows of physical block ``src`` into
+    block ``dst`` across every layer — the admission-time copy-on-write:
+    a partially matched cached block's reusable rows move into a block
+    the new slot OWNS, and the shared source is never written.
+
+    pool ``[L, num_blocks + 1, block_size, H, D]``; ``src``/``dst``/
+    ``n_rows`` are DATA (int32), so one compiled program covers every
+    COW copy."""
+    bs = pool.shape[2]
+    keep = (jnp.arange(bs) < n_rows)[None, :, None, None]
+    merged = jnp.where(keep, pool[:, src], pool[:, dst])
+    return pool.at[:, dst].set(merged)
+
+
 class BlockAllocator:
-    """Host-side free-list over the physical pool. Admission reserves the
-    request's worst-case block count up front (prompt + max_new_tokens,
-    clamped to max_seq_len), so a decoding slot can never fail to grow."""
+    """Host-side refcounted free-list over the physical pool. Admission
+    reserves the request's worst-case block count up front (prompt +
+    max_new_tokens, clamped to max_seq_len), so a decoding slot can never
+    fail to grow. A block may be referenced by several holders at once —
+    multiple slots aliasing a shared prefix plus the prefix index's own
+    pin — and returns to the free list only when the LAST reference
+    drops (``free()`` on an aliased block while a reader still holds it
+    merely decrements)."""
 
     def __init__(self, cfg: KVCacheConfig):
         self.cfg = cfg
         self._free: List[int] = list(range(cfg.num_blocks))
         self._owned: dict = {}   # slot -> list of physical block ids
+        self._refs: Dict[int, int] = {}   # block -> live reference count
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        return self._refs.get(int(block), 0)
+
+    def refcounts(self) -> Dict[int, int]:
+        """Per-block live reference counts (the /debug/state payload;
+        safe to call from handler threads)."""
+        return dict(_stable_items(self._refs))
+
+    def aliased_blocks(self) -> int:
+        """Blocks held by more than one reference (shared prefix)."""
+        return sum(1 for _, c in _stable_items(self._refs) if c >= 2)
+
     def can_alloc(self, n_tokens: int) -> bool:
         return self.cfg.blocks_needed(n_tokens) <= len(self._free)
 
-    def alloc(self, slot: int, n_tokens: int) -> np.ndarray:
+    def alloc(self, slot: int, n_tokens: int,
+              shared: Sequence[int] = ()) -> np.ndarray:
         """Reserve blocks for ``n_tokens`` positions; returns the slot's
-        table row ``[max_blocks_per_slot]`` (unused entries = trash)."""
-        need = self.cfg.blocks_needed(n_tokens)
+        table row ``[max_blocks_per_slot]`` (unused entries = trash).
+
+        ``shared``: already-written physical blocks aliased as the row's
+        LEADING entries (their refcount is bumped; the slot must never
+        write them) — only the remainder comes off the free list."""
+        shared = [int(b) for b in shared]
+        need = self.cfg.blocks_needed(n_tokens) - len(shared)
+        if need < 0:
+            raise ValueError(
+                f"{len(shared)} shared blocks exceed the "
+                f"{self.cfg.blocks_needed(n_tokens)} needed for "
+                f"{n_tokens} tokens")
         if need > len(self._free):
             raise RuntimeError(
                 f"KV pool exhausted: need {need} blocks, "
                 f"{len(self._free)} free")
         if slot in self._owned:
             raise RuntimeError(f"slot {slot} already holds blocks")
-        blocks = [self._free.pop() for _ in range(need)]
+        for b in shared:
+            if self._refs.get(b, 0) <= 0:
+                raise RuntimeError(
+                    f"block {b} aliased while unreferenced (stale "
+                    "prefix-index entry?)")
+            self._refs[b] += 1
+        fresh = []
+        for _ in range(need):
+            b = self._free.pop()
+            self._refs[b] = 1
+            fresh.append(b)
+        blocks = shared + fresh
         self._owned[slot] = blocks
         row = np.full((self.cfg.max_blocks_per_slot,),
                       self.cfg.trash_block, np.int32)
-        row[:need] = blocks
+        row[:len(blocks)] = blocks
         return row
+
+    def retain(self, block: int) -> None:
+        """Extra pin on a live block (the prefix index's hold on a cached
+        block: the block survives its writer slot's release)."""
+        b = int(block)
+        if self._refs.get(b, 0) <= 0:
+            raise RuntimeError(f"retain of unreferenced block {b}")
+        self._refs[b] += 1
+
+    def release_block(self, block: int) -> bool:
+        """Drop one reference; the block returns to the free list only at
+        zero. Returns True when the block was actually freed."""
+        b = int(block)
+        n = self._refs.get(b, 0)
+        if n <= 0:
+            raise RuntimeError(f"block {b} over-freed")
+        if n == 1:
+            del self._refs[b]
+            self._free.append(b)
+            return True
+        self._refs[b] = n - 1
+        return False
 
     def free(self, slot: int) -> None:
         for b in self._owned.pop(slot, []):
-            self._free.append(b)
+            self.release_block(b)
+
+
+class PrefixIndex:
+    """Host-side shared-prefix index: prompt content → resident blocks.
+
+    One entry per cached physical block, keyed by the EXACT
+    ``(parent_block_id, tuple(block_tokens))`` pair — token equality, not
+    a hash, decides a match, so a collision can never alias wrong KV.
+    Only FULL prompt blocks are indexed (their contents are complete
+    after prefill and never rewritten: decode writes land past the
+    prompt), and every entry holds one allocator reference so the cached
+    chain outlives the slot that wrote it. ``last-used`` ordering is a
+    logical tick, not wall time — eviction order is deterministic for a
+    given admit sequence."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        # (parent_block, tokens) -> block; meta: block -> {key, parent, tick}
+        self._entries: Dict[Tuple[int, Tuple[int, ...]], int] = {}
+        self._meta: Dict[int, Dict[str, Any]] = {}
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._meta)
+
+    def match(self, ids: Sequence[int]) -> List[int]:
+        """Longest indexed chain of full blocks prefixing ``ids`` →
+        physical block ids, oldest first (the caller caps actual reuse at
+        ``len(ids) - 1`` so the last prompt token is always prefilled and
+        yields the first-token logits). Bumps the chain's recency."""
+        bs = self.block_size
+        self._tick += 1
+        chain: List[int] = []
+        parent = -1
+        for i in range(len(ids) // bs):
+            key = (parent, tuple(int(t) for t in ids[i * bs:(i + 1) * bs]))
+            blk = self._entries.get(key)
+            if blk is None:
+                break
+            self._meta[blk]["tick"] = self._tick
+            chain.append(blk)
+            parent = blk
+        return chain
+
+    def insert(self, ids: Sequence[int], row: np.ndarray, n_tokens: int,
+               alloc: BlockAllocator) -> int:
+        """Register every full block of ``ids[:n_tokens]`` (now fully
+        written in the pool) under an allocator pin; blocks whose chain
+        key already exists are skipped (never double-pinned — the chain
+        continues through the block already indexed). Returns the number
+        of newly indexed blocks."""
+        bs = self.block_size
+        self._tick += 1
+        parent = -1
+        added = 0
+        for i in range(int(n_tokens) // bs):
+            key = (parent, tuple(int(t) for t in ids[i * bs:(i + 1) * bs]))
+            blk = self._entries.get(key)
+            if blk is None:
+                blk = int(row[i])
+                if blk in self._meta:
+                    # same block already indexed under another key is
+                    # impossible (a block is written by one slot under
+                    # one content); guard anyway rather than double-pin
+                    parent = blk
+                    continue
+                alloc.retain(blk)
+                self._entries[key] = blk
+                self._meta[blk] = {"key": key, "parent": parent,
+                                   "tick": self._tick}
+                added += 1
+            else:
+                self._meta[blk]["tick"] = self._tick
+            parent = blk
+        return added
+
+    def reclaimable(self, alloc: BlockAllocator) -> int:
+        """Cached blocks only the index still references — the blocks an
+        eviction sweep could actually return to the free list. Read from
+        handler threads too (kv_pool_stats), so snapshot defensively."""
+        return sum(1 for b, _ in _stable_items(self._meta)
+                   if alloc.refcount(b) == 1)
+
+    def _subtree(self, root: int) -> List[int]:
+        out: List[int] = []
+        frontier = {root}
+        while frontier:
+            out.extend(sorted(frontier))
+            frontier = {b for b, m in self._meta.items()
+                        if m["parent"] in frontier and b not in out}
+        return out
+
+    def evict(self, alloc: BlockAllocator, need_free: int,
+              protect: Sequence[int] = ()) -> int:
+        """Cascade-evict least-recently-used chains until the allocator
+        has ``need_free`` free blocks (or nothing evictable remains).
+        Evicting an entry drops the INDEX pin only — a block a reader
+        slot still aliases stays resident until the reader releases.
+        ``protect``: blocks the in-progress admission just matched (about
+        to be aliased) — their subtrees are skipped. Returns the number
+        of blocks actually freed."""
+        protect_set = {int(b) for b in protect}
+        skipped: set = set()
+        freed = 0
+        while alloc.free_blocks < need_free:
+            candidates = [b for b in self._meta if b not in skipped]
+            if not candidates:
+                break
+            victim = min(candidates,
+                         key=lambda b: (self._meta[b]["tick"], b))
+            sub = self._subtree(victim)
+            if protect_set.intersection(sub):
+                skipped.add(victim)
+                continue
+            for blk in sub:
+                key = self._meta.pop(blk)["key"]
+                del self._entries[key]
+                self.evictions += 1
+                if alloc.release_block(blk):
+                    freed += 1
+        return freed
+
+    def debug_state(self) -> Dict[str, Any]:
+        return {"entries": len(self._entries),
+                "cached_blocks": len(self._meta),
+                "hits": int(self.hits), "misses": int(self.misses),
+                "tokens_reused": int(self.tokens_reused),
+                "evictions": int(self.evictions)}

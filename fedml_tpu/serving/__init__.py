@@ -53,6 +53,22 @@ class Overloaded(RuntimeError):
         self.retry_after_s = max(float(retry_after_s), 0.0)
 
 
+class SSEStream:
+    """A route handler's STREAMING verdict: instead of one JSON body,
+    the HTTP layer writes each yielded event as a ``text/event-stream``
+    ``data:`` frame (dicts are JSON-encoded; strings pass through),
+    closing with ``data: [DONE]`` — the OpenAI streaming wire shape, so
+    existing OpenAI streaming clients consume a served federated
+    fine-tune unchanged. Errors raised by the iterator AFTER the headers
+    went out surface as a final ``data: {"error": ...}`` frame (the
+    status line is already on the wire; a mid-stream 500 is not a thing
+    HTTP has)."""
+
+    def __init__(self, events, headers: Optional[dict] = None):
+        self.events = events
+        self.headers = dict(headers or {})
+
+
 def save_model(params: PyTree, path: str) -> str:
     """Persist model params with the wire codec (``dumps_tree``). No
     pickle: artifacts may cross trust boundaries (device uploads, served
@@ -189,6 +205,42 @@ class FedMLInferenceRunner:
                 self.end_headers()
                 self.wfile.write(blob)
 
+            def _reply_stream(self, stream: SSEStream,
+                              traceparent: Optional[str] = None) -> None:
+                """Write an SSE event stream (no Content-Length; the
+                HTTP/1.0 connection close delimits the body, so plain
+                read-to-EOF clients work)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                if traceparent:
+                    self.send_header("traceparent", traceparent)
+                for k, v in stream.headers.items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                events = iter(stream.events)
+                try:
+                    for ev in events:
+                        blob = ev if isinstance(ev, str) else json.dumps(ev)
+                        self.wfile.write(f"data: {blob}\n\n".encode())
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away mid-stream: stop generating
+                    close = getattr(events, "close", None)
+                    if close is not None:
+                        close()
+                except Exception as e:  # noqa: BLE001 — headers are out
+                    logger.exception("stream handler failed mid-stream")
+                    try:
+                        self.wfile.write(
+                            ("data: " + json.dumps({"error": str(e)})
+                             + "\n\n").encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+
             def do_GET(self):
                 if self.path == "/ready":
                     ok = runner.predictor.ready()
@@ -232,8 +284,13 @@ class FedMLInferenceRunner:
                     try:
                         n = int(self.headers.get("Content-Length", 0))
                         request = json.loads(self.rfile.read(n) or b"{}")
-                        self._reply(200, handler(request),
-                                    traceparent=sp.traceparent())
+                        resp = handler(request)
+                        if isinstance(resp, SSEStream):
+                            self._reply_stream(
+                                resp, traceparent=sp.traceparent())
+                        else:
+                            self._reply(200, resp,
+                                        traceparent=sp.traceparent())
                     except Overloaded as e:
                         # shed (or parked-unhealthy engine), not failed:
                         # 503 + Retry-After tells the client — and the

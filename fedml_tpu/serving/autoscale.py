@@ -534,19 +534,20 @@ class Gateway:
             return port
         return None
 
-    def predict(self, request: dict, timeout: float = 30.0,
-                path: str = "/predict") -> dict:
-        """Route one request to a replica; ``path`` selects the replica
-        route (e.g. ``/v1/chat/completions`` on LLM replicas)."""
+    def _connect(self, request: dict, timeout: float, path: str):
+        """The ONE failover loop (predict and stream share it): pick a
+        routable port, ride out chaos connection drops, quarantine 503
+        sheds (honoring the replica's Retry-After) and connection-phase
+        failures, and return an OPEN ``HTTPResponse`` from the first
+        replica that starts answering. Raises the last failure (or
+        RuntimeError) once every attempt is spent."""
         from ..core.distributed.communication.backoff import backoff_delays
-        from ..core.obs import metrics as obs_metrics
         from ..core.obs import trace as obs_trace
         body = json.dumps(request).encode()
         headers = {"Content-Type": "application/json"}
         cur = obs_trace.current_span()
         if cur is not None and cur.traceparent():
             headers["traceparent"] = cur.traceparent()
-        t0 = time.perf_counter()
         delays = backoff_delays(base_s=0.05, factor=2.0, max_s=0.5,
                                 seed=self.backoff_seed)
         tried: set = set()
@@ -568,8 +569,7 @@ class Gateway:
                 f"http://127.0.0.1:{port}{path}", data=body,
                 headers=headers)
             try:
-                with urllib.request.urlopen(req, timeout=timeout) as r:
-                    out = json.load(r)
+                return urllib.request.urlopen(req, timeout=timeout)
             except urllib.error.HTTPError as e:
                 if e.code == 503:
                     # shed or parked-unhealthy replica: the request was
@@ -598,13 +598,54 @@ class Gateway:
                 last_exc = e
                 time.sleep(next(delays))
                 continue
-            dt = time.perf_counter() - t0
-            obs_metrics.record_gateway_latency(dt)
-            self._window.observe(dt)
-            return out
         if last_exc is not None:
             raise last_exc
         raise RuntimeError("no live replicas")
+
+    def _observe_latency(self, t0: float) -> None:
+        from ..core.obs import metrics as obs_metrics
+        dt = time.perf_counter() - t0
+        obs_metrics.record_gateway_latency(dt)
+        self._window.observe(dt)
+
+    def predict(self, request: dict, timeout: float = 30.0,
+                path: str = "/predict") -> dict:
+        """Route one request to a replica; ``path`` selects the replica
+        route (e.g. ``/v1/chat/completions`` on LLM replicas)."""
+        t0 = time.perf_counter()
+        with self._connect(request, timeout, path) as r:
+            out = json.load(r)
+        self._observe_latency(t0)
+        return out
+
+    def stream(self, request: dict, timeout: float = 30.0,
+               path: str = "/v1/chat/completions"):
+        """Streaming pass-through: route one SSE request to a replica
+        and yield each ``data:`` payload string as it arrives (the
+        ``[DONE]`` terminator is consumed, not yielded). Failover (dead
+        connect, 503 shed) applies only until the response starts —
+        once frames are flowing the stream belongs to that replica and
+        an error surfaces to the caller. A replica answering plain JSON
+        (its ``llm_stream`` knob off) degrades gracefully: the whole
+        body is yielded as the single event."""
+        t0 = time.perf_counter()
+        resp = self._connect(request, timeout, path)
+        try:
+            ctype = resp.headers.get("Content-Type", "")
+            if "text/event-stream" not in ctype:
+                yield resp.read().decode("utf-8", "replace")
+            else:
+                for raw in resp:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        break
+                    yield data
+        finally:
+            resp.close()
+        self._observe_latency(t0)
 
     def metrics(self) -> GatewayMetrics:
         """Trailing-window :class:`GatewayMetrics` from the shared

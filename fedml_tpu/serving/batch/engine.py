@@ -86,7 +86,8 @@ class _Request:
     __slots__ = ("ids", "max_new", "temperature", "seed", "adapter_idx",
                  "deadline_ts", "future", "span", "out_ids", "slot",
                  "submitted_ts", "queue_span", "decode_span", "admit_ts",
-                 "decode_ts", "requeues", "admit_seq", "queue_wait_start")
+                 "decode_ts", "requeues", "admit_seq", "queue_wait_start",
+                 "stream_q", "adapter_pinned")
 
     def __init__(self, ids, max_new, temperature, seed, adapter_idx,
                  deadline_ts, span):
@@ -111,6 +112,13 @@ class _Request:
         # requeue, else a once-preempted request instantly reads as
         # starved and preempts its preemptor — ping-pong)
         self.queue_wait_start = self.submitted_ts
+        # SSE streaming: tokens are pushed here as they decode; a
+        # requeue/recovery replays transparently (the kept prefix is
+        # never re-emitted — only genuinely new tokens flow)
+        self.stream_q = None
+        # hot-swap safety: a pinned adapter row is never reused while
+        # this request (including its requeued replays) is in flight
+        self.adapter_pinned = False
 
 
 class BatchingEngine:
@@ -144,6 +152,7 @@ class BatchingEngine:
         self.resets_total = 0
         self._failed: Optional[str] = None   # reset budget exhausted
         self._admit_counter = 0
+        self._wave_seq = 0       # piggybacked-prefill wave stamp
         self._req_wall_ema: Optional[float] = None   # Retry-After input
         self._last_fault_step = -1   # one plan consult per step index
         # --- black box + watchdog ------------------------------------------
@@ -176,7 +185,8 @@ class BatchingEngine:
                temperature: float = 0.0, seed: int = 0,
                adapter_idx: int = 0,
                deadline_s: Optional[float] = None,
-               parent: Any = None) -> Future:
+               parent: Any = None, stream_q=None,
+               adapter_pre_pinned: bool = False) -> Future:
         """Enqueue one request; the future resolves to ``{"ids",
         "finish_reason", "prompt_tokens", "completion_tokens"}``.
 
@@ -184,7 +194,19 @@ class BatchingEngine:
         SpanContext, or raw traceparent string — e.g. an inbound HTTP
         header); with no parent the request joins the submitting
         thread's current span (the HTTP surface's ``serving.http``) or
-        roots a fresh trace."""
+        roots a fresh trace.
+
+        ``stream_q``: an optional queue; each generated token is put as
+        ``("token", id)`` the step it decodes, followed by one
+        ``("finish", reason)`` after the future resolves (``("error",
+        msg)`` on failure). A preempt/reset replay is transparent
+        mid-stream: the kept prefix is never re-emitted.
+
+        ``adapter_pre_pinned``: the caller already holds the adapter
+        row's pin (an atomic name-resolve + retain — the template's
+        hot-swap-safe path); ownership transfers to the request and is
+        released at resolution. Raises before the request object exists
+        (stopped/failed/shed) leave the pin with the caller."""
         if not self._running:
             obs_metrics.record_llm_reject("engine_stopped")
             raise RuntimeError("engine stopped")
@@ -223,6 +245,10 @@ class BatchingEngine:
         req = _Request(list(map(int, prompt_ids)), max_new_tokens,
                        temperature, seed, adapter_idx,
                        time.time() + dl if dl > 0 else None, span)
+        req.stream_q = stream_q
+        # from here on the request owns the caller's pin: every early
+        # resolution below (_finish/_reject) releases it
+        req.adapter_pinned = bool(adapter_pre_pinned)
         if req.max_new <= 0 or not req.ids:
             self._finish(req, "length")
             return req.future
@@ -251,6 +277,16 @@ class BatchingEngine:
         # unattributed wall in the waterfall
         if req.queue_span.span_id is not None:
             req.queue_span.start_ts = span.start_ts
+        # pin the adapter row for the request's whole lifetime (incl.
+        # requeued replays): a hot-swap repoints the NAME to a new row,
+        # but this row is not reused until the pin drops — in-flight
+        # requests keep the version they started with. (A pre-pinned
+        # caller already did this atomically with name resolution.)
+        if not req.adapter_pinned:
+            bank = getattr(self.scheduler, "bank", None)
+            if bank is not None and hasattr(bank, "retain_row"):
+                bank.retain_row(req.adapter_idx)
+                req.adapter_pinned = True
         self.flight.note("submit", prompt_tokens=len(req.ids),
                          max_new=req.max_new, adapter_idx=req.adapter_idx,
                          trace_id=span.trace_id)
@@ -261,7 +297,28 @@ class BatchingEngine:
         obs_metrics.record_llm_reject(reason)
         self.flight.note("reject", reason=reason)
         req.span.set_attr("error", reason).end()
+        self._release_adapter_pin(req)
+        self._stream_error(req, err)
         req.future.set_exception(err)
+
+    def _release_adapter_pin(self, req: _Request) -> None:
+        if not req.adapter_pinned:
+            return
+        req.adapter_pinned = False
+        bank = getattr(self.scheduler, "bank", None)
+        if bank is not None and hasattr(bank, "release_row"):
+            try:
+                bank.release_row(req.adapter_idx)
+            except Exception:  # noqa: BLE001 — resolution must not raise
+                logger.exception("adapter pin release failed")
+
+    @staticmethod
+    def _stream_error(req: _Request, err: Exception) -> None:
+        if req.stream_q is not None:
+            try:
+                req.stream_q.put(("error", str(err)))
+            except Exception:  # noqa: BLE001
+                pass
 
     def queue_depth(self) -> int:
         return self._q.qsize() + len(self._pending)
@@ -369,6 +426,9 @@ class BatchingEngine:
                 return
 
     def _admit(self) -> None:
+        wave_w = int(getattr(self.scheduler, "prefill_batch", 0) or 0)
+        use_wave = wave_w > 1 and hasattr(self.scheduler, "begin_admit")
+        wave: List[tuple] = []   # (req, pending, dequeue_ts, span)
         while self._pending:
             req = self._pending[0]
             now = time.time()
@@ -391,11 +451,18 @@ class BatchingEngine:
                 self._finish(req, "length")
                 continue
             if not self.scheduler.can_admit(len(admit_ids), remaining):
+                if wave:
+                    break   # flush the collected wave; retry next pass
                 if not self._maybe_preempt_for(req, now):
-                    return
+                    break
                 if not self.scheduler.can_admit(len(admit_ids),
                                                 remaining):
-                    return
+                    break
+            if not use_wave:
+                self._pending.popleft()
+                self._admit_one(req, admit_ids, remaining)
+                continue
+            # piggybacked admission: reserve now, prefill as one wave
             self._pending.popleft()
             dequeue_ts = time.time()
             if req.queue_span is not None:
@@ -407,48 +474,141 @@ class BatchingEngine:
             if prefill_span.span_id is not None:
                 prefill_span.start_ts = dequeue_ts  # stitch to queue end
             try:
-                slot, first = self.scheduler.admit(
+                pending = self.scheduler.begin_admit(
                     admit_ids, adapter_idx=req.adapter_idx,
                     temperature=req.temperature, seed=req.seed,
                     max_new_tokens=remaining)
             except Exception as e:  # noqa: BLE001
                 prefill_span.set_attr("error", type(e).__name__).end()
                 req.span.set_attr("error", type(e).__name__).end()
+                self._release_adapter_pin(req)
+                self._stream_error(req, e)
                 req.future.set_exception(e)
                 continue
-            now = time.time()
-            self.last_progress_ts = now  # a slow prefill is not a stall
-            prefill_span.set_attr("slot", slot)
-            first_admit = req.decode_ts is None
-            req.slot = slot
-            self._admit_counter += 1
-            req.admit_seq = self._admit_counter
-            if first_admit:
-                req.admit_ts = dequeue_ts
-                req.decode_ts = now
-                # first token exists the moment prefill returns: TTFT is
-                # submit -> here (queue wait + chunked prefill, Orca's
-                # SLO). A RE-admission keeps the original TTFT — the
-                # user saw their first token before the reset.
-                req.span.set_attr("ttft_s",
-                                  round(now - req.submitted_ts, 6))
-                obs_metrics.record_llm_ttft(now - req.submitted_ts)
-            req.span.add_event("admit", slot=slot,
-                               recompute=not first_admit)
-            obs_metrics.record_llm_admit()
-            self._note_kv_pool()
-            self.flight.note(
-                "admit", slot=slot, recompute=not first_admit,
-                queue_wait_s=round(dequeue_ts - req.submitted_ts, 6))
-            self._inflight[slot] = req
-            req.decode_span = obs_trace.tracer.start_span(
-                "serving.decode", parent=req.span, attrs={"slot": slot})
-            if req.decode_span.span_id is not None:
-                req.decode_span.start_ts = now  # stitch to prefill end
-            prefill_span.end()
-            self._note_tokens(1)
-            if not self._append_token(req, first):
-                self._retire(req)
+            if pending is None:   # raced out of space since can_admit
+                prefill_span.end()
+                self._requeue_front(req)
+                break
+            wave.append((req, pending, dequeue_ts, prefill_span))
+            if len(wave) >= wave_w:
+                self._flush_wave(wave)
+                wave = []
+        if wave:
+            self._flush_wave(wave)
+
+    def _requeue_front(self, req: _Request) -> None:
+        """Put an unadmittable dequeued head back where it was, with a
+        fresh queue span so the renewed wait stays attributed."""
+        req.queue_span = obs_trace.tracer.start_span(
+            "serving.queue", parent=req.span)
+        self._pending.appendleft(req)
+
+    def _admit_one(self, req: _Request, admit_ids: List[int],
+                   remaining: int) -> None:
+        """The serial (non-wave) admission path — one chunked prefill
+        per request, today's default."""
+        dequeue_ts = time.time()
+        if req.queue_span is not None:
+            req.queue_span.end()
+            req.queue_span = None
+        prefill_span = obs_trace.tracer.start_span(
+            "serving.prefill", parent=req.span,
+            attrs={"prompt_tokens": len(admit_ids)})
+        if prefill_span.span_id is not None:
+            prefill_span.start_ts = dequeue_ts  # stitch to queue end
+        try:
+            slot, first = self.scheduler.admit(
+                admit_ids, adapter_idx=req.adapter_idx,
+                temperature=req.temperature, seed=req.seed,
+                max_new_tokens=remaining)
+        except Exception as e:  # noqa: BLE001
+            prefill_span.set_attr("error", type(e).__name__).end()
+            req.span.set_attr("error", type(e).__name__).end()
+            self._release_adapter_pin(req)
+            self._stream_error(req, e)
+            req.future.set_exception(e)
+            return
+        info = getattr(self.scheduler, "last_admit_info", None)
+        self._post_admit(req, slot, first, dequeue_ts, prefill_span,
+                         info)
+
+    def _flush_wave(self, wave: List[tuple]) -> None:
+        """Run one piggybacked prefill over the collected admissions and
+        complete their per-request bookkeeping."""
+        self._wave_seq += 1
+        obs_metrics.record_llm_prefill_wave(len(wave))
+        try:
+            firsts = self.scheduler.finish_admits(
+                [pending for _, pending, _, _ in wave])
+        except Exception as e:  # noqa: BLE001
+            logger.exception("piggybacked prefill wave failed")
+            for req, pending, _, span in wave:
+                try:
+                    self.scheduler.abort_admit(pending)
+                except Exception:  # noqa: BLE001
+                    pass
+                span.set_attr("error", type(e).__name__).end()
+                req.span.set_attr("error", type(e).__name__).end()
+                self._release_adapter_pin(req)
+                self._stream_error(req, e)
+                req.future.set_exception(e)
+            return
+        for (req, pending, dequeue_ts, span), first in zip(wave, firsts):
+            self._post_admit(req, pending.slot, first, dequeue_ts, span,
+                             pending.info, wave_id=self._wave_seq,
+                             wave_size=len(wave))
+
+    def _post_admit(self, req: _Request, slot: int, first: int,
+                    dequeue_ts: float, prefill_span,
+                    info: Optional[Dict[str, Any]],
+                    wave_id: Optional[int] = None,
+                    wave_size: int = 1) -> None:
+        now = time.time()
+        self.last_progress_ts = now  # a slow prefill is not a stall
+        prefill_span.set_attr("slot", slot)
+        if info:
+            # the serving_report waterfall's prefix-cache annotation:
+            # tokens served from resident blocks vs actually prefilled
+            prefill_span.set_attr("cached_tokens",
+                                  int(info.get("cached_tokens", 0)))
+            prefill_span.set_attr("novel_tokens",
+                                  int(info.get("novel_tokens", 0)))
+        if wave_id is not None:
+            prefill_span.set_attr("wave", int(wave_id))
+            prefill_span.set_attr("wave_size", int(wave_size))
+        first_admit = req.decode_ts is None
+        req.slot = slot
+        self._admit_counter += 1
+        req.admit_seq = self._admit_counter
+        if first_admit:
+            req.admit_ts = dequeue_ts
+            req.decode_ts = now
+            # first token exists the moment prefill returns: TTFT is
+            # submit -> here (queue wait + chunked prefill, Orca's
+            # SLO). A RE-admission keeps the original TTFT — the
+            # user saw their first token before the reset.
+            req.span.set_attr("ttft_s",
+                              round(now - req.submitted_ts, 6))
+            obs_metrics.record_llm_ttft(now - req.submitted_ts)
+        req.span.add_event("admit", slot=slot,
+                           recompute=not first_admit)
+        obs_metrics.record_llm_admit()
+        self._note_kv_pool()
+        note = {"slot": slot, "recompute": not first_admit,
+                "queue_wait_s": round(dequeue_ts - req.submitted_ts, 6)}
+        if info:
+            note["cached_tokens"] = int(info.get("cached_tokens", 0))
+            note["aliased_blocks"] = int(info.get("aliased_blocks", 0))
+        self.flight.note("admit", **note)
+        self._inflight[slot] = req
+        req.decode_span = obs_trace.tracer.start_span(
+            "serving.decode", parent=req.span, attrs={"slot": slot})
+        if req.decode_span.span_id is not None:
+            req.decode_span.start_ts = now  # stitch to prefill end
+        prefill_span.end()
+        self._note_tokens(1)
+        if not self._append_token(req, first):
+            self._retire(req)
 
     def _maybe_preempt_for(self, starved: _Request, now: float) -> bool:
         """Graceful degradation: when the queue head has starved past
@@ -488,6 +648,8 @@ class BatchingEngine:
             self._finish(req, "stop")
             return False
         req.out_ids.append(int(token))
+        if req.stream_q is not None:
+            req.stream_q.put(("token", int(token)))
         if (len(req.out_ids) % PROGRESS_EVERY_TOKENS == 0
                 and req.decode_span is not None):
             req.decode_span.add_event("decode.progress",
@@ -643,10 +805,13 @@ class BatchingEngine:
         obs_metrics.record_llm_reject("engine_failed")
         self.flight.note("reject", reason="engine_failed")
         self._end_spans_on_error(req)
-        req.future.set_exception(Overloaded(
+        err = Overloaded(
             f"engine unhealthy (reset budget exhausted after "
             f"{self._failed}); drain and restart the replica",
-            retry_after_s=30.0))
+            retry_after_s=30.0)
+        self._release_adapter_pin(req)
+        self._stream_error(req, err)
+        req.future.set_exception(err)
 
     def _retry_after_s(self, depth: int) -> float:
         """Retry-After from the live gauges: how long until the queue
@@ -703,10 +868,15 @@ class BatchingEngine:
             req.decode_span = None
         self.flight.note("finish", reason=reason,
                          completion_tokens=len(req.out_ids))
+        self._release_adapter_pin(req)
         req.future.set_result({
             "ids": list(req.out_ids), "finish_reason": reason,
             "prompt_tokens": len(req.ids),
             "completion_tokens": len(req.out_ids)})
+        if req.stream_q is not None:
+            # after set_result: the stream consumer reading the finish
+            # frame can immediately collect the resolved usage totals
+            req.stream_q.put(("finish", reason))
 
     def _fail_all(self, err: Exception) -> None:
         self._drain_queue()   # a submit racing stop() must fail too
@@ -714,10 +884,14 @@ class BatchingEngine:
             self._retire(req)
             if not req.future.done():
                 self._end_spans_on_error(req)
+                self._release_adapter_pin(req)
+                self._stream_error(req, err)
                 req.future.set_exception(err)
         for req in list(self._pending):
             if not req.future.done():
                 self._end_spans_on_error(req)
+                self._release_adapter_pin(req)
+                self._stream_error(req, err)
                 req.future.set_exception(err)
         self._pending.clear()
 
@@ -747,7 +921,9 @@ class BatchingEngine:
         st = self.scheduler.kv_pool_stats()
         obs_metrics.record_llm_kv_pool(
             st["used_blocks"], st["free_blocks"],
-            st["headroom_requests"], st["fragmentation"])
+            st["headroom_requests"], st["fragmentation"],
+            aliased_blocks=st.get("aliased_blocks"),
+            cached_blocks=st.get("cached_blocks"))
 
     def _observe_step(self, tokens_out: int, wall_s: float) -> None:
         self.last_progress_ts = time.time()

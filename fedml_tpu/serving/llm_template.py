@@ -25,7 +25,7 @@ from __future__ import annotations
 import logging
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -58,7 +58,7 @@ class CausalLMPredictor(FedMLPredictor):
                  max_seq_len: Optional[int] = None,
                  temperature: float = 0.0, mode: str = "single",
                  batch_opts: Optional[Dict[str, Any]] = None,
-                 adapter_bank=None):
+                 adapter_bank=None, stream: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -70,6 +70,10 @@ class CausalLMPredictor(FedMLPredictor):
         self.max_seq_len = int(max_seq_len or bundle.cfg.max_seq_len)
         self.temperature = float(temperature)
         self.mode = str(mode)
+        # llm_stream knob: with it OFF a request carrying "stream": true
+        # gets the ordinary JSON completion — the wire stays byte-
+        # identical to the pre-streaming path
+        self.stream_enabled = bool(stream)
         if self.mode not in ("single", "batch"):
             raise ValueError(f"llm_serving_mode {mode!r}: single|batch")
 
@@ -117,7 +121,9 @@ class CausalLMPredictor(FedMLPredictor):
             slots=int(opts.get("slots", 8)),
             block_size=int(opts.get("block_size", 16)),
             num_blocks=opts.get("num_blocks"),
-            prefill_chunk=int(opts.get("prefill_chunk", 32)))
+            prefill_chunk=int(opts.get("prefill_chunk", 32)),
+            prefix_cache=bool(opts.get("prefix_cache", False)),
+            prefill_batch=int(opts.get("prefill_batch", 0) or 0))
         self._engine = BatchingEngine(
             scheduler,
             default_deadline_s=float(opts.get("deadline_s", 0.0)),
@@ -152,6 +158,8 @@ class CausalLMPredictor(FedMLPredictor):
         return {"mode": "single", "max_seq_len": self.max_seq_len}
 
     def close(self) -> None:
+        if self._bank is not None and hasattr(self._bank, "stop_watch"):
+            self._bank.stop_watch()
         if self._engine is not None:
             self._engine.stop()
             self._engine = None
@@ -196,6 +204,10 @@ class CausalLMPredictor(FedMLPredictor):
                     getattr(args, "serving_preempt_after_s", 0.0)),
                 "shed_queue_depth": int(
                     getattr(args, "serving_shed_queue_depth", 0)),
+                "prefix_cache": bool(
+                    getattr(args, "llm_prefix_cache", False)),
+                "prefill_batch": int(
+                    getattr(args, "llm_prefill_batch", 0) or 0),
             })
             # seeded serving chaos (engine-side stall/NaN injection);
             # None unless a chaos_serving_* knob is live, so the default
@@ -212,6 +224,14 @@ class CausalLMPredictor(FedMLPredictor):
                     alpha=float(getattr(args, "lora_alpha", 16.0)),
                     capacity=int(getattr(args, "serving_max_adapters",
                                          64)))
+                # adapter hot-swap: watch the export dir so a fresh
+                # federated round's adapters go live with zero restart
+                watch_s = float(getattr(args, "llm_adapter_watch_s",
+                                        0.0) or 0.0)
+                if watch_s > 0:
+                    kw["adapter_bank"].watch_dir(adapter_dir,
+                                                 poll_s=watch_s)
+        kw.setdefault("stream", bool(getattr(args, "llm_stream", False)))
         return cls(bundle, load_model(params_path), tokenizer=tokenizer,
                    **kw)
 
@@ -279,22 +299,53 @@ class CausalLMPredictor(FedMLPredictor):
                 "prompt_tokens": n_prompt,
                 "completion_tokens": len(out_ids)}
 
-    def _generate_batched(self, ids: List[int], max_new_tokens: int,
-                          temp: float, seed: int,
-                          adapter: Optional[str]) -> Dict[str, Any]:
+    def _resolve_aidx(self, adapter: Optional[str]) -> Tuple[int, bool]:
+        """Adapter name → ``(bank row index, pinned)`` — the ONE
+        resolution path for batched and streamed requests. Resolution
+        and pinning happen atomically (:meth:`AdapterBank.acquire`), so
+        a concurrent hot-swap can never retire-and-reuse the row between
+        lookup and submit; the pin transfers to the engine request
+        (released at resolution) via ``adapter_pre_pinned``."""
         if adapter is not None and self._bank is None:
             raise ValueError(
                 f"adapter {adapter!r} requested but no adapter bank is "
                 "loaded (full fine-tune artifact without llm_adapter_dir)")
-        aidx = (self._bank.index(adapter) if adapter is not None
-                else self._default_aidx)
+        pinned = False
+        if adapter is not None:
+            aidx = self._bank.acquire(adapter)
+            pinned = aidx > 0
+        else:
+            aidx = self._default_aidx
+            if self._bank is not None and aidx > 0:
+                self._bank.retain_row(aidx)   # fixed idx: no name race
+                pinned = True
         from ..core.obs import metrics as obs_metrics
         obs_metrics.record_llm_adapter(
             adapter if adapter is not None
             else ("default" if self._default_aidx else "base"))
-        fut = self._engine.submit(ids, max_new_tokens=int(max_new_tokens),
-                                  temperature=temp, seed=seed,
-                                  adapter_idx=aidx)
+        return aidx, pinned
+
+    def _submit_pinned(self, ids: List[int], *, max_new_tokens: int,
+                       temp: float, seed: int, adapter: Optional[str],
+                       stream_q=None):
+        """Resolve+pin the adapter and submit; a submit that raises
+        before the engine owns the request releases the pin here."""
+        aidx, pinned = self._resolve_aidx(adapter)
+        try:
+            return self._engine.submit(
+                ids, max_new_tokens=int(max_new_tokens),
+                temperature=temp, seed=seed, adapter_idx=aidx,
+                adapter_pre_pinned=pinned, stream_q=stream_q)
+        except Exception:
+            if pinned:
+                self._bank.release_row(aidx)
+            raise
+
+    def _generate_batched(self, ids: List[int], max_new_tokens: int,
+                          temp: float, seed: int,
+                          adapter: Optional[str]) -> Dict[str, Any]:
+        fut = self._submit_pinned(ids, max_new_tokens=max_new_tokens,
+                                  temp=temp, seed=seed, adapter=adapter)
         out = fut.result(timeout=self._request_timeout_s)
         return {"text": self.tokenizer.decode(out["ids"]),
                 "finish_reason": out["finish_reason"],
@@ -333,7 +384,11 @@ class CausalLMPredictor(FedMLPredictor):
     def chat(self, request: Any) -> Any:
         """OpenAI ``/v1/chat/completions`` schema. The prompt is the
         concatenated user/system turns (the instruction-tuning format the
-        federated fine-tune trained on: instruction ++ SEP ++ response)."""
+        federated fine-tune trained on: instruction ++ SEP ++ response).
+        With the ``llm_stream`` knob on, a request carrying ``"stream":
+        true`` returns ``text/event-stream`` chunk deltas instead (knob
+        off ⇒ the stream flag is ignored and the wire is byte-identical
+        to the pre-streaming path)."""
         messages = request.get("messages") or []
         # keep EVERY turn (assistant replies included) — dropping the
         # model's own prior turns would make multi-turn continuations
@@ -341,6 +396,9 @@ class CausalLMPredictor(FedMLPredictor):
         prompt = "\n".join(str(m.get("content", "")) for m in messages
                            if m.get("content"))
         seed = request.get("seed")
+        if (self.stream_enabled and request.get("stream")
+                and self._engine is not None):
+            return self._chat_stream(request, prompt, seed)
         out = self.generate(
             prompt,
             max_new_tokens=int(request.get("max_tokens", 64)),
@@ -371,6 +429,86 @@ class CausalLMPredictor(FedMLPredictor):
                 + out["completion_tokens"],
             },
         }
+
+    def _chat_stream(self, request: Any, prompt: str, seed) -> Any:
+        """SSE token streaming: submit with a stream queue and emit one
+        OpenAI ``chat.completion.chunk`` per decoded text delta, closed
+        by a finish frame carrying ``finish_reason`` +
+        ``finish_reason_detail`` and the usage totals. An engine
+        preempt/requeue (PR 11 recovery) replays transparently
+        mid-stream — the kept prefix is never re-emitted, the stream
+        just pauses over the recompute gap."""
+        import os as _os
+        import queue as _queue
+
+        from . import SSEStream
+        from ..core.obs import metrics as obs_metrics
+
+        temp = (self.temperature if request.get("temperature") is None
+                else float(request.get("temperature")))
+        if seed is None:
+            seed = int.from_bytes(_os.urandom(4), "little") & 0x7FFFFFFF
+        max_new = int(request.get("max_tokens", 64))
+        obs_metrics.record_llm_stream_request()
+        ids = self._encode_prompt(prompt, max_new)
+        q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        # submit BEFORE returning the stream: an Overloaded/validation
+        # verdict still surfaces as the ordinary HTTP error, not a
+        # broken half-stream
+        fut = self._submit_pinned(ids, max_new_tokens=max_new,
+                                  temp=temp, seed=int(seed),
+                                  adapter=self._resolve_adapter(request),
+                                  stream_q=q)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        model = str(request.get("model", self.bundle.name))
+        deadline = time.time() + self._request_timeout_s
+
+        def chunk(delta: Dict[str, Any], finish=None, **extra):
+            out = {"id": rid, "object": "chat.completion.chunk",
+                   "created": created, "model": model,
+                   "choices": [{"index": 0, "delta": delta,
+                                "finish_reason": finish}]}
+            out["choices"][0].update(extra)
+            return out
+
+        def events():
+            yield chunk({"role": "assistant", "content": ""})
+            toks: List[int] = []
+            emitted = ""
+            while True:
+                try:
+                    kind, val = q.get(
+                        timeout=max(deadline - time.time(), 0.001))
+                except _queue.Empty:
+                    raise TimeoutError(
+                        f"stream stalled past request_timeout_s "
+                        f"{self._request_timeout_s}")
+                if kind == "token":
+                    toks.append(int(val))
+                    text = self.tokenizer.decode(toks)
+                    delta = text[len(emitted):]
+                    if delta:
+                        emitted = text
+                        yield chunk({"content": delta})
+                elif kind == "finish":
+                    native = str(val)
+                    out = fut.result(timeout=5.0)
+                    yield chunk(
+                        {}, finish="stop" if native == "stop"
+                        else "length",
+                        finish_reason_detail=native,
+                        usage={
+                            "prompt_tokens": out["prompt_tokens"],
+                            "completion_tokens":
+                                out["completion_tokens"],
+                            "total_tokens": out["prompt_tokens"]
+                            + out["completion_tokens"]})
+                    return
+                else:   # ("error", msg)
+                    raise RuntimeError(f"stream failed: {val}")
+
+        return SSEStream(events())
 
 
 class ChatCompletionRunner(FedMLInferenceRunner):

@@ -37,12 +37,16 @@ from typing import Dict, List, Optional, Tuple
 # "tokens_per_s" reads as higher-is-better while "p99_latency_s" and
 # "time_to_90pct_s" read as lower-is-better. goodput/success cover the
 # serving chaos leg; resets/trips/faults count recovery EPISODES —
-# fewer is better (same plan, less damage).
+# fewer is better (same plan, less damage). hit_rate/reused cover the
+# prefix-cache leg (more prompt tokens served from cached KV is
+# better); fragmentation/ttft are the gauges the cache must DRIVE DOWN
+# (llm_ttft_seconds, llm_kv_fragmentation — ttft_* fields also end in
+# `_s` and read lower-is-better via the suffix rule).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
-                  "goodput", "success")
+                  "goodput", "success", "hit_rate", "reused")
 LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles",
                  "time_to", "step_time", "wall", "round_s",
-                 "resets", "trips", "faults")
+                 "resets", "trips", "faults", "fragmentation", "ttft")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

@@ -95,6 +95,7 @@ def analyze_request(root: dict, children: List[dict]) -> Dict[str, Any]:
     wall = max(hi - lo, 1e-12)
     phase_s: Dict[str, float] = {}
     covered: List[Tuple[float, float]] = []
+    cached = novel = wave = None
     for c in children:
         s = max(float(c["start_ts"]), lo)
         e = min(float(c["end_ts"]), hi)
@@ -102,6 +103,16 @@ def analyze_request(root: dict, children: List[dict]) -> Dict[str, Any]:
             continue
         covered.append((s, e))
         phase_s[c["name"]] = phase_s.get(c["name"], 0.0) + (e - s)
+        if c["name"] == "serving.prefill":
+            # prefix-cache + piggybacked-prefill annotations (the FIRST
+            # admission's numbers; a requeued recompute overwrites them
+            # with its own, which is the admission that last ran)
+            ca = c.get("attrs", {}) or {}
+            if "cached_tokens" in ca:
+                cached = ca.get("cached_tokens")
+                novel = ca.get("novel_tokens")
+            if "wave" in ca:
+                wave = ca.get("wave")
     attrs = root.get("attrs", {}) or {}
     return {
         "trace_id": root["trace_id"],
@@ -115,6 +126,9 @@ def analyze_request(root: dict, children: List[dict]) -> Dict[str, Any]:
         "ttft_s": attrs.get("ttft_s"),
         "queue_wait_s": attrs.get("queue_wait_s"),
         "tokens_per_s": attrs.get("tokens_per_s"),
+        "cached_tokens": cached,
+        "novel_tokens": novel,
+        "wave": wave,
     }
 
 
@@ -147,8 +161,8 @@ def print_report(spans: List[dict], snapshots: List[dict],
             for r in requests]
 
     hdr = (f"{'request':<22} {'wall_s':>8} {'queue':>8} {'prefill':>8} "
-           f"{'decode':>8} {'ttft_s':>7} {'tok/s':>7} {'finish':>8} "
-           f"{'attr%':>6}  trace")
+           f"{'decode':>8} {'ttft_s':>7} {'tok/s':>7} {'cache':>9} "
+           f"{'wave':>5} {'finish':>8} {'attr%':>6}  trace")
     print(hdr, file=out)
     print("-" * len(hdr), file=out)
     worst = 1.0
@@ -159,12 +173,22 @@ def print_report(spans: List[dict], snapshots: List[dict],
                  f"->{a['completion_tokens'] if a['completion_tokens'] is not None else '?'}tok")
         ttft = a["ttft_s"]
         tps = a["tokens_per_s"]
+        # prefix-cache annotation: tokens reused from resident KV blocks
+        # vs tokens actually prefilled; wave = piggybacked-prefill batch
+        # membership (rows sharing a wave id admitted in one pass)
+        if a["cached_tokens"] is None:
+            cache = "-"
+        else:
+            total = int(a["cached_tokens"]) + int(a["novel_tokens"] or 0)
+            cache = f"{a['cached_tokens']}/{total}"
+        wave = f"w{a['wave']}" if a["wave"] is not None else "-"
         print(f"{label:<22} {a['wall_s']:>8.4f} "
               f"{p.get('serving.queue', 0.0):>8.4f} "
               f"{p.get('serving.prefill', 0.0):>8.4f} "
               f"{p.get('serving.decode', 0.0):>8.4f} "
               f"{ttft if ttft is not None else float('nan'):>7.3f} "
               f"{tps if tps is not None else float('nan'):>7.1f} "
+              f"{cache:>9} {wave:>5} "
               f"{str(a['finish_reason']):>8} "
               f"{100.0 * a['attributed_frac']:>5.1f}%  "
               f"{a['trace_id'][:12]}", file=out)

@@ -1,0 +1,418 @@
+"""Shared-prefix KV cache + piggybacked prefill (ISSUE 13): refcounted
+block aliasing correctness (free never reclaims a shared block while a
+reader holds it), copy-on-write never mutating a shared block,
+bit-identical decode with the cache on, eviction of cache holders under
+KV pressure, wave-prefill parity, compile-once across the new programs,
+and the /debug/state + flight-recorder diagnosis surface.
+"""
+
+import concurrent.futures as cf
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.llm.federated import build_llm
+from fedml_tpu.llm.kv_cache import (BlockAllocator, KVCacheConfig,
+                                    PrefixIndex)
+from fedml_tpu.serving.batch import DecodeScheduler
+from fedml_tpu.serving.llm_template import CausalLMPredictor
+
+pytestmark = pytest.mark.serving
+
+
+def _args(**kw):
+    base = dict(dataset="llm_synthetic", model="causal_lm",
+                client_num_in_total=2, client_num_per_round=2,
+                comm_round=1, epochs=1, batch_size=4, learning_rate=1e-3,
+                random_seed=3, llm_hidden_size=32, llm_num_layers=2,
+                llm_num_heads=2, llm_intermediate_size=64,
+                llm_max_seq_len=128, lora_rank=4)
+    base.update(kw)
+    return Arguments(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    args = _args()
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return args, bundle, params, tok
+
+
+def _sched(bundle, **kw):
+    opts = dict(slots=4, block_size=8, prefill_chunk=8)
+    opts.update(kw)
+    return DecodeScheduler(bundle.module, bundle.cfg, bundle.base_params,
+                           None, **opts)
+
+
+def _run(sched, ids, n=6, seed=0, temp=0.0):
+    slot, first = sched.admit(ids, seed=seed, temperature=temp,
+                              max_new_tokens=n)
+    out = [first]
+    for _ in range(n - 1):
+        out.append(sched.step()[slot])
+    sched.release(slot)
+    return out
+
+
+def _enc(tok, p):
+    return [1] + tok.encode(p) + [3]
+
+
+# ------------------------------------------------ allocator refcounts ----
+
+class TestRefcountedAllocator:
+    CFG = KVCacheConfig(num_layers=1, kv_heads=1, head_dim=4,
+                        max_seq_len=64, block_size=8, num_blocks=16)
+
+    def test_free_never_reclaims_aliased_block_with_live_reader(self):
+        """The correctness core: the writer releases, but the aliased
+        block must NOT return to the free list while the reader (or the
+        prefix index) still references it."""
+        alloc = BlockAllocator(self.CFG)
+        row_a = alloc.alloc(0, 24)                      # 3 blocks
+        shared = [int(b) for b in row_a[:2]]
+        alloc.alloc(1, 24, shared=shared)               # aliases 2 of them
+        free0 = alloc.free_blocks
+        alloc.free(0)                                   # writer releases
+        # only the UNshared third block came back
+        assert alloc.free_blocks == free0 + 1
+        for b in shared:
+            assert alloc.refcount(b) == 1               # reader's ref
+        alloc.free(1)                                   # reader releases
+        assert alloc.free_blocks == self.CFG.num_blocks  # all returned
+        assert all(alloc.refcount(b) == 0 for b in shared)
+
+    def test_index_pin_survives_writer_release(self):
+        alloc = BlockAllocator(self.CFG)
+        row = alloc.alloc(0, 16)
+        alloc.retain(int(row[0]))                       # index pin
+        alloc.free(0)
+        assert alloc.refcount(int(row[0])) == 1         # still resident
+        assert alloc.release_block(int(row[0]))         # now it frees
+
+    def test_over_free_raises(self):
+        alloc = BlockAllocator(self.CFG)
+        row = alloc.alloc(0, 8)
+        alloc.free(0)
+        with pytest.raises(RuntimeError, match="over-freed"):
+            alloc.release_block(int(row[0]))
+
+    def test_alias_of_unreferenced_block_raises(self):
+        """A stale prefix-index entry must fail loudly, never silently
+        alias a reallocated block's foreign content."""
+        alloc = BlockAllocator(self.CFG)
+        row = alloc.alloc(0, 8)
+        alloc.free(0)
+        with pytest.raises(RuntimeError, match="unreferenced"):
+            alloc.alloc(1, 16, shared=[int(row[0])])
+
+
+class TestPrefixIndexHost:
+    CFG = KVCacheConfig(num_layers=1, kv_heads=1, head_dim=4,
+                        max_seq_len=64, block_size=4, num_blocks=16)
+
+    def test_match_is_exact_token_equality(self):
+        alloc = BlockAllocator(self.CFG)
+        idx = PrefixIndex(4)
+        ids = list(range(10, 22))                       # 3 full blocks
+        row = alloc.alloc(0, len(ids))
+        idx.insert(ids, row, len(ids), alloc)
+        assert idx.match(ids) == [int(b) for b in row[:3]]
+        # same first block, divergent second: only the first matches
+        div = ids[:4] + [99] * 8
+        assert idx.match(div) == [int(row[0])]
+        assert idx.match([99] * 12) == []
+
+    def test_cascade_eviction_frees_whole_chain(self):
+        alloc = BlockAllocator(self.CFG)
+        idx = PrefixIndex(4)
+        ids = list(range(10, 22))
+        row = alloc.alloc(0, len(ids))
+        idx.insert(ids, row, len(ids), alloc)
+        alloc.free(0)                                   # index-only pins
+        assert alloc.free_blocks == self.CFG.num_blocks - 3
+        freed = idx.evict(alloc, self.CFG.num_blocks)
+        assert freed == 3 and len(idx) == 0
+        assert alloc.free_blocks == self.CFG.num_blocks
+
+    def test_protected_chain_is_skipped(self):
+        alloc = BlockAllocator(self.CFG)
+        idx = PrefixIndex(4)
+        ids = list(range(10, 22))
+        row = alloc.alloc(0, len(ids))
+        idx.insert(ids, row, len(ids), alloc)
+        alloc.free(0)
+        idx.evict(alloc, self.CFG.num_blocks,
+                  protect=[int(b) for b in row[:3]])    # the matched chain
+        # an admission protects its WHOLE matched chain: nothing evicted
+        assert len(idx) == 3
+        # a protected ROOT alone still shields itself (its subtree
+        # intersects the protect set) while unprotected descendants go
+        idx.evict(alloc, self.CFG.num_blocks, protect=[int(row[0])])
+        assert idx.match(ids) == [int(row[0])]
+
+
+# ----------------------------------------------------- COW + parity ----
+
+class TestPrefixCacheParity:
+    def test_shared_prefix_bit_identical_and_cow_never_mutates(
+            self, setup):
+        """Two requests sharing a prefix: the second aliases the first's
+        blocks (COW for the partial one) and decodes bit-identically to
+        the cache-off path; the shared source block's bytes are
+        untouched by the second request's prefill + decode."""
+        _, bundle, params, tok = setup
+        base = _sched(bundle)
+        pc = _sched(bundle, prefix_cache=True)
+        sys_p = "You are a concise federated assistant. "
+        p1 = _enc(tok, sys_p + "first question")
+        p2 = _enc(tok, sys_p + "second, longer question entirely")
+        ref1, ref2 = _run(base, p1), _run(base, p2)
+        assert _run(pc, p1) == ref1                     # cold
+        info_miss = pc.last_admit_info
+        assert info_miss["cached_tokens"] == 0
+        # bytes of the soon-to-be-shared blocks, before the aliasing
+        chain = pc._index.match(p2)
+        assert chain, "warm lookup found no shared prefix"
+        kp_before = np.asarray(pc._kp)[:, chain]
+        assert _run(pc, p2) == ref2                     # warm, aliased
+        info_hit = pc.last_admit_info
+        assert info_hit["cached_tokens"] > 0
+        assert info_hit["aliased_blocks"] >= 1
+        kp_after = np.asarray(pc._kp)[:, chain]
+        assert np.array_equal(kp_before, kp_after), \
+            "a shared (read-only) block was mutated"
+
+    def test_cow_partial_block_copy(self, setup):
+        """A prompt fully covered by cached blocks forces the cap: the
+        last block is COW-copied (bs-1 rows) and exactly one token is
+        prefilled — still bit-identical."""
+        _, bundle, params, tok = setup
+        base = _sched(bundle)
+        pc = _sched(bundle, prefix_cache=True)
+        p32 = _enc(tok, "y" * 30)                       # 32 = 4 full blocks
+        assert len(p32) % 8 == 0
+        ref = _run(base, p32)
+        assert _run(pc, p32) == ref
+        assert _run(pc, p32) == ref                     # warm: COW path
+        assert pc.last_admit_info["cow_rows"] == 7
+        assert pc.last_admit_info["novel_tokens"] == 1
+
+    def test_sampled_decode_unchanged_by_aliasing(self, setup):
+        _, bundle, params, tok = setup
+        base = _sched(bundle)
+        pc = _sched(bundle, prefix_cache=True)
+        p = _enc(tok, "sampling prefix shared across requests q")
+        ref = _run(base, p, seed=11, temp=1.3)
+        assert _run(pc, p, seed=11, temp=1.3) == ref
+        assert _run(pc, p, seed=11, temp=1.3) == ref    # warm
+
+    def test_default_scheduler_has_no_cache_machinery(self, setup):
+        _, bundle, params, tok = setup
+        s = _sched(bundle)
+        assert s._index is None
+        assert "prefix_cache" not in s.debug_state()
+
+
+# ---------------------------------------------------------- eviction ----
+
+class TestEvictionUnderPressure:
+    def test_cache_holder_evicted_for_admission(self, setup):
+        """KV pressure: a new request that cannot fit alongside the warm
+        cache evicts the cold chains (can_admit counts them as
+        reclaimable) and admits."""
+        _, bundle, params, tok = setup
+        pc = _sched(bundle, slots=2, num_blocks=10, prefix_cache=True)
+        small = _enc(tok, "cached prompt xyz")          # 19 tok
+        _run(pc, small, n=4)
+        assert pc._index.cached_blocks == 2             # 2 full blocks
+        big = _enc(tok, "B" * 53)                       # 55 tok
+        # needs ceil((55 + 9)/8) = 8 blocks; free = 10 - 2 = 8... leave
+        # no slack: the pool must evict to fit
+        assert pc.can_admit(len(big), 17)               # 72 tok = 9 blocks
+        out = _run(pc, big, n=17)
+        assert len(out) == 17
+        assert pc._index.evictions >= 1
+
+    def test_reader_held_cache_block_survives_eviction(self, setup):
+        """Evicting an index entry whose block a live slot aliases drops
+        only the index pin — the reader decodes on, bit-identically."""
+        _, bundle, params, tok = setup
+        base = _sched(bundle)
+        pc = _sched(bundle, slots=3, num_blocks=12, prefix_cache=True)
+        shared = _enc(tok, "hold this prefix steady ok")   # 28 tok
+        ref = _run(base, shared, n=10, seed=5)
+        _run(pc, shared, n=10, seed=5)                  # seeds the cache
+        slot, first = pc.admit(shared, seed=5, max_new_tokens=10)
+        assert pc.last_admit_info["aliased_blocks"] >= 1
+        # force eviction pressure while the reader is mid-decode
+        big = _enc(tok, "E" * 40)
+        slot2, _ = pc.admit(big, max_new_tokens=8)
+        out = [first]
+        for _ in range(9):
+            out.append(pc.step()[slot])
+        assert out == ref
+        pc.release(slot)
+        pc.release(slot2)
+
+
+# ------------------------------------------- wave prefill + compile ----
+
+class TestPiggybackedPrefill:
+    def test_wave_matches_serial_bit_for_bit(self, setup):
+        _, bundle, params, tok = setup
+        serial = _sched(bundle)
+        wave = _sched(bundle, prefix_cache=True, prefill_batch=4)
+        prompts = [_enc(tok, p) for p in
+                   ("alpha question", "a much longer beta question "
+                    "spanning several chunks of prefill", "g",
+                    "delta prompt")]
+        refs = [_run(serial, p, n=6, seed=i)
+                for i, p in enumerate(prompts)]
+        pends = [wave.begin_admit(p, seed=i, max_new_tokens=6)
+                 for i, p in enumerate(prompts)]
+        firsts = wave.finish_admits(pends)
+        outs = [[f] for f in firsts]
+        for _ in range(5):
+            toks = wave.step()
+            for i, p in enumerate(pends):
+                outs[i].append(toks[p.slot])
+        assert outs == refs
+
+    def test_compile_once_across_waves_and_cow(self, setup,
+                                               xla_compile_counter):
+        """Wave membership, prefix hits, COW copies, eviction churn:
+        all DATA — zero recompiles after the three programs warm."""
+        _, bundle, params, tok = setup
+        sched = _sched(bundle, prefix_cache=True, prefill_batch=4)
+        sys_p = "warm system prompt for compile pinning. "
+        warm = [_enc(tok, sys_p + s) for s in ("a", "bb long suffix here",
+                                               "c", "dd")]
+        # warm: serial admit, a full wave, and a COW-triggering repeat
+        _run(sched, warm[0], n=3)
+        pends = [sched.begin_admit(p, seed=i, max_new_tokens=3)
+                 for i, p in enumerate(warm)]
+        sched.finish_admits(pends)
+        sched.step()
+        for p in pends:
+            sched.release(p.slot)
+        xla_compile_counter.reset()
+        for round_i in range(2):
+            batch = [_enc(tok, sys_p + f"round {round_i} q {i}")
+                     for i in range(3)]
+            pends = [sched.begin_admit(p, seed=i, max_new_tokens=3)
+                     for i, p in enumerate(batch)]
+            assert any(p.info["cached_tokens"] > 0 for p in pends)
+            sched.finish_admits(pends)
+            for _ in range(2):
+                sched.step()
+            for p in pends:
+                sched.release(p.slot)
+        assert xla_compile_counter.delta() == 0
+
+
+class TestAdmitFailureCleanup:
+    def test_failed_prefill_releases_reservation(self, setup,
+                                                 monkeypatch):
+        """A prefill that raises mid-admit must return the reserved slot
+        AND its worst-case block reservation — each transient failure
+        must not permanently shrink serving capacity."""
+        _, bundle, params, tok = setup
+        sched = _sched(bundle, slots=2, prefix_cache=True)
+        ids = _enc(tok, "leak probe")
+        orig = sched._prefill_serial
+        calls = {"n": 0}
+
+        def flaky(p):
+            if calls["n"] == 0:
+                calls["n"] += 1
+                raise RuntimeError("transient device error")
+            return orig(p)
+
+        monkeypatch.setattr(sched, "_prefill_serial", flaky)
+        with pytest.raises(RuntimeError, match="transient"):
+            sched.admit(ids, max_new_tokens=4)
+        assert len(sched.free_slots()) == 2          # slot returned
+        assert sched.alloc.free_blocks == sched.cache_cfg.num_blocks
+        slot, _ = sched.admit(ids, max_new_tokens=4)  # heals
+        sched.release(slot)
+
+
+# -------------------------------------------------- debug + flight ----
+
+class TestDiagnosisSurface:
+    def test_debug_state_exposes_index_and_refcounts(self, setup):
+        _, bundle, params, tok = setup
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 8, "prefill_chunk": 8,
+                        "prefix_cache": True})
+        try:
+            pred.generate("debug prefix shared", max_new_tokens=4)
+            pred.generate("debug prefix shared too", max_new_tokens=4)
+            st = pred.debug_state()["scheduler"]
+            pc = st["prefix_cache"]
+            assert pc["hits"] >= 1
+            assert pc["cached_blocks"] >= 1
+            assert pc["block_refcounts"]          # per-block counts live
+            assert st["geometry"]["prefix_cache"] is True
+            assert st["kv_pool"]["cached_blocks"] >= 1
+            # flight records carry the aliased-block count
+            admits = [r for r in pred.engine.flight.snapshot()
+                      if r["event"] == "admit"]
+            assert any(r.get("data", {}).get("aliased_blocks", 0) >= 1
+                       for r in admits)
+            assert any(r.get("data", {}).get("cached_tokens", 0) > 0
+                       for r in admits)
+        finally:
+            pred.close()
+
+    def test_prefix_metrics_flow_to_registry(self, setup):
+        from fedml_tpu.core.obs import metrics as obs_metrics
+        _, bundle, params, tok = setup
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 8, "prefill_chunk": 8,
+                        "prefix_cache": True})
+        try:
+            pred.generate("metric prefix probe", max_new_tokens=3)
+            pred.generate("metric prefix probe two", max_new_tokens=3)
+            snap = obs_metrics.REGISTRY.snapshot()
+            assert "llm_prefix_lookups_total" in snap
+            assert "llm_prefix_cached_tokens_total" in snap
+            cached = obs_metrics.REGISTRY.counter(
+                "llm_prefix_cached_tokens_total").value()
+            assert cached > 0
+            assert "llm_kv_aliased_blocks" in snap
+        finally:
+            pred.close()
+
+
+class TestEngineWaveE2E:
+    def test_concurrent_requests_through_wave_engine_match_serial(
+            self, setup):
+        _, bundle, params, tok = setup
+        plain = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 4, "block_size": 8, "prefill_chunk": 8})
+        fast = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 4, "block_size": 8, "prefill_chunk": 8,
+                        "prefix_cache": True, "prefill_batch": 4})
+        try:
+            prompts = [f"shared system header. question {i} with tail"
+                       for i in range(6)]
+            ref = [plain.generate(p, max_new_tokens=6)["text"]
+                   for p in prompts]
+            with cf.ThreadPoolExecutor(6) as ex:
+                got = list(ex.map(
+                    lambda p: fast.generate(p, max_new_tokens=6)["text"],
+                    prompts))
+            assert got == ref
+        finally:
+            plain.close()
+            fast.close()

@@ -292,9 +292,15 @@ class TestBackpressure:
         sched.step_barrier = threading.Event()   # wedge decode politely
         eng = BatchingEngine(sched, watchdog_s=0.0, shed_queue_depth=2)
         try:
-            futs = [eng.submit([5, 6], max_new_tokens=4)
-                    for _ in range(3)]   # 1 in flight + 2 queued
+            futs = [eng.submit([5, 6], max_new_tokens=4)]
             deadline = time.time() + 5
+            # wait for admission so the first request is IN FLIGHT (not
+            # queued) before loading the queue — submitting all three
+            # back-to-back races the worker's admit and flakes
+            while time.time() < deadline and sched.active_count() < 1:
+                time.sleep(0.01)
+            futs += [eng.submit([5, 6], max_new_tokens=4)
+                     for _ in range(2)]   # 2 queued behind the wedge
             while time.time() < deadline and eng.queue_depth() < 2:
                 time.sleep(0.01)
             with pytest.raises(Overloaded) as ei:

@@ -1,0 +1,388 @@
+"""SSE token streaming + adapter hot-swap (ISSUE 13): stream/non-stream
+bit-parity, the llm_stream knob's off-path, transparent recovery replay
+mid-stream (PR 11 composition), hot-swap row semantics (in-flight
+requests keep their version), the watched-adapter-dir loop, and the
+slow-marked federated adapter flywheel scenario
+(train → export → hot-swap → streamed serve → observe).
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.chaos import FaultLedger, FaultPlan, \
+    ServingChaosInjector
+from fedml_tpu.llm.federated import build_llm, save_adapter_artifacts
+from fedml_tpu.serving import SSEStream
+from fedml_tpu.serving.batch import AdapterBank
+from fedml_tpu.serving.llm_template import (CausalLMPredictor,
+                                            ChatCompletionRunner)
+
+pytestmark = pytest.mark.serving
+
+
+def _args(**kw):
+    base = dict(dataset="llm_synthetic", model="causal_lm",
+                client_num_in_total=2, client_num_per_round=2,
+                comm_round=1, epochs=1, batch_size=4, learning_rate=1e-3,
+                random_seed=3, llm_hidden_size=32, llm_num_layers=2,
+                llm_num_heads=2, llm_intermediate_size=64,
+                llm_max_seq_len=128, lora_rank=4)
+    base.update(kw)
+    return Arguments(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    args = _args()
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return args, bundle, params, tok
+
+
+def _rand_adapter(template, seed):
+    import jax
+    import jax.numpy as jnp
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    key = jax.random.PRNGKey(seed)
+    return jax.tree_util.tree_unflatten(
+        treedef, [0.3 * jax.random.normal(jax.random.fold_in(key, i),
+                                          l.shape, jnp.float32)
+                  for i, l in enumerate(leaves)])
+
+
+def _drain_stream(stream: SSEStream):
+    """Consume an SSEStream → (joined_text, finish_choice, n_chunks)."""
+    text, finish, n = "", None, 0
+    for ev in stream.events:
+        n += 1
+        choice = ev["choices"][0]
+        text += choice["delta"].get("content", "")
+        if choice["finish_reason"] is not None:
+            finish = choice
+    return text, finish, n
+
+
+# ------------------------------------------------------- streaming ----
+
+class TestStreaming:
+    @pytest.fixture(scope="class")
+    def preds(self, setup):
+        _, bundle, params, tok = setup
+        opts = {"slots": 2, "block_size": 8, "prefill_chunk": 8}
+        plain = CausalLMPredictor(bundle, params, tokenizer=tok,
+                                  mode="batch", batch_opts=dict(opts))
+        streaming = CausalLMPredictor(bundle, params, tokenizer=tok,
+                                      mode="batch",
+                                      batch_opts=dict(opts), stream=True)
+        yield plain, streaming
+        plain.close()
+        streaming.close()
+
+    def test_stream_text_bit_identical_to_nonstream(self, preds):
+        plain, streaming = preds
+        req = {"messages": [{"role": "user", "content": "stream me a"}],
+               "max_tokens": 10, "seed": 4}
+        ref = plain.chat(dict(req))
+        out = streaming.chat(dict(req, stream=True))
+        assert isinstance(out, SSEStream)
+        text, finish, _ = _drain_stream(out)
+        assert text == ref["choices"][0]["message"]["content"]
+        assert finish["finish_reason"] == \
+            ref["choices"][0]["finish_reason"]
+        assert finish["finish_reason_detail"] == \
+            ref["choices"][0]["finish_reason_detail"]
+        assert finish["usage"] == ref["usage"]
+
+    def test_knob_off_ignores_stream_flag(self, preds):
+        """llm_stream off ⇒ a request carrying "stream": true gets the
+        ordinary JSON completion — byte-identical today-path."""
+        plain, _ = preds
+        out = plain.chat({"messages": [{"role": "user",
+                                        "content": "no stream"}],
+                          "max_tokens": 6, "stream": True})
+        assert isinstance(out, dict)
+        assert out["object"] == "chat.completion"
+
+    def test_sampled_stream_reproducible(self, preds):
+        _, streaming = preds
+        req = {"messages": [{"role": "user", "content": "sample"}],
+               "max_tokens": 8, "temperature": 1.4, "seed": 21,
+               "stream": True}
+        a = _drain_stream(streaming.chat(dict(req)))[0]
+        b = _drain_stream(streaming.chat(dict(req)))[0]
+        assert a == b
+
+    def test_stream_metric_counted(self, preds):
+        from fedml_tpu.core.obs import metrics as obs_metrics
+        _, streaming = preds
+        before = obs_metrics.REGISTRY.counter(
+            "llm_stream_requests_total").value()
+        _drain_stream(streaming.chat(
+            {"messages": [{"role": "user", "content": "count me"}],
+             "max_tokens": 4, "stream": True}))
+        after = obs_metrics.REGISTRY.counter(
+            "llm_stream_requests_total").value()
+        assert after == before + 1
+
+
+@pytest.mark.chaos
+class TestStreamRecoveryReplay:
+    def test_recovery_replays_transparently_mid_stream(self, setup):
+        """PR 11 composition: an injected NaN mid-decode triggers the
+        controlled reset + recompute-from-prompt; the stream pauses over
+        the gap and resumes with ONLY new tokens — the delivered text is
+        bit-identical to a fault-free run, no duplicates, no holes."""
+        _, bundle, params, tok = setup
+        ref_pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 8, "prefill_chunk": 8})
+        req = {"messages": [{"role": "user",
+                             "content": "replay this stream"}],
+               "max_tokens": 12, "temperature": 1.1, "seed": 9}
+        ref = ref_pred.chat(dict(req))
+        ref_pred.close()
+
+        inj = ServingChaosInjector(
+            FaultPlan(seed=7, serving_nan_at_step=4),
+            ledger=FaultLedger())
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 8, "prefill_chunk": 8,
+                        "watchdog_s": 0.3, "max_resets": 4,
+                        "max_requeues": 8, "chaos": inj},
+            stream=True)
+        try:
+            out = pred.chat(dict(req, stream=True))
+            text, finish, _ = _drain_stream(out)
+            assert pred.engine.resets_total >= 1, \
+                "the injected NaN never tripped a reset"
+            assert text == ref["choices"][0]["message"]["content"]
+            assert finish["usage"]["completion_tokens"] == \
+                ref["usage"]["completion_tokens"]
+        finally:
+            pred.close()
+
+
+class TestGatewayStreamPassthrough:
+    def test_gateway_streams_frames_and_degrades_to_json(self, setup):
+        """Gateway.stream yields the replica's SSE payloads (no [DONE])
+        through the shared failover loop; a stream-knob-off replica's
+        JSON body comes back as the single event."""
+        from fedml_tpu.serving.autoscale import Gateway, ReplicaSet
+        _, bundle, params, tok = setup
+        opts = {"slots": 2, "block_size": 8, "prefill_chunk": 8}
+        rs = ReplicaSet(
+            predictor_factory=lambda: CausalLMPredictor(
+                bundle, params, tokenizer=tok, mode="batch",
+                batch_opts=dict(opts), stream=True),
+            min_replicas=1, max_replicas=1,
+            runner_cls=ChatCompletionRunner)
+        gw = Gateway(rs, window_s=5.0)
+        req = {"messages": [{"role": "user", "content": "gw stream"}],
+               "max_tokens": 6, "seed": 2}
+        try:
+            ref = gw.predict(dict(req), path="/v1/chat/completions",
+                             timeout=60)
+            frames = [json.loads(d) for d in
+                      gw.stream(dict(req, stream=True), timeout=60)]
+            text = "".join(c["choices"][0]["delta"].get("content", "")
+                           for c in frames)
+            assert text == ref["choices"][0]["message"]["content"]
+            assert frames[-1]["choices"][0]["finish_reason"] is not None
+            # knob respected end-to-end: no "stream" flag -> one JSON
+            # event through the same generator surface
+            whole = list(gw.stream(dict(req), timeout=60))
+            assert len(whole) == 1
+            assert json.loads(whole[0])["object"] == "chat.completion"
+        finally:
+            rs.stop()
+
+
+# --------------------------------------------------- adapter hot-swap ----
+
+class TestAdapterHotSwap:
+    def test_swap_writes_fresh_row_and_pins_protect_old(self, setup):
+        _, bundle, params, tok = setup
+        bank = AdapterBank(params, capacity=8)
+        old_idx = bank.add("silo", _rand_adapter(params, 1))
+        old_row = [h[old_idx].copy() for h in bank._host]
+        bank.retain_row(old_idx)                 # an in-flight request
+        new_idx = bank.swap("silo", _rand_adapter(params, 2))
+        assert new_idx != old_idx
+        assert bank.index("silo") == new_idx
+        # the pinned old row's weights are untouched (the in-flight
+        # request keeps the version it started with)
+        assert all(np.array_equal(h[old_idx], r)
+                   for h, r in zip(bank._host, old_row))
+        assert old_idx in bank._retired
+        # another swap must NOT reuse the pinned row
+        third = bank.swap("other", _rand_adapter(params, 3))
+        assert third not in (old_idx, new_idx)
+        bank.release_row(old_idx)                # request finished
+        assert old_idx not in bank._retired
+        # now the row is reusable
+        fourth = bank.swap("silo", _rand_adapter(params, 4))
+        assert fourth == old_idx
+
+    def test_unpinned_swap_frees_row_immediately(self, setup):
+        _, bundle, params, tok = setup
+        bank = AdapterBank(params, capacity=4)
+        a = bank.add("s", _rand_adapter(params, 1))
+        b = bank.swap("s", _rand_adapter(params, 2))
+        assert b != a
+        c = bank.swap("s", _rand_adapter(params, 3))
+        assert c == a                            # the freed row cycles
+        assert bank.swaps == 2
+
+    def test_engine_pins_adapter_for_request_lifetime(self, setup):
+        """A hot-swap mid-request must not change the weights a running
+        request decodes with: its output equals the pre-swap solo run."""
+        _, bundle, params, tok = setup
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 8, "prefill_chunk": 8,
+                        "max_adapters": 8})
+        bank = pred.adapter_bank
+        try:
+            v1 = _rand_adapter(params, 31)
+            bank.add("siloX", v1)
+            before = pred.generate("pin probe", max_new_tokens=8,
+                                   adapter="siloX")["text"]
+            idx_v1 = bank.index("siloX")
+            bank.retain_row(idx_v1)              # simulate in-flight pin
+            bank.swap("siloX", _rand_adapter(params, 32))
+            # the retired, pinned row still serves v1 weights: a request
+            # that resolved before the swap decodes unchanged
+            fut = pred.engine.submit(
+                pred._encode_prompt("pin probe", 8), max_new_tokens=8,
+                adapter_idx=idx_v1)
+            out = fut.result(timeout=60)
+            assert tok.decode(out["ids"]) == before
+            # new requests by NAME get the new version
+            after = pred.generate("pin probe", max_new_tokens=8,
+                                  adapter="siloX")["text"]
+            assert after != before
+            bank.release_row(idx_v1)
+        finally:
+            pred.close()
+
+    def test_watched_dir_swaps_live(self, setup, tmp_path):
+        """The zero-restart loop: re-exporting into the watched dir goes
+        live within a poll without touching the engine (zero recompiles
+        — the stack refresh is a host→device transfer)."""
+        _, bundle, params, tok = setup
+        v1, v2 = _rand_adapter(params, 41), _rand_adapter(params, 42)
+        save_adapter_artifacts({"siloW": v1}, str(tmp_path))
+        bank = AdapterBank.from_artifacts(str(tmp_path), capacity=8)
+        pred = CausalLMPredictor(
+            bundle, params, tokenizer=tok, mode="batch",
+            batch_opts={"slots": 2, "block_size": 8, "prefill_chunk": 8},
+            adapter_bank=bank)
+        try:
+            out_v1 = pred.generate("watch probe", max_new_tokens=8,
+                                   adapter="siloW")["text"]
+            bank.watch_dir(str(tmp_path), poll_s=0.1)
+            time.sleep(0.15)                     # initial scan settles
+            assert bank.swaps == 0               # no spurious swap
+            # a fresh federated export lands (atomic os.replace inside)
+            os.utime(str(tmp_path))              # ensure mtime moves
+            save_adapter_artifacts({"siloW": v2, "siloNew": v1},
+                                   str(tmp_path))
+            deadline = time.time() + 10
+            while time.time() < deadline and bank.swaps < 2:
+                time.sleep(0.05)
+            assert bank.swaps >= 2               # siloW update + siloNew
+            assert bank.has("siloNew")
+            out_v2 = pred.generate("watch probe", max_new_tokens=8,
+                                   adapter="siloW")["text"]
+            assert out_v2 != out_v1              # the new version serves
+        finally:
+            pred.close()
+        assert bank._watch_thread is None        # close() stopped it
+
+
+# --------------------------------- the federated adapter flywheel ----
+
+@pytest.mark.slow
+class TestAdapterFlywheelE2E:
+    def test_train_export_hotswap_stream_observe(self, tmp_path):
+        """ROADMAP item 1's loop, end to end: federated LoRA fine-tune →
+        adapter export → served bank with a watcher → a NEW round's
+        re-export hot-swaps live → streamed chat over HTTP uses the bank
+        → /debug/state and /metrics observe the whole thing."""
+        from fedml_tpu.llm.federated import run_federated_llm
+        from fedml_tpu.serving import save_model
+
+        export_dir = str(tmp_path / "adapters")
+        args = _args(comm_round=1,
+                     llm_adapter_export_dir=export_dir,
+                     llm_adapter_personalize_steps=1)
+        result = run_federated_llm(args)
+        assert os.path.exists(os.path.join(export_dir, "manifest.json"))
+        params_path = str(tmp_path / "model.fmtpu")
+        save_model(result["params"], params_path)
+
+        serve_args = _args(
+            llm_serving_mode="batch", llm_adapter_dir=export_dir,
+            llm_adapter_watch_s=0.1, llm_stream=True,
+            llm_prefix_cache=True, llm_prefill_batch=4,
+            serving_slots=4, serving_kv_block_size=8,
+            serving_prefill_chunk=8)
+        pred = CausalLMPredictor.from_artifact(serve_args, params_path)
+        runner = ChatCompletionRunner(pred)
+        port = runner.start()
+        try:
+            bank = pred.adapter_bank
+            assert bank.has("global") and bank.has("silo_0")
+            # a "new federated round" re-exports: hot-swap goes live
+            import jax
+            leaves, treedef = jax.tree_util.tree_flatten(
+                result["params"])
+            bumped = jax.tree_util.tree_unflatten(
+                treedef, [l + 0.05 for l in leaves])
+            save_adapter_artifacts({"global": result["params"],
+                                    "silo_0": bumped}, export_dir)
+            deadline = time.time() + 10
+            while time.time() < deadline and bank.swaps < 1:
+                time.sleep(0.05)
+            assert bank.swaps >= 1
+
+            # streamed chat over HTTP against the swapped bank
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                data=json.dumps({
+                    "model": "silo_0",
+                    "messages": [{"role": "user",
+                                  "content": "flywheel check"}],
+                    "max_tokens": 6, "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert "text/event-stream" in r.headers["Content-Type"]
+                frames = [ln.decode().strip() for ln in r if ln.strip()]
+            datas = [f[6:] for f in frames if f.startswith("data: ")]
+            assert datas[-1] == "[DONE]"
+            chunks = [json.loads(d) for d in datas[:-1]]
+            assert chunks[-1]["choices"][0]["finish_reason"] is not None
+
+            # observe: /debug/state exposes the prefix index; /metrics
+            # exposes swaps and stream counters
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/state",
+                    timeout=10) as r:
+                dbg = json.load(r)
+            assert "prefix_cache" in dbg["scheduler"]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                metrics_text = r.read().decode()
+            assert "llm_adapter_swaps_total" in metrics_text
+            assert "llm_stream_requests_total" in metrics_text
+        finally:
+            runner.stop()
+            pred.close()
