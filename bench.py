@@ -1635,12 +1635,244 @@ def bench_llm_serving_chaos(concurrency=8, requests=24, max_new=12):
     }), flush=True)
 
 
+def bench_llm_serving_adapter_churn(concurrency=64, rounds=4, max_new=12,
+                                    bank_size=8):
+    """Sustained adapter churn (ISSUE 14 satellite, the ROADMAP's
+    in-but-unmeasured leg): c64 traffic flows through the batched engine
+    while ONE adapter per round is re-exported into the watched dir and
+    hot-swapped live through the PR 12 watcher/pin machinery. The
+    numbers that matter: tokens/s under churn vs a churn-free round on
+    the same engine (the swap is a host→device stack refresh, so the
+    gap should be noise) and ZERO recompiles across the whole run."""
+    import concurrent.futures as cf
+    import os
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core import mlops
+    from fedml_tpu.llm.federated import build_llm, save_adapter_artifacts
+    from fedml_tpu.serving.batch import AdapterBank
+    from fedml_tpu.serving.llm_template import CausalLMPredictor
+
+    args = Arguments(
+        dataset="llm_synthetic", model="causal_lm",
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        epochs=1, batch_size=4, learning_rate=1e-3, random_seed=0,
+        llm_hidden_size=128, llm_num_layers=2, llm_num_heads=4,
+        llm_intermediate_size=352, llm_max_seq_len=128, lora_rank=8)
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+
+    def rand_adapter(seed):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = jax.random.PRNGKey(seed)
+        return jax.tree_util.tree_unflatten(
+            treedef, [0.1 * jax.random.normal(jax.random.fold_in(key, j),
+                                              l.shape)
+                      for j, l in enumerate(leaves)])
+
+    export_dir = tempfile.mkdtemp(prefix="churn_adapters_")
+    names = [f"silo_{a}" for a in range(bank_size)]
+    save_adapter_artifacts({n: rand_adapter(a)
+                            for a, n in enumerate(names)}, export_dir)
+    # capacity: bank rows + a fresh row per swap (retired rows rejoin
+    # the pool once their last in-flight pin drops)
+    bank = AdapterBank.from_artifacts(export_dir,
+                                      capacity=bank_size + rounds + 4)
+    pred = CausalLMPredictor(
+        bundle, params, tokenizer=tok, mode="batch",
+        batch_opts={"slots": concurrency, "block_size": 16,
+                    "prefill_chunk": 32},
+        adapter_bank=bank)
+    prompts = [f"request {i}: summarize federated round {i * 7}"
+               for i in range(concurrency)]
+
+    def sweep():
+        t0 = time.perf_counter()
+        lats = [0.0] * concurrency
+        toks = [0] * concurrency
+
+        def one(i):
+            out = pred.generate(prompts[i], max_new_tokens=max_new,
+                                adapter=names[i % len(names)])
+            lats[i] = time.perf_counter() - t0
+            toks[i] = out["completion_tokens"]
+
+        with cf.ThreadPoolExecutor(concurrency) as ex:
+            list(ex.map(one, range(concurrency)))
+        wall = time.perf_counter() - t0
+        p99 = sorted(lats)[min(concurrency - 1,
+                               int(0.99 * (concurrency - 1) + 0.5))]
+        return sum(toks) / wall, p99
+
+    legs = {}
+    try:
+        mlops.install_compile_counter()
+        pred.generate("warm", max_new_tokens=2, adapter=names[0])
+        sweep()                                    # warm the sweep path
+        tps0, p99_0 = sweep()                      # churn-free reference
+        legs["no_churn"] = {"tokens_per_s": round(tps0, 1),
+                            "p99_latency_s": round(p99_0, 3)}
+        bank.watch_dir(export_dir, poll_s=0.1)
+        time.sleep(0.15)                           # initial scan settles
+        compiles0 = mlops.compile_count()
+        churn_tps, churn_p99 = [], []
+        for r in range(rounds):
+            victim = names[r % len(names)]
+            with cf.ThreadPoolExecutor(1) as swapper:
+                # one hot-swap per round, landing MID-TRAFFIC: the
+                # exporter thread re-writes the artifact while the c64
+                # sweep decodes against the bank
+                fut = swapper.submit(
+                    save_adapter_artifacts,
+                    {victim: rand_adapter(1000 + r)}, export_dir)
+                tps, p99 = sweep()
+                fut.result()
+            churn_tps.append(tps)
+            churn_p99.append(p99)
+        deadline = time.time() + 10                # let the last swap land
+        while time.time() < deadline and bank.swaps < rounds:
+            time.sleep(0.05)
+        recompiles = mlops.compile_count() - compiles0
+        legs["churn"] = {
+            "tokens_per_s": round(sum(churn_tps) / len(churn_tps), 1),
+            "tokens_per_s_best": round(max(churn_tps), 1),
+            "p99_latency_s": round(max(churn_p99), 3),
+            "swaps": int(bank.swaps),
+            "recompiles": int(recompiles)}
+    finally:
+        pred.close()
+    ratio = legs["churn"]["tokens_per_s"] / max(
+        legs["no_churn"]["tokens_per_s"], 1e-9)
+    print(json.dumps({
+        "metric": "llm_serving_adapter_churn_tokens_per_s",
+        "value": legs["churn"]["tokens_per_s"],
+        "unit": f"generated tokens/s (c{concurrency}, {bank_size}-adapter "
+                f"bank, one watched hot-swap per round x{rounds}, "
+                f"{max_new} new tokens/request, "
+                f"{jax.default_backend()})",
+        "vs_baseline": round(ratio, 3),
+        "legs": legs,
+    }), flush=True)
+
+
+def _sum_collective_kinds(colls, block):
+    """Per-(op, group) wire bytes per round — SUMMED across distinct
+    operand shapes (the roofline rows key on shape too; collapsing by
+    overwrite would understate any kind with >1 payload shape)."""
+    out = {}
+    for c in colls:
+        key = f"{c['op']}_g{c['group']}"
+        out[key] = round(out.get(key, 0.0) + c["wire_bytes"] / block, 1)
+    return out
+
+
+def bench_robust_rfa_weak_scaling(device_counts=(1, 4, 8),
+                                  rounds_per_leg=16, block=8,
+                                  clients_per_device=2):
+    """Weak scaling of the fused defended round (ISSUE 14 satellite —
+    the missing BASELINE leg): `fedavg_robust_rfa_rounds_per_hour` at
+    1/4/8 devices with CONSTANT per-device work (2 clients/device), so
+    ideal scaling is a flat rounds/hour line. Each leg also captures the
+    program's roofline (obs_roofline) and reports the predicted
+    per-device collective wire bytes per round — the column that tells
+    the multi-chip item whether a scaling cliff is the defense's
+    psum/all_to_all traffic or something else. On the CPU host mesh the
+    times are shape-comparable, the collective bytes exact, and a TPU
+    re-run is the real verdict (BASELINE.md measurement-honesty note)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.constants import AXIS_CLIENT
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.core.obs import roofline as obs_roofline
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    devs = jax.devices()
+    counts = [k for k in device_counts if k <= len(devs)]
+    legs = {}
+    base_rph = None
+    for k in counts:
+        n_clients = clients_per_device * k
+        n_byz = max(1, n_clients // 8)
+        args = Arguments(
+            dataset="synthetic_mnist", model="lr",
+            client_num_in_total=n_clients, client_num_per_round=n_clients,
+            comm_round=rounds_per_leg, epochs=1, batch_size=32,
+            learning_rate=0.1, frequency_of_the_test=10_000,
+            random_seed=0, enable_attack=True,
+            attack_type="byzantine_flip", byzantine_client_num=n_byz,
+            attack_scale=5.0, enable_defense=True, defense_type="rfa",
+            obs_roofline=True)
+        fed, output_dim = load(args)
+        bundle = create(args, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        mesh = Mesh(np.asarray(devs[:k]), (AXIS_CLIENT,))
+        sim = TPUSimulator(args, fed, bundle,
+                           create_optimizer(args, spec), spec, mesh=mesh)
+        hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                           epochs=1)
+        r = [0]
+
+        def leg_block():
+            sim.run_rounds_fused(r[0], block, hyper)
+            r[0] += block
+
+        leg_block()                       # compile warmup + capture
+        _force(sim.params)
+        trials = []
+        for _ in range(max(rounds_per_leg // block, 2)):
+            t0 = time.perf_counter()
+            leg_block()
+            _force(sim.params)
+            trials.append((time.perf_counter() - t0) / block)
+        step_s = min(trials)
+        rph = 3600.0 / step_s
+        if base_rph is None:
+            base_rph = rph
+        rep = obs_roofline.report("robust_rounds_fused") or {}
+        coll = rep.get("collective_wire_bytes")
+        legs[f"d{k}"] = {
+            "rounds_per_hour": round(rph, 1),
+            "step_time_s": round(step_s, 4),
+            "clients": n_clients,
+            "weak_scaling_efficiency": round(rph / base_rph, 3),
+            "collective_wire_bytes_per_round": (
+                round(coll / block, 1) if coll is not None else None),
+            "collective_kinds": _sum_collective_kinds(
+                rep.get("collectives", []), block),
+        }
+    top = f"d{counts[-1]}"
+    print(json.dumps({
+        "metric": "fedavg_robust_rfa_weak_scaling_efficiency",
+        "value": legs[top]["weak_scaling_efficiency"],
+        "unit": f"rounds/hour at {counts[-1]} devices ÷ at 1 device, "
+                f"{clients_per_device} clients/device, byzantine-flip + "
+                f"RFA fused {block}-round dispatch "
+                f"({jax.default_backend()})",
+        "vs_baseline": None,
+        "legs": legs,
+    }), flush=True)
+
+
 def run():
     bench_flagship()
     for name, fn in (
             ("fedavg_resnet18_engine_mfu", bench_engine_mfu_resnet18),
             ("fedavg_robust_krum_rounds_per_hour", bench_robust_krum),
             ("fedavg_robust_rfa_rounds_per_hour", bench_robust_rfa),
+            ("fedavg_robust_rfa_weak_scaling_efficiency",
+             bench_robust_rfa_weak_scaling),
             ("fedavg_contribution_loo_rounds_per_hour",
              bench_contribution_fused),
             ("hierarchical_femnist_mobilenet_rounds_per_hour",
@@ -1657,6 +1889,8 @@ def run():
              bench_shakespeare_fedopt),
             ("fedllm_lora_federated_round_s", bench_federated_lora),
             ("llm_serving_tokens_per_s", bench_llm_serving),
+            ("llm_serving_adapter_churn_tokens_per_s",
+             bench_llm_serving_adapter_churn),
             ("llm_serving_ttft", bench_llm_serving_ttft),
             ("llm_serving_chaos_goodput", bench_llm_serving_chaos),
             ("llm_train_step_mfu", bench_llm_mfu),

@@ -274,6 +274,12 @@ _SCHEMA: Dict[str, Any] = {
     # cross-device handshake, agents; skips when nothing changed
     "obs_metrics_flush_s": 60.0,
     "obs_profile_device": False,  # host/device split + per-round MFU
+    # compute-plane roofline capture (core/obs/roofline): AOT-compiles
+    # each dispatched program once per abstract-shape signature and
+    # emits the per-op roofline + collective-traffic record — OPT-IN
+    # because the extra backend compile would trip the compile-once
+    # counters (recompile FORENSICS is always on and compile-free)
+    "obs_roofline": False,
     "log_file_dir": "~/.cache/fedml_tpu/logs",
     "save_model_path": None,     # persist final params (serving artifact)
     "checkpoint_dir": None,

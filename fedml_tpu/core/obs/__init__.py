@@ -14,8 +14,15 @@
    attribution at the engine seam + the FLOPs model as a first-class
    per-round MFU gauge (opt-in: blocking defeats dispatch overlap).
 
+4. **Compute plane** (:mod:`.roofline`): per-op roofline attribution of
+   compiled programs (opt-in ``obs_roofline`` — one AOT compile per
+   program), collective-traffic accounting, and always-on recompile
+   forensics that name the changed abstract shapes when a dispatch
+   compiles past its pinned expectation.
+
 ``scripts/trace_report.py`` reads a run's JSONL and prints the per-round
-critical path. :mod:`.schema` is the one table every record kind
+critical path; ``scripts/roofline_report.py`` renders the compute
+plane's records. :mod:`.schema` is the one table every record kind
 validates against.
 
 Knobs (``arguments.py``): tracing + metrics default ON (cheap — spans
@@ -26,7 +33,7 @@ it the defaults apply, so library use without init still traces.
 
 from __future__ import annotations
 
-from . import flight, metrics, profiler, schema, trace          # noqa: F401
+from . import flight, metrics, profiler, roofline, schema, trace  # noqa: F401
 from .flight import FlightRecorder, Watchdog                    # noqa: F401
 from .metrics import REGISTRY                                   # noqa: F401
 from .trace import (NOOP_SPAN, SpanContext, add_event, current_span,  # noqa: F401
@@ -47,3 +54,8 @@ def configure(args=None) -> None:
         float(getattr(args, "obs_metrics_flush_s", 60.0) or 0.0))
     profiler.set_device_profiling(
         bool(getattr(args, "obs_profile_device", False)))
+    # compute-plane roofline capture (opt-in: costs one AOT backend
+    # compile per program); engines read their own args knob first —
+    # this default covers seams without an args object (serving)
+    roofline.set_default_enabled(
+        bool(getattr(args, "obs_roofline", False)))

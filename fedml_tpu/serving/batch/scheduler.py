@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...core.obs import metrics as obs_metrics
+from ...core.obs import roofline as obs_roofline
 from ...llm import kv_cache as kvc
 
 PyTree = Any
@@ -120,7 +121,27 @@ class DecodeScheduler:
         # True until a decode step observes NaN/inf in an active slot's
         # logits — the watchdog's poison signal
         self.last_step_finite = True
+        # compute plane at the serving dispatch seam: always-on recompile
+        # forensics for the decode/prefill programs (steady-state zero
+        # recompiles is the engine's core invariant) + opt-in roofline
+        # capture (obs_roofline via core/obs configure — the scheduler
+        # has no args object, so the module default is the knob)
+        from ...core import mlops
+        mlops.install_compile_counter()
+        self._roofline = obs_roofline.DispatchTracker(
+            n_devices=max(len(self._jax.devices()), 1))
         self._build_programs()
+
+    def _dispatch(self, name: str, fn, *args):
+        """Run one jitted serving program through the compute-plane seam:
+        signature before the call (kp/vp are donated), forensics after."""
+        from ...core import mlops
+        sig = obs_roofline.dispatch_signature(args)
+        self._roofline.maybe_capture(name, fn, args, sig=sig)
+        c0 = mlops.compile_count()
+        out = fn(*args)
+        self._roofline.observe(name, sig, mlops.compile_count() - c0)
+        return out
 
     # ------------------------------------------------------------- reset --
     def reset(self) -> None:
@@ -415,7 +436,8 @@ class DecodeScheduler:
             chunk = p.ids[j:j + c]
             n_valid = len(chunk)
             chunk = chunk + [0] * (c - n_valid)
-            logits_last, self._kp, self._vp = self._prefill_fn(
+            logits_last, self._kp, self._vp = self._dispatch(
+                "llm_prefill_chunk", self._prefill_fn,
                 self.params, stack, self._kp, self._vp, row_dev,
                 jnp.asarray(chunk, jnp.int32), jnp.int32(j),
                 jnp.int32(n_valid), jnp.int32(p.aidx))
@@ -456,7 +478,8 @@ class DecodeScheduler:
                     toks[i, :len(chunk)] = chunk
                     p0[i] = start
                     n_valid[i] = len(chunk)
-                logits, self._kp, self._vp = self._prefill_wave_fn(
+                logits, self._kp, self._vp = self._dispatch(
+                    "llm_prefill_wave", self._prefill_wave_fn,
                     self.params, stack, self._kp, self._vp, rows_dev,
                     jnp.asarray(toks), jnp.asarray(p0),
                     jnp.asarray(n_valid), aidx_dev)
@@ -522,7 +545,8 @@ class DecodeScheduler:
         jnp = self._jnp
         if not self._active.any():
             return {}
-        nxt, finite, self._kp, self._vp = self._step_fn(
+        nxt, finite, self._kp, self._vp = self._dispatch(
+            "llm_decode_step", self._step_fn,
             self.params, self._stack(), self._kp, self._vp,
             jnp.asarray(self._tables), jnp.asarray(self._pos),
             jnp.asarray(self._active), jnp.asarray(self._aidx),

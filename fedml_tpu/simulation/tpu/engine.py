@@ -33,6 +33,7 @@ from ...core.collectives import (
 from ...core.dp import FedMLDifferentialPrivacy
 from ...core import mlops
 from ...core.obs import profiler as obs_profiler
+from ...core.obs import roofline as obs_roofline
 from ...core.obs import trace as obs_trace
 from ...core.chaos import ChaosCrash, FaultLedger, FaultPlan
 from ...core.checkpoint import RoundCheckpointer
@@ -184,6 +185,17 @@ class TPUSimulator:
         self._obs_profile = bool(getattr(args, "obs_profile_device",
                                          False))
         self._flops_per_round: Optional[float] = None
+        # compute plane (core/obs/roofline): per-dispatch abstract-shape
+        # signatures feed always-on recompile forensics; `obs_roofline`
+        # additionally AOT-captures each program's per-op roofline +
+        # collective-traffic record (one extra backend compile per
+        # program — opt-in, like obs_profile_device, so the compile-once
+        # invariants hold at default knobs)
+        self._roofline = obs_roofline.DispatchTracker(
+            enabled=bool(getattr(args, "obs_roofline",
+                                 obs_roofline.default_enabled())),
+            n_devices=self.n_devices,
+            device=self.mesh.devices.flat[0])
 
         # chaos: seeded fault injection (off by default). Availability
         # faults ride the round programs as DATA (per-slot work fractions
@@ -546,6 +558,11 @@ class TPUSimulator:
         its outputs to split wall time into host (enqueue) vs device-wait
         (compute tail), wraps the call in a ``jax.profiler`` annotation,
         and converts the FLOPs model into the per-round MFU gauge."""
+        # compute plane: signature BEFORE the dispatch (donated buffers
+        # die with it), capture BEFORE the counter snapshot (the opt-in
+        # AOT compile must not be charged to the dispatch record)
+        sig = obs_roofline.dispatch_signature(args)
+        self._roofline.maybe_capture(name, fn, args, sig=sig)
         c0 = mlops.compile_count()
         with obs_trace.span("dispatch",
                             attrs={"name": name,
@@ -564,6 +581,7 @@ class TPUSimulator:
                 wait = time.perf_counter() - t1
                 sp.set_attr("device_wait_s", round(wait, 6))
         compiles = mlops.compile_count() - c0
+        self._roofline.observe(name, sig, compiles)
         if self._obs_profile:
             # the FLOPs model describes a TRAINING round: dispatches that
             # carry no training (the host-robust path's server_update is

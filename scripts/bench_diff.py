@@ -42,11 +42,19 @@ from typing import Dict, List, Optional, Tuple
 # better); fragmentation/ttft are the gauges the cache must DRIVE DOWN
 # (llm_ttft_seconds, llm_kv_fragmentation — ttft_* fields also end in
 # `_s` and read lower-is-better via the suffix rule).
+# roofline/weak-scaling additions (ISSUE 14): predicted MFU rides the
+# existing `mfu` marker and collective wire bytes the `bytes` marker;
+# `bound_share` covers roofline_memory_bound_share (drive the
+# memory-bound time share DOWN), `efficiency` the weak-scaling column,
+# `swaps` the adapter-churn leg's sustained hot-swap count (more churn
+# absorbed at the same tokens/s is better).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
-                  "goodput", "success", "hit_rate", "reused")
+                  "goodput", "success", "hit_rate", "reused",
+                  "efficiency", "swaps", "attributed")
 LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles",
                  "time_to", "step_time", "wall", "round_s",
-                 "resets", "trips", "faults", "fragmentation", "ttft")
+                 "resets", "trips", "faults", "fragmentation", "ttft",
+                 "bound_share")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

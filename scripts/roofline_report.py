@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Compute-plane roofline report: per-op attribution from JSONL.
+
+Reads run logs (the mlops sink's ``run_<id>.jsonl``) and renders the
+``kind: roofline`` records the compute plane captured (one per compiled
+program under ``obs_roofline: true``) plus any ``kind: recompile``
+forensics records:
+
+    python scripts/roofline_report.py run_0.jsonl
+    python scripts/roofline_report.py run_0.jsonl --top 12 --program round
+    python scripts/roofline_report.py old.jsonl --compare new.jsonl
+    python scripts/roofline_report.py run_0.jsonl --min-attr 0.9
+
+Per program: machine balance header (STATIC-ONLY flagged loudly on a CPU
+mesh — shapes/FLOPs/bytes are exact there, the time/MFU columns are a
+model), top-N ops by predicted time, a per-operand-shape aggregation
+(the conv stream grouped by shape — the view the MFU-gap item needs),
+the compute- vs memory-bound time split, and the collective-traffic
+table (per-device wire bytes per execution, by collective kind and
+replica-group size — the weak-scaling accounting).
+
+``--compare`` matches programs across two runs (or two device counts)
+and diffs predicted MFU, memory-bound share, predicted time, and
+collective wire bytes. ``--min-attr`` exits 2 when any program
+attributes less than the given fraction of its predicted device time to
+named ops (the coverage gate, analogous to ``trace_report --min-attr``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def load_records(paths: List[str]) -> Tuple[Dict[str, dict], List[dict]]:
+    """(latest roofline record per program, recompile records in order)."""
+    rooflines: "OrderedDict[str, dict]" = OrderedDict()
+    recompiles: List[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                kind = rec.get("kind")
+                if kind == "roofline" and rec.get("program"):
+                    rooflines[str(rec["program"])] = rec
+                elif kind == "recompile":
+                    recompiles.append(rec)
+    return rooflines, recompiles
+
+
+def _eng(v: Optional[float], unit: str = "") -> str:
+    if v is None:
+        return "-"
+    for scale, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suf}{unit}"
+    return f"{v:.1f}{unit}"
+
+
+def _ms(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.3f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def _pct(v: Optional[float]) -> str:
+    return "-" if v is None else f"{100.0 * v:.1f}%"
+
+
+def _op_label(op: Dict[str, Any]) -> str:
+    ins = ",".join(op.get("operands") or [])
+    out = op.get("out") or ""
+    return f"{op.get('op')}({ins})->{out}"
+
+
+def print_program(rec: Dict[str, Any], top: int,
+                  out=None) -> None:
+    out = out if out is not None else sys.stdout
+    name = rec["program"]
+    static = rec.get("static_only")
+    hdr = (f"== {name} — {rec.get('device_kind')} x"
+           f"{rec.get('n_devices')}"
+           + (" [STATIC-ONLY: no measured machine balance — time/MFU "
+              "columns are a model]" if static else "") + " ==")
+    print(hdr, file=out)
+    print(f"  peak {rec.get('peak_tflops')} TF/s | hbm "
+          f"{rec.get('hbm_gbps')} GB/s | balance "
+          f"{rec.get('balance_flops_per_byte')} flops/byte", file=out)
+    print(f"  predicted {_ms(rec.get('predicted_s'))}/execution | "
+          f"predicted MFU {_pct(rec.get('predicted_mfu'))} | "
+          f"flops {_eng(rec.get('total_flops'))} | "
+          f"bytes {_eng(rec.get('total_bytes'), 'B')} | "
+          f"attributed {_pct(rec.get('attributed_share'))}", file=out)
+    unknown = max(0.0, 1.0 - (rec.get("memory_bound_share") or 0.0)
+                  - (rec.get("compute_bound_share") or 0.0))
+    print(f"  bound split: memory {_pct(rec.get('memory_bound_share'))} "
+          f"| compute {_pct(rec.get('compute_bound_share'))} "
+          f"| other {_pct(unknown)}", file=out)
+    ops = rec.get("ops") or []
+    if ops:
+        print(f"\n  top {min(top, len(ops))} ops by predicted time:",
+              file=out)
+        print(f"  {'share':>6} {'time':>10} {'bound':<7} {'mult':>5} "
+              f"{'flops':>9} {'bytes':>9} {'AI':>8}  op", file=out)
+        for op in ops[:top]:
+            ai = op.get("intensity")
+            print(f"  {_pct(op.get('share')):>6} "
+                  f"{_ms(op.get('time_s')):>10} "
+                  f"{op.get('bound', '?'):<7} {op.get('mult', 1):>5} "
+                  f"{_eng(op.get('flops')):>9} "
+                  f"{_eng(op.get('bytes'), 'B'):>9} "
+                  f"{ai if ai is not None else '-':>8}  "
+                  f"{_op_label(op)}"
+                  + (" [est]" if op.get("estimated") else ""), file=out)
+    # per-operand-shape aggregation: the conv stream grouped by shape
+    groups: "OrderedDict[str, Dict[str, float]]" = OrderedDict()
+    for op in ops:
+        if op.get("op") == "(other)":
+            continue
+        key = _op_label(op)
+        g = groups.setdefault(key, {"share": 0.0, "time_s": 0.0,
+                                    "count": 0, "bound": op.get("bound")})
+        g["share"] += op.get("share") or 0.0
+        g["time_s"] += op.get("time_s") or 0.0
+        g["count"] += 1
+    agg = sorted(groups.items(), key=lambda kv: kv[1]["share"],
+                 reverse=True)
+    if agg:
+        print(f"\n  by operand shape (top {min(top, len(agg))}):",
+              file=out)
+        print(f"  {'share':>6} {'time':>10} {'bound':<7} {'n':>3}  "
+              f"shape", file=out)
+        for key, g in agg[:top]:
+            print(f"  {_pct(g['share']):>6} {_ms(g['time_s']):>10} "
+                  f"{g['bound'] or '?':<7} {g['count']:>3}  {key}",
+                  file=out)
+    colls = rec.get("collectives") or []
+    if colls:
+        print("\n  collectives (per device, per execution):", file=out)
+        print(f"  {'op':<20} {'group':>5} {'count':>6} "
+              f"{'payload':>10} {'wire bytes':>11}", file=out)
+        for c in colls:
+            print(f"  {c.get('op', '?'):<20} {c.get('group', '-'):>5} "
+                  f"{c.get('count', 0):>6} "
+                  f"{_eng(c.get('payload_bytes'), 'B'):>10} "
+                  f"{_eng(c.get('wire_bytes'), 'B'):>11}", file=out)
+        print(f"  total predicted collective wire bytes/execution: "
+              f"{_eng(rec.get('collective_wire_bytes'), 'B')}", file=out)
+    print("", file=out)
+
+
+def print_recompiles(recompiles: List[dict], out=None) -> None:
+    out = out if out is not None else sys.stdout
+    if not recompiles:
+        return
+    print(f"recompile forensics ({len(recompiles)} event(s) past the "
+          "pinned one-compile expectation):", file=out)
+    for rec in recompiles:
+        changed = rec.get("changed") or []
+        if changed:
+            det = "; ".join(
+                f"{c.get('arg')}: {c.get('was')} -> {c.get('now')}"
+                for c in changed[:6])
+            if len(changed) > 6:
+                det += f" (+{len(changed) - 6} more)"
+        else:
+            det = rec.get("note") or "no shape change recorded"
+        print(f"  {rec.get('program')}: {rec.get('compiles')} compile(s) "
+              f"(total {rec.get('total_compiles')}) — {det}", file=out)
+    print("", file=out)
+
+
+def print_compare(old: Dict[str, dict], new: Dict[str, dict],
+                  out=None) -> None:
+    out = out if out is not None else sys.stdout
+    common = [p for p in old if p in new]
+    if not common:
+        print("no common programs between the two runs", file=out)
+        return
+    print(f"{'program':<24} {'field':<26} {'old':>12} {'new':>12} "
+          f"{'delta':>9}", file=out)
+    fields = (("predicted_mfu", _pct), ("memory_bound_share", _pct),
+              ("predicted_s", _ms), ("collective_wire_bytes",
+                                     lambda v: _eng(v, "B")),
+              ("total_flops", _eng), ("total_bytes",
+                                      lambda v: _eng(v, "B")))
+    for prog in common:
+        o, n = old[prog], new[prog]
+        for fname, fmt in fields:
+            ov, nv = o.get(fname), n.get(fname)
+            if ov is None and nv is None:
+                continue
+            if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+                    and ov:
+                delta = f"{100.0 * (nv - ov) / abs(ov):+.1f}%"
+            else:
+                delta = "-"
+            print(f"{prog:<24} {fname:<26} {fmt(ov):>12} {fmt(nv):>12} "
+                  f"{delta:>9}", file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("logs", nargs="+", help="run_<id>.jsonl file(s)")
+    ap.add_argument("--top", type=int, default=12,
+                    help="ops/shape-groups to print per program")
+    ap.add_argument("--program", default=None,
+                    help="only this program's record")
+    ap.add_argument("--compare", default=None, metavar="OTHER",
+                    help="second run log: diff predicted MFU / bound "
+                    "share / collective bytes per program")
+    ap.add_argument("--min-attr", type=float, default=0.0,
+                    help="fail (exit 2) when any program attributes "
+                    "less than this fraction of predicted time")
+    args = ap.parse_args(argv)
+
+    rooflines, recompiles = load_records(args.logs)
+    if args.program:
+        rooflines = {p: r for p, r in rooflines.items()
+                     if p == args.program}
+    if not rooflines and not recompiles:
+        print("no roofline/recompile records found (capture with "
+              "obs_roofline: true)", file=sys.stderr)
+        return 1
+
+    if args.compare:
+        other, _ = load_records([args.compare])
+        if args.program:
+            other = {p: r for p, r in other.items() if p == args.program}
+        print_compare(rooflines, other)
+        return 0
+
+    for rec in rooflines.values():
+        print_program(rec, args.top)
+    print_recompiles(recompiles)
+
+    if args.min_attr > 0 and rooflines:
+        worst_prog, worst = min(
+            ((p, r.get("attributed_share") or 0.0)
+             for p, r in rooflines.items()), key=lambda kv: kv[1])
+        if worst < args.min_attr:
+            print(f"FAIL: program {worst_prog!r} attributes only "
+                  f"{100 * worst:.1f}% of its predicted device time "
+                  f"(< {100 * args.min_attr:.0f}%)", file=sys.stderr)
+            return 2
+        print(f"coverage OK: every program attributes >= "
+              f"{100 * args.min_attr:.0f}% (worst {worst_prog!r} at "
+              f"{100 * worst:.1f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # `roofline_report ... | head` is fine
+        sys.exit(0)

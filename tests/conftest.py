@@ -83,13 +83,37 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+class _CompileDelta(int):
+    """An int whose repr carries the latest recompile-forensics records:
+    a failing ``assert delta() == 0`` then NAMES the program and the
+    changed abstract shapes instead of printing a bare counter."""
+
+    def __repr__(self):  # pytest shows repr() of compared operands
+        n = int(self)
+        if n == 0:
+            return str(n)
+        from fedml_tpu.core.obs import roofline
+        recs = roofline.recent_recompiles()
+        if not recs:
+            return (f"{n} (no recompile-forensics record — the compile "
+                    "came from a seam outside the dispatch trackers)")
+        det = "; ".join(
+            f"{r['program']}: " + (", ".join(
+                f"{c['arg']} {c['was']} -> {c['now']}"
+                for c in (r.get("changed") or [])[:4])
+                or (r.get("note") or "?"))
+            for r in recs[-3:])
+        return f"{n} (recompile forensics: {det})"
+
+
 @pytest.fixture
 def xla_compile_counter():
     """Counts XLA backend compiles via the process-wide jax.monitoring
     listener at the mlops seam. Use ``reset()`` after warmup, then assert
     ``delta() == 0`` across steady-state work — a nonzero delta is a
     shape-instability regression that would otherwise recompile silently
-    every round."""
+    every round. On failure the delta's repr prints the recompile
+    forensics (core/obs/roofline), naming the shapes that moved."""
     from fedml_tpu.core import mlops
 
     mlops.install_compile_counter()
@@ -102,6 +126,6 @@ def xla_compile_counter():
             self._start = mlops.compile_count()
 
         def delta(self):
-            return mlops.compile_count() - self._start
+            return _CompileDelta(mlops.compile_count() - self._start)
 
     return _Counter()
