@@ -1759,6 +1759,104 @@ def bench_llm_serving_adapter_churn(concurrency=64, rounds=4, max_new=12,
     }), flush=True)
 
 
+def bench_cohort_assembly(populations=(10_000, 100_000, 1_000_000),
+                          rounds=8, k=128):
+    """Million-client control plane (core/selection, ISSUE 15): per-round
+    cohort-assembly cost over synthetic populations of 10k/100k/1M
+    devices — streaming eligibility scan (hash-derived charging/idle/
+    unmetered flags, ~51% eligible) + Oort-utility scoring over the
+    SPARSE stats store + chunked partial top-k + the deadline pacer —
+    and, on the same populations, the selection strategies' per-round
+    ``select()`` cost with seeded candidate pools (``oort``) vs the
+    uniform stream. The headline is the 1M-client assembly wall; the leg
+    table carries the selection-overhead-vs-population column and the
+    sublinearity ratio (1M ÷ 10k — linear scaling would read ~100x)."""
+    import numpy as np
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.selection import (DeadlinePacer, SelectionManager,
+                                          StreamingCohortAssembler,
+                                          make_stats_store,
+                                          population_chunks)
+    from fedml_tpu.core.selection.cohort import _seeded_jitter
+
+    def leg(n: int):
+        args = Arguments(
+            dataset="synthetic_mnist", model="lr", client_num_in_total=n,
+            client_num_per_round=k, random_seed=7,
+            sampling_stream="seeded", selection_store="sparse",
+            cohort_require_charging=True, allow_synthetic=True)
+        store = make_stats_store(args, n)
+        # realistic warm history: a few thousand previously-seen devices
+        rng = np.random.default_rng(0)
+        touched = rng.choice(n, size=min(4096, n // 2), replace=False)
+        for i, cid in enumerate(touched):
+            store.record_selected(i % 64, [int(cid)])
+            store.record_loss(int(cid), float(rng.gamma(2.0, 1.0)))
+            store.record_latency(int(cid), float(rng.gamma(2.0, 5.0)))
+            store.record_availability(int(cid),
+                                      participated=bool(i % 5),
+                                      work=1.0)
+        asm = StreamingCohortAssembler(args, store, n)
+        pacer = DeadlinePacer.from_args(args)
+
+        def elig(ids):  # ~51% "charging" via the seeded hash
+            return _seeded_jitter(ids, 99, 0) < 0.51
+
+        walls = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            res = asm.assemble(r, pacer.target_cohort(k),
+                               population_chunks(n, asm.chunk),
+                               eligible_fn=elig)
+            walls.append((time.perf_counter() - t0) * 1e3)
+            pacer.observe_round(completed=int(0.9 * len(res.cohort)),
+                                expected=len(res.cohort),
+                                wall_s=pacer.deadline_s * 0.4)
+        # strategy select() overhead on the same population (oort rides
+        # a seeded candidate pool above the threshold; uniform rides the
+        # streaming sampler)
+        sel = {}
+        for strat in ("uniform", "oort"):
+            mgr = SelectionManager(
+                Arguments(dataset="synthetic_mnist", model="lr",
+                          client_num_in_total=n, client_num_per_round=k,
+                          random_seed=7, sampling_stream="seeded",
+                          selection_store="sparse",
+                          client_selection=strat, allow_synthetic=True),
+                n)
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                mgr.select(r, k)
+            sel[strat] = (time.perf_counter() - t0) * 1e3 / rounds
+        return {"assembly_ms": round(float(np.median(walls)), 3),
+                "select_oort_ms": round(sel["oort"], 3),
+                "select_uniform_ms": round(sel["uniform"], 3),
+                "touched_rows": store.num_touched()}
+
+    legs = {f"pop_{n//1000}k" if n < 1_000_000 else "pop_1m": leg(n)
+            for n in populations}
+    lo = legs[next(iter(legs))]
+    hi = legs[list(legs)[-1]]
+    ratio = hi["assembly_ms"] / max(lo["assembly_ms"], 1e-9)
+    sel_ratio = hi["select_oort_ms"] / max(lo["select_oort_ms"], 1e-9)
+    print(json.dumps({
+        "metric": "cross_device_cohort_assembly_ms",
+        "value": hi["assembly_ms"],
+        "unit": f"median ms to assemble a {k}-cohort from 1M synthetic "
+                f"devices (streaming eligibility + oort utility + "
+                f"partial top-k, sparse store; legs: per-population "
+                f"assembly and strategy-select overhead)",
+        # ratios ride legs so bench_diff gates them (probe "overhead"
+        # reads lower-is-better: selection must stay sublinear)
+        "legs": dict(legs, scaling={
+            "overhead_ratio_1m_vs_10k": round(ratio, 2),
+            "select_overhead_ratio_1m_vs_10k": round(sel_ratio, 2)}),
+        "population_scaling": f"{populations[-1] // populations[0]}x "
+                              f"population -> {ratio:.1f}x assembly cost",
+    }), flush=True)
+
+
 def _sum_collective_kinds(colls, block):
     """Per-(op, group) wire bytes per round — SUMMED across distinct
     operand shapes (the roofline rows key on shape too; collapsing by
@@ -1885,6 +1983,7 @@ def run():
             ("fedavg_async_robust_updates_per_hour", bench_async_robust),
             ("fedavg_chaos_selection_rounds_to_target",
              bench_chaos_selection),
+            ("cross_device_cohort_assembly_ms", bench_cohort_assembly),
             ("fedopt_shakespeare_rnn_rounds_per_hour",
              bench_shakespeare_fedopt),
             ("fedllm_lora_federated_round_s", bench_federated_lora),

@@ -29,10 +29,42 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import mlops
+from .sparse import SparseClientStatsStore
 from .stats import ClientStatsStore
-from .strategies import SELECTION_STRATEGIES, create_strategy
+from .strategies import (DEFAULT_POOL_THRESHOLD, SELECTION_STRATEGIES,
+                         create_strategy)
 
 logger = logging.getLogger(__name__)
+
+STORE_BACKENDS = ("auto", "dense", "sparse")
+
+
+def make_stats_store(args, num_clients: int, **store_kw):
+    """The ONE ``selection_store`` knob reading (``auto``/``dense``/
+    ``sparse``), shared by the engine manager and the cross-device
+    cohort plane. ``auto`` (default) keeps the dense backend — O(N)
+    state, whole-population reads — below
+    ``selection_sparse_threshold`` clients and flips to the sparse
+    backend above it, where dense allocation alone would dwarf the
+    round. ``selection_store_capacity`` (sparse only) caps rows with
+    least-recently-touched eviction."""
+    backend = str(getattr(args, "selection_store", "auto")
+                  or "auto").lower()
+    if backend not in STORE_BACKENDS:
+        raise ValueError(f"selection_store {backend!r} unknown; choose "
+                         f"from {STORE_BACKENDS}")
+    n = int(num_clients)
+    if backend == "auto":
+        threshold = int(getattr(args, "selection_sparse_threshold",
+                                DEFAULT_POOL_THRESHOLD)
+                        or DEFAULT_POOL_THRESHOLD)
+        backend = "sparse" if n >= threshold else "dense"
+    if backend == "sparse":
+        cap = int(getattr(args, "selection_store_capacity", 0) or 0)
+        logger.info("selection stats: sparse backend over %d clients"
+                    "%s", n, f" (capacity {cap})" if cap else "")
+        return SparseClientStatsStore(n, capacity=cap, **store_kw)
+    return ClientStatsStore(n, **store_kw)
 
 # slot placement: client k of the sampled list lands on device
 # cid // cpd at that device's next free slot — the SAME loop as
@@ -61,8 +93,8 @@ class SelectionManager:
                 f"from {SELECTION_STRATEGIES}")
         self.adaptive = bool(getattr(args, "selection_adaptive_oversample",
                                      False))
-        self.store = ClientStatsStore(
-            self.num_clients,
+        self.store = make_stats_store(
+            args, self.num_clients,
             loss_window=int(getattr(args, "selection_loss_window", 8) or 8),
             ema_alpha=float(getattr(args, "selection_ema_alpha", 0.2)
                             or 0.2))

@@ -28,6 +28,24 @@ per-client state:
 All state is plain NumPy arrays, so ``state_dict``/``load_state_dict``
 round-trip through :class:`~fedml_tpu.core.checkpoint.RoundCheckpointer`
 (orbax ``StandardSave``) and crash-resume replays identical selections.
+
+Two query surfaces coexist:
+
+* the legacy **whole-population** arrays/properties (``reputation``,
+  ``last_loss()``, ...) — O(N) reads kept for the dense cross-silo and
+  small-simulation callers;
+* **id-parameterized** queries (``last_loss_for(ids)``, ...) — the
+  candidate-pool surface, O(len(ids)) on both backends. Strategies go
+  through these exclusively so a
+  :class:`~fedml_tpu.core.selection.sparse.SparseClientStatsStore` can
+  stand in for the dense store without ever materializing the
+  population.
+
+Population-pooled reductions (``population_dropout_mean``, the
+reputation cohort mean, ``observed_rms_mean``) are computed over the
+OBSERVED rows in ascending-id order on both backends — same multiset,
+same order, same pairwise-summation tree — which is what makes
+dense-vs-sparse posterior parity *bit-identical*, not merely close.
 """
 
 from __future__ import annotations
@@ -153,6 +171,15 @@ class ClientStatsStore:
                             1.0 / self.ema_interarrival, 0.0)
         return np.where(self.arr_obs > 0, rate, 0.0).astype(np.float32)
 
+    def arrival_rate_for(self, ids: Sequence[int]) -> np.ndarray:
+        """[len(ids)] arrivals per unit time; 0 for never-observed ids —
+        O(len(ids)): index first, divide after (the *_for contract)."""
+        ids = np.asarray(ids, np.int64)
+        ei = self.ema_interarrival[ids]
+        with np.errstate(divide="ignore"):
+            rate = np.where(ei > 0, 1.0 / ei, 0.0)
+        return np.where(self.arr_obs[ids] > 0, rate, 0.0).astype(np.float32)
+
     def predicted_staleness(self, pour_interval_s: float) -> np.ndarray:
         """[n] expected model-version lag of each client's next upload:
         inter-arrival EMA over the pour interval. NaN for never-observed
@@ -186,11 +213,93 @@ class ClientStatsStore:
         obs = self.incl_obs + self.excl_obs
         raw = (1.0 + self.incl_obs) / (2.0 + obs)
         seen = obs > 0
-        if not bool(np.any(seen)):
+        pop = self._reputation_pop_mean()
+        if pop is None:
             return np.ones(self.n, np.float32)
-        pop = float(np.mean(raw[seen]))
         rep = np.clip(raw / max(pop, 1e-9), 0.0, 1.0)
         return np.where(seen, rep, 1.0).astype(np.float32)
+
+    # --- id-parameterized queries (the candidate-pool surface) -------------
+    # Every *_for query is O(len(ids)) on the sparse backend too; the
+    # whole-population reads further down stay for dense callers.
+    def last_loss_for(self, ids: Sequence[int]) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        seen = self.loss_count[ids] > 0
+        idx = (self.loss_ptr[ids] - 1) % self.loss_window
+        last = self.losses[ids, idx]
+        return np.where(seen, last, np.inf).astype(np.float32)
+
+    def rms_loss_for(self, ids: Sequence[int]) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        k = np.minimum(self.loss_count[ids], self.loss_window)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ms = np.sum(self.losses[ids] ** 2, axis=1) / np.maximum(k, 1)
+        return np.where(k > 0, np.sqrt(ms), np.nan).astype(np.float32)
+
+    def reputation_for(self, ids: Sequence[int]) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        obs = self.incl_obs[ids] + self.excl_obs[ids]
+        raw = (1.0 + self.incl_obs[ids]) / (2.0 + obs)
+        pop = self._reputation_pop_mean()
+        if pop is None:
+            return np.ones(len(ids), np.float32)
+        rep = np.clip(raw / max(pop, 1e-9), 0.0, 1.0)
+        return np.where(obs > 0, rep, 1.0).astype(np.float32)
+
+    def _reputation_pop_mean(self) -> Optional[float]:
+        """Cohort-mean inclusion posterior over OBSERVED clients in
+        ascending-id order (the canonical reduction both backends share);
+        None when nobody has a verdict yet."""
+        obs = self.incl_obs + self.excl_obs
+        seen = obs > 0
+        if not bool(np.any(seen)):
+            return None
+        raw = (1.0 + self.incl_obs[seen]) / (2.0 + obs[seen])
+        return float(np.mean(raw))
+
+    def ema_work_for(self, ids: Sequence[int]) -> np.ndarray:
+        return self.ema_work[np.asarray(ids, np.int64)]
+
+    def latency_for(self, ids: Sequence[int]) -> np.ndarray:
+        """[len(ids)] EMA latency; NaN for never-observed clients."""
+        ids = np.asarray(ids, np.int64)
+        return np.where(self.has_latency[ids] > 0, self.ema_latency[ids],
+                        np.nan).astype(np.float32)
+
+    def times_selected_for(self, ids: Sequence[int]) -> np.ndarray:
+        return self.times_selected[np.asarray(ids, np.int64)]
+
+    def last_selected_for(self, ids: Sequence[int]) -> np.ndarray:
+        return self.last_selected[np.asarray(ids, np.int64)]
+
+    def observed_rms_mean(self) -> float:
+        """Mean RMS loss over clients WITH loss history (ascending-id
+        order — the canonical reduction); NaN when nobody has one. Oort's
+        neutral fill for unobserved candidates."""
+        seen = self.loss_count > 0
+        if not bool(np.any(seen)):
+            return float("nan")
+        ids = np.flatnonzero(seen)
+        return float(np.mean(self.rms_loss_for(ids)))
+
+    def observed_latency_median(self) -> float:
+        """Median EMA latency over clients WITH a latency observation;
+        NaN when nobody has one (Oort's default preferred latency)."""
+        seen = self.has_latency > 0
+        if not bool(np.any(seen)):
+            return float("nan")
+        return float(np.median(self.ema_latency[seen]))
+
+    def num_touched(self) -> int:
+        """How many clients carry ANY observed evidence — the dense
+        backend's answer is a scan; the sparse backend's is its size."""
+        return int(np.sum(self._touched_mask()))
+
+    def _touched_mask(self) -> np.ndarray:
+        return ((self.loss_count > 0) | (self.part_obs > 0)
+                | (self.drop_obs > 0) | (self.incl_obs + self.excl_obs > 0)
+                | (self.has_latency > 0) | (self.times_selected > 0)
+                | (self.arr_obs > 0) | (self.last_selected >= 0))
 
     # --- queries ------------------------------------------------------------
     def dropout_posterior_mean(self,
@@ -207,9 +316,13 @@ class ClientStatsStore:
     def population_dropout_mean(self) -> float:
         """POOLED posterior mean over the whole population — the adaptive
         over-sampling signal (per-client posteriors would be noise-
-        dominated early; the pooled estimate converges in a few rounds)."""
-        a = self.drop_prior_a + float(np.sum(self.drop_obs))
-        b = self.drop_prior_b + float(np.sum(self.part_obs))
+        dominated early; the pooled estimate converges in a few rounds).
+        Summed over rows WITH availability evidence in ascending-id order
+        (zero rows contribute nothing) so the sparse backend's pooled
+        posterior is bit-identical, not merely close."""
+        seen = (self.drop_obs > 0) | (self.part_obs > 0)
+        a = self.drop_prior_a + float(np.sum(self.drop_obs[seen]))
+        b = self.drop_prior_b + float(np.sum(self.part_obs[seen]))
         return float(a / (a + b))
 
     def last_loss(self) -> np.ndarray:

@@ -39,7 +39,8 @@ class DeviceClientManager(FedMLCommManager):
 
     def __init__(self, args, fed, bundle, spec, optimizer, device_id: int,
                  comm=None, backend: str = "INPROC",
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None,
+                 eligibility: Optional[dict] = None):
         size = int(getattr(args, "client_num_per_round", 1)) + 1
         super().__init__(args, comm, device_id, size, backend)
         self.fed = fed
@@ -49,6 +50,14 @@ class DeviceClientManager(FedMLCommManager):
         self.device_id = int(device_id)
         self.engine = (engine or str(getattr(args, "device_engine", "jax"))
                        ).lower()
+        # eligibility analogues the registration handshake carries
+        # (charging/idle/unmetered); a real device SDK would read the
+        # platform battery/network managers — the simulated device reads
+        # per-device overrides, then args knobs, defaulting to eligible
+        elig = dict(eligibility or {})
+        self.eligibility = {
+            k: bool(elig.get(k, getattr(args, f"device_{k}", True)))
+            for k in ("charging", "idle", "unmetered")}
         self.cache_dir = os.path.expanduser(
             getattr(args, "model_file_cache_dir", None)
             or "~/.cache/fedml_tpu/device_models")
@@ -93,6 +102,12 @@ class DeviceClientManager(FedMLCommManager):
         msg.add_params(DeviceMessage.ARG_DEVICE_ID, self.device_id)
         msg.add_params(DeviceMessage.ARG_DEVICE_OS, platform.system())
         msg.add_params(DeviceMessage.ARG_DEVICE_ENGINE, self.engine)
+        msg.add_params(DeviceMessage.ARG_DEVICE_CHARGING,
+                       self.eligibility["charging"])
+        msg.add_params(DeviceMessage.ARG_DEVICE_IDLE,
+                       self.eligibility["idle"])
+        msg.add_params(DeviceMessage.ARG_DEVICE_UNMETERED,
+                       self.eligibility["unmetered"])
         self.send_message(msg)
 
     def handle_round(self, msg: Message) -> None:

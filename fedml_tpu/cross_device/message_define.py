@@ -19,6 +19,14 @@ class DeviceMessage:
     ARG_DEVICE_ID = "device_id"
     ARG_DEVICE_OS = "device_os"
     ARG_DEVICE_ENGINE = "device_engine"
+    # eligibility analogues on the registration handshake (Bonawitz
+    # MLSys'19 §2: phones check in when charging + idle + on an unmetered
+    # network; the server's cohort assembly filters on them). Absent
+    # fields read as True — a device that predates the fields stays
+    # schedulable.
+    ARG_DEVICE_CHARGING = "device_charging"
+    ARG_DEVICE_IDLE = "device_idle"
+    ARG_DEVICE_UNMETERED = "device_unmetered"
     ARG_MODEL_FILE = "model_file"
     ARG_ROUND_IDX = "round_idx"
     ARG_DATA_SILO_IDX = "data_silo_idx"

@@ -29,12 +29,13 @@ def build_device_server(args, fed, bundle, backend: Optional[str] = None):
 
 def build_device_client(args, fed, bundle, device_id: int,
                         backend: Optional[str] = None,
-                        engine: Optional[str] = None):
+                        engine: Optional[str] = None,
+                        eligibility: Optional[dict] = None):
     spec = make_trainer_spec(fed, bundle)
     optimizer = create_optimizer(args, spec)
     return DeviceClientManager(args, fed, bundle, spec, optimizer,
                                device_id, backend=backend or _backend(args),
-                               engine=engine)
+                               engine=engine, eligibility=eligibility)
 
 
 def _backend(args) -> str:
@@ -64,15 +65,20 @@ def build_cross_device_runner(args, dataset, model):
 
 
 def run_cross_device_inproc(args, fed, bundle,
-                            engines: Optional[list] = None
+                            engines: Optional[list] = None,
+                            eligibility: Optional[list] = None
                             ) -> Dict[str, Any]:
     """Server + N simulated devices as threads over the in-proc broker —
-    the cross-device 'multi-node without a cluster' test mode."""
+    the cross-device 'multi-node without a cluster' test mode.
+    ``eligibility`` (optional, per device): charging/idle/unmetered
+    handshake overrides the cohort-assembly predicates read."""
     from ..cross_silo import run_inproc_session
     n = int(getattr(args, "client_num_per_round", 2))
     engs = engines or [None] * n
+    eligs = eligibility or [None] * n
     return run_inproc_session(args, lambda: [
         build_device_server(args, fed, bundle, backend="INPROC"),
         *[build_device_client(args, fed, bundle, device_id=i + 1,
-                              backend="INPROC", engine=engs[i])
+                              backend="INPROC", engine=engs[i],
+                              eligibility=eligs[i])
           for i in range(n)]], join_timeout_s=30.0)

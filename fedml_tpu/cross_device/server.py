@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Dict, Optional
 
 import jax
@@ -25,6 +26,7 @@ import numpy as np
 from ..core import mlops
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..simulation.sampling import FAST_SAMPLE_MIN_N, sample_ids_streaming
 from ..serving import check_model_magic, load_model, save_model
 from ..utils.paths import confine_path
 from .message_define import DeviceMessage
@@ -41,8 +43,15 @@ class DeviceAggregator:
         self.global_params = global_params
         self.eval_fn = eval_fn
         self.client_num = int(getattr(args, "client_num_per_round", 1))
+        self._expected = self.client_num
         self.model_files: Dict[int, str] = {}
         self.sample_nums: Dict[int, float] = {}
+
+    def set_round_expected(self, n: int) -> None:
+        """Per-round barrier width (cohort assembly over-samples the
+        dispatch but closes on the WANTED cohort — Bonawitz pace
+        steering: first k reports win, the rest are straggler slack)."""
+        self._expected = max(int(n), 1)
 
     def add_device_result(self, device_id: int, model_file: str,
                           num_samples: float) -> None:
@@ -50,7 +59,7 @@ class DeviceAggregator:
         self.sample_nums[device_id] = float(num_samples)
 
     def all_received(self) -> bool:
-        return len(self.model_files) >= self.client_num
+        return len(self.model_files) >= self._expected
 
     def aggregate(self):
         loaded = []
@@ -115,6 +124,35 @@ class DeviceServerManager(FedMLCommManager):
         # did -> on-device accuracy of the round's global model (native
         # devices report it; cleared per round)
         self._device_accs: dict = {}
+        # --- streaming cohort assembly (cohort_assembly knob; off =
+        # every online device trains every round, the legacy behavior).
+        # Population-plane pieces: sparse-capable stats store over device
+        # ids, handshake eligibility predicates, chunked top-k assembler,
+        # and Oort's deadline pacer driving the straggler timer + the
+        # over-sampled dispatch width.
+        self.cohort_enabled = bool(getattr(args, "cohort_assembly", False))
+        self.stats = None
+        self.assembler = None
+        self.pacer = None
+        self._cohort: list = []
+        self._barrier = self.expected_devices
+        self._dispatch_ts = 0.0
+        if self.cohort_enabled:
+            from ..core.selection import (DeadlinePacer,
+                                          StreamingCohortAssembler,
+                                          make_stats_store,
+                                          required_eligibility)
+            # +1: device ids are 1-based ranks
+            population = max(int(getattr(args, "client_num_in_total",
+                                         self.expected_devices)),
+                             self.expected_devices) + 1
+            self.stats = make_stats_store(args, population)
+            self.assembler = StreamingCohortAssembler(args, self.stats,
+                                                      population)
+            self.pacer = DeadlinePacer.from_args(args)
+            self.required_elig = required_eligibility(args)
+            self.cohort_k = int(getattr(args, "cohort_size", 0) or 0) \
+                or self.expected_devices
 
     # --- FSM ---------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -128,6 +166,13 @@ class DeviceServerManager(FedMLCommManager):
         self.devices_online[did] = {
             "os": msg.get(DeviceMessage.ARG_DEVICE_OS, "?"),
             "engine": msg.get(DeviceMessage.ARG_DEVICE_ENGINE, "?"),
+            # eligibility analogues (absent = True: a device that
+            # predates the handshake fields stays schedulable)
+            "charging": bool(msg.get(DeviceMessage.ARG_DEVICE_CHARGING,
+                                     True)),
+            "idle": bool(msg.get(DeviceMessage.ARG_DEVICE_IDLE, True)),
+            "unmetered": bool(msg.get(DeviceMessage.ARG_DEVICE_UNMETERED,
+                                      True)),
         }
         logger.info("server: device %d online (%s/%s), %d/%d", did,
                     self.devices_online[did]["os"],
@@ -145,27 +190,98 @@ class DeviceServerManager(FedMLCommManager):
         save_model(self.aggregator.global_params, path)
         return path
 
+    def _round_cohort(self) -> list:
+        """The devices this round trains: every online device (legacy),
+        or the streaming-assembled cohort — eligibility predicates over
+        the handshake metadata, utility scoring from observed history,
+        pacer-over-sampled dispatch width."""
+        online = sorted(self.devices_online)
+        if not self.cohort_enabled:
+            return online
+        from ..core import mlops
+        from ..core.selection.cohort import eligible_mask
+        target = self.pacer.target_cohort(self.cohort_k,
+                                          ceiling=len(online))
+        ids = np.asarray(online, np.int64)
+        metas = [self.devices_online[d] for d in online]
+        mask = eligible_mask(metas, self.required_elig)
+
+        def elig(chunk: np.ndarray) -> np.ndarray:
+            # the online table is one in-memory chunk here; a
+            # registry-backed deployment pages through its device table
+            pos = np.searchsorted(ids, chunk)
+            return mask[pos]
+
+        res = self.assembler.assemble(
+            self.round_idx, target, [ids], eligible_fn=elig,
+            deadline_s=self.pacer.deadline_s,
+            over_sample=self.pacer.over_sample)
+        cohort = sorted(res.cohort)
+        if not cohort:
+            logger.warning(
+                "cohort assembly round %d: no eligible device of %d "
+                "online — dispatching to every online device",
+                self.round_idx, len(online))
+            cohort = online
+        self.stats.record_selected(self.round_idx, cohort)
+        mlops.log_selection(
+            round_idx=self.round_idx, strategy="cohort",
+            sampled=cohort, excluded=[],
+            target_n=target,
+            dropout_posterior=round(
+                self.stats.population_dropout_mean(), 5))
+        logger.info(
+            "cohort round %d: %d/%d online eligible, dispatching %d "
+            "(deadline %.1fs, over-sample %.2f, assembly %.2fms)",
+            self.round_idx, res.eligible, len(online), len(cohort),
+            self.pacer.deadline_s, self.pacer.over_sample, res.wall_ms)
+        return cohort
+
+    def _round_deadline_s(self) -> float:
+        """Straggler budget for the CURRENT round: the pacer's live
+        deadline under cohort assembly, else the static knob."""
+        if self.cohort_enabled:
+            return float(self.pacer.deadline_s)
+        return self.round_timeout_s
+
     def _dispatch_round(self, msg_type: str) -> None:
-        """Write the global artifact once, point every device at it
-        (reference start_train JSON with the global model S3 path)."""
+        """Write the global artifact once, point every cohort device at
+        it (reference start_train JSON with the global model S3 path)."""
         path = self._global_model_file()
+        cohort = self._round_cohort()
         with self._lock:
             self._round_closed = False
+            self._cohort = list(cohort)
+            # cohort mode: the barrier closes on the WANTED k, not the
+            # over-sampled dispatch width — first k reports win
+            self._barrier = (min(self.cohort_k, len(cohort))
+                             if self.cohort_enabled
+                             else self.aggregator.client_num)
+            self.aggregator.set_round_expected(self._barrier)
+        self._dispatch_ts = time.time()
         # dead-round leash: if NO device ever reports this round (all
         # crashed post-registration), the tight first-arrival timer in
         # handle_device_model never arms and the round would hang forever.
         # Arm a generous 3x leash now; the first arrival swaps it for the
         # tight straggler timer (mirrors SecAggServerManager._start_round).
-        if self.round_timeout_s > 0:
-            self._arm_timer(3.0 * self.round_timeout_s)
+        deadline = self._round_deadline_s()
+        if deadline > 0:
+            self._arm_timer(3.0 * deadline)
         n_total = int(getattr(self.args, "client_num_in_total",
                               self.expected_devices))
-        rs = np.random.RandomState(1000 + self.round_idx)
-        silos = (np.arange(len(self.devices_online))
-                 if n_total <= len(self.devices_online)
-                 else rs.choice(n_total, len(self.devices_online),
-                                replace=False))
-        for i, did in enumerate(sorted(self.devices_online)):
+        if n_total <= len(cohort):
+            silos = np.arange(len(cohort))
+        elif n_total >= FAST_SAMPLE_MIN_N:
+            # population-scale silo draw: O(cohort) via the streaming
+            # sampler instead of RandomState.choice's [n_total]
+            # permutation (still a pure function of the round index)
+            silos = sample_ids_streaming(
+                np.random.default_rng((1000, self.round_idx)),
+                n_total, len(cohort))
+        else:
+            rs = np.random.RandomState(1000 + self.round_idx)
+            silos = rs.choice(n_total, len(cohort), replace=False)
+        for i, did in enumerate(cohort):
             msg = Message(msg_type, self.rank, did)
             msg.add_params(DeviceMessage.ARG_MODEL_FILE, path)
             msg.add_params(DeviceMessage.ARG_ROUND_IDX, self.round_idx)
@@ -220,15 +336,21 @@ class DeviceServerManager(FedMLCommManager):
             self.aggregator.add_device_result(
                 did, path,
                 float(msg.get(DeviceMessage.ARG_NUM_SAMPLES, 1.0)))
+            if self.cohort_enabled and self._dispatch_ts > 0:
+                # dispatch→upload wall clock: the utility scorer's
+                # system-latency signal and the pacer's raw material
+                self.stats.record_latency(did,
+                                          time.time() - self._dispatch_ts)
             acc = msg.get(DeviceMessage.ARG_DEVICE_EVAL_ACC)
             if acc is not None:  # on-device eval of the global model
                 self._device_accs[did] = float(acc)
             if not self.aggregator.all_received():
-                if (self.round_timeout_s > 0
+                deadline = self._round_deadline_s()
+                if (deadline > 0
                         and len(self.aggregator.model_files) == 1):
                     # first arrival: swap the dead-round leash for the
                     # tight straggler timeout
-                    self._arm_timer(self.round_timeout_s)
+                    self._arm_timer(deadline)
                 return
             self._finish_collect_locked()
         self._advance_round()
@@ -252,6 +374,24 @@ class DeviceServerManager(FedMLCommManager):
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self.cohort_enabled and self._cohort:
+            # the round's outcome feeds the control plane BEFORE
+            # aggregate() clears the report table: availability evidence
+            # per dispatched device (reported vs not — the Beta dropout
+            # posterior), then the pacer's deadline/over-sample step
+            reported = set(self.aggregator.model_files)
+            for did in self._cohort:
+                self.stats.record_availability(did,
+                                               participated=did in reported)
+            # the pacer measures delivery against the round's BARRIER
+            # (the wanted k), not the over-sampled dispatch width — a
+            # round that closed on k early reports is a SUCCESS even
+            # though the straggler slack never reported (Bonawitz pace
+            # steering: the slack exists to be discarded)
+            self.pacer.observe_round(
+                completed=len(reported),
+                expected=self._barrier,
+                wall_s=max(time.time() - self._dispatch_ts, 0.0))
         self.aggregator.aggregate()
 
     def _advance_round(self) -> None:

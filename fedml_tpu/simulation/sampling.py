@@ -27,6 +27,37 @@ import numpy as np
 
 SAMPLING_STREAMS = ("legacy", "seeded")
 
+# population size past which the seeded stream stops materializing and
+# permuting a full [N] id array per draw (np.random's replace=False path)
+# and samples ids via the generator directly. The two paths draw
+# DIFFERENT (equally valid, equally deterministic) cohorts, so the
+# switch is pinned to a fixed threshold — small-N draws stay
+# bit-identical to every recorded schedule.
+FAST_SAMPLE_MIN_N = 65536
+
+
+def sample_ids_streaming(gen: np.random.Generator, n: int,
+                         k: int) -> np.ndarray:
+    """Uniform k-of-n id sample WITHOUT materializing the population.
+
+    Floyd's algorithm: k draws from the generator, O(k) memory, exact
+    uniform subset — then a k-element shuffle so the placement order is
+    also uniform (callers treat sample order as schedule order). A pure
+    function of the generator's state, so draws stay replayable."""
+    k = min(int(k), int(n))
+    if k <= 0:
+        return np.empty(0, np.int64)
+    chosen: set = set()
+    order = []
+    for j in range(n - k, n):
+        t = int(gen.integers(0, j + 1))
+        pick = t if t not in chosen else j
+        chosen.add(pick)
+        order.append(pick)
+    out = np.asarray(order, np.int64)
+    gen.shuffle(out)
+    return out
+
 
 def sampling_stream_from_args(args) -> str:
     """The ``sampling_stream`` knob, validated. ``legacy`` (default) keeps
@@ -57,6 +88,12 @@ def client_sampling(round_idx: int, client_num_in_total: int,
         return list(rng.choice(range(client_num_in_total), num,
                                replace=False))
     gen = np.random.default_rng((int(random_seed), int(round_idx)))
+    if client_num_in_total >= FAST_SAMPLE_MIN_N:
+        # huge-population fast path: O(k) draws via the generator, no
+        # [N] permutation (Generator.choice with replace=False builds
+        # one) — still a pure function of (seed, round)
+        return [int(c) for c in
+                sample_ids_streaming(gen, client_num_in_total, num)]
     return [int(c) for c in gen.choice(client_num_in_total, num,
                                        replace=False)]
 

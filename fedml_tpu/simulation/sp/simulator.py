@@ -26,7 +26,7 @@ from ...core import mlops
 from ...core.checkpoint import RoundCheckpointer
 from ...core.contribution import ContributionAssessorManager
 from ...core.security import FedMLAttacker, FedMLDefender, stack_to_matrix
-from ..sampling import client_sampling, sampling_stream_from_args
+from ...core.selection import SelectionManager
 from ..tpu.engine import (ATTACK_FOLD, DEFENSE_FOLD, DP_CDP_FOLD,
                           DP_LDP_FOLD)
 
@@ -64,15 +64,44 @@ class SPSimulator:
             self.attacker.is_model_attack()
             or self.defender.is_defense_enabled())
         self.contribution = ContributionAssessorManager(args)
+        # participant selection (the engine's subsystem, same knobs):
+        # passive at defaults — uniform + legacy stream delegates to the
+        # reference draw, trajectories stay bit-identical
+        self.selection = SelectionManager(args, self.fed.num_clients)
         self.ckpt = RoundCheckpointer(
             getattr(args, "checkpoint_dir", None),
             int(getattr(args, "checkpoint_every_rounds", 0) or 0))
         self.history: List[Dict[str, Any]] = []
 
     def _ckpt_state(self):
-        return {"params": self.params, "server_state": self.server_state,
-                "client_states": self.client_states, "rng": self.rng,
-                "dp": self.dp.state_dict()}
+        st = {"params": self.params, "server_state": self.server_state,
+              "client_states": self.client_states, "rng": self.rng,
+              "dp": self.dp.state_dict()}
+        if self.selection.stateful:
+            # selection history rides the checkpoint so crash-resume
+            # replays IDENTICAL cohorts (same contract as the engine)
+            st["selection"] = self.selection.state_dict()
+        return st
+
+    def _ckpt_latest(self):
+        """Tolerant restore (mirrors the engine): the optional
+        ``selection`` leaf's presence can flip between save and resume
+        (knob change, version skew) — retry without it rather than
+        refusing a valid checkpoint."""
+        template = self._ckpt_state()
+        try:
+            return self.ckpt.latest(template)
+        except Exception as e:
+            if "selection" not in template:
+                raise
+            restored = self.ckpt.latest(
+                {k: v for k, v in template.items() if k != "selection"})
+            if restored is not None:
+                logger.warning(
+                    "checkpoint restore succeeded only without the "
+                    "selection leaf (%s: %s) — selection history resumes "
+                    "cold", type(e).__name__, e)
+            return restored
 
     def _load_ckpt_state(self, st):
         self.params = st["params"]
@@ -80,6 +109,8 @@ class SPSimulator:
         self.client_states = st["client_states"]
         self.rng = st["rng"]
         self.dp.load_state_dict(st["dp"])
+        if "selection" in st and self.selection.stateful:
+            self.selection.load_state_dict(st["selection"])
 
     def _client_data(self, cid: int) -> ClientData:
         return jax.tree_util.tree_map(lambda a: a[cid], self.fed.train)
@@ -104,8 +135,16 @@ class SPSimulator:
         if self.contribution.enabled:
             self._assess_contribution(mat, w, sampled, round_idx)
         if self.defender.is_defense_enabled():
-            vec, _ = self.defender.defend_matrix(
+            vec, info = self.defender.defend_matrix(
                 mat, w, jax.random.fold_in(round_key, DEFENSE_FOLD), ids)
+            if self.selection.track and info:
+                # defense verdicts feed reputation here too (the engine's
+                # mask-vs-index validation applies unchanged)
+                from ..tpu.engine import _verdict_from_info
+                v = _verdict_from_info(info, len(sampled))
+                if v is not None:
+                    self.selection.store.record_verdict(
+                        [int(c) for c in sampled], v)
         else:
             from ...core.security.defense.robust_agg import weighted_mean
             vec = weighted_mean(mat, jnp.asarray(w, jnp.float32))
@@ -132,18 +171,25 @@ class SPSimulator:
                            epochs=int(args.epochs))
         t0 = time.time()
         start_round = 0
-        restored = self.ckpt.latest(self._ckpt_state())
+        restored = self._ckpt_latest()
         if restored is not None:
             step, st = restored
             self._load_ckpt_state(st)
             start_round = step + 1
             logger.info("resumed from checkpoint at round %d", step)
         for round_idx in range(start_round, rounds):
-            sampled = client_sampling(
-                round_idx, self.fed.num_clients,
-                int(args.client_num_per_round),
-                random_seed=int(getattr(args, "random_seed", 0) or 0),
-                stream=sampling_stream_from_args(args))
+            # selection subsystem (uniform default = the reference's
+            # client_sampling draw, bit-identical); a reputation
+            # strategy's benched clients are simply not trained here —
+            # the SP loop has no work-0 slot channel to renormalize
+            full_sampled, excluded = self.selection.select(
+                round_idx, int(args.client_num_per_round))
+            excl = set(excluded)
+            sampled = [c for c in full_sampled if c not in excl]
+            self.selection.note_schedule(
+                round_idx, full_sampled, excluded,
+                {int(c): 1.0 for c in sampled},
+                target_n=len(full_sampled))
             round_key = jax.random.fold_in(self.rng, round_idx)
             updates, weights, extras_list, states, metrics = [], [], [], [], []
             for cid in sampled:
@@ -164,6 +210,15 @@ class SPSimulator:
                 metrics.append(out.metrics)
                 if self.opt.has_client_state:
                     self.client_states[cid] = out.client_state
+            if self.selection.track:
+                # per-client losses feed the loss ring (SP materializes
+                # round metrics host-side anyway — no extra transfer
+                # pressure, unlike the engine's lazy queue)
+                for cid, m in zip(sampled, metrics):
+                    c = float(m["count"])
+                    if c > 0:
+                        self.selection.store.record_loss(
+                            int(cid), float(m["loss_sum"]) / c)
             w = jnp.stack(weights)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *updates)
             agg_update = self._aggregate_robust(stacked, w, sampled,

@@ -478,7 +478,9 @@ class AsyncBufferedSimulator(TPUSimulator):
         else:  # oort
             util = self.selection.strategy._utility(self.version)
         rate = st.arrival_rate()
-        seen = st.arr_obs > 0
+        # rate == 0 IFF never observed (both store backends); the sparse
+        # store's arr_obs is row-space, so never read it as [n] here
+        seen = rate > 0
         fill = (float(np.mean(rate[seen])) if bool(np.any(seen)) else 1.0)
         rate = np.where(seen, rate, max(fill, 1e-9))
         score = np.asarray([float(util[c]) * float(rate[c])

@@ -48,13 +48,18 @@ from typing import Dict, List, Optional, Tuple
 # memory-bound time share DOWN), `efficiency` the weak-scaling column,
 # `swaps` the adapter-churn leg's sustained hot-swap count (more churn
 # absorbed at the same tokens/s is better).
+# population-plane additions (ISSUE 15): `_ms` covers the cohort-
+# assembly and strategy-select wall columns
+# (cross_device_cohort_assembly_ms and its assembly_ms/select_*_ms
+# legs), `overhead` the 1M-vs-10k scaling ratios — both drive DOWN
+# (selection must stay sublinear in population).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
                   "goodput", "success", "hit_rate", "reused",
                   "efficiency", "swaps", "attributed")
 LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles",
                  "time_to", "step_time", "wall", "round_s",
                  "resets", "trips", "faults", "fragmentation", "ttft",
-                 "bound_share")
+                 "bound_share", "_ms", "overhead")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

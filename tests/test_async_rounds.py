@@ -362,10 +362,18 @@ class TestAsyncEngine:
         assert r_res["rounds"] == r_ref["rounds"]
         assert r_res["virtual_time_s"] == pytest.approx(
             r_ref["virtual_time_s"])
+        # trajectory equality, not bit equality: the resumed engine is a
+        # SEPARATELY COMPILED program instance, and XLA:CPU fuses/orders
+        # reductions differently under concurrent compilation load —
+        # observed drift is ~2e-5 relative over the 7 post-restore pours
+        # (flaky ~1/3 of triple-suite runs at the old rtol=1e-6, flagged
+        # in PR 13). The replay CLAIM (same pours, same cohorts, same
+        # virtual clock) is pinned exactly above; params get a tolerance
+        # with headroom over the observed drift.
         for a, b in zip(jax.tree_util.tree_leaves(r_ref["params"]),
                         jax.tree_util.tree_leaves(r_res["params"])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=1e-6, atol=1e-7)
+                                       rtol=1e-4, atol=1e-6)
 
 
 # --- async optimizers: staleness corrections ---------------------------------

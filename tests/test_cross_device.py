@@ -170,6 +170,120 @@ def test_dead_device_does_not_stall_round(tmp_path):
     assert server.result["final_test_acc"] > 0.5
 
 
+class TestCohortAssembly:
+    """Streaming cohort assembly on the cross-device scheduler path
+    (ISSUE 15): eligibility predicates from the registration handshake,
+    pacer-driven deadlines, and chaos (a dead cohort member) + selection
+    composing on the same rounds — the standing scenario gap."""
+
+    def _session(self, tmp_path, n_devices, dead=(), eligibility=None,
+                 **kw):
+        import threading
+        from fedml_tpu.core.distributed.communication.inproc import \
+            InProcBroker
+        from fedml_tpu.cross_device import (DeviceClientManager,
+                                            build_device_client,
+                                            build_device_server)
+
+        class DeadDevice(DeviceClientManager):
+            def handle_round(self, msg):
+                self.finish()  # dies before training/uploading
+
+        args = make_args(model_file_cache_dir=str(tmp_path),
+                         client_num_in_total=n_devices,
+                         client_num_per_round=n_devices,
+                         cohort_assembly=True, **kw)
+        args.inproc_broker = InProcBroker()
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        server = build_device_server(args, fed, bundle, backend="INPROC")
+        eligs = eligibility or [None] * n_devices
+        devices = []
+        for i in range(1, n_devices + 1):
+            if i in dead:
+                from fedml_tpu.core.algframe.client_trainer import \
+                    make_trainer_spec
+                from fedml_tpu.optimizers.registry import create_optimizer
+                spec = make_trainer_spec(fed, bundle)
+                devices.append(DeadDevice(
+                    args, fed, bundle, spec, create_optimizer(args, spec),
+                    device_id=i, backend="INPROC",
+                    eligibility=eligs[i - 1]))
+            else:
+                devices.append(build_device_client(
+                    args, fed, bundle, device_id=i, backend="INPROC",
+                    eligibility=eligs[i - 1]))
+        threads = [threading.Thread(target=d.run, daemon=True)
+                   for d in devices]
+        for t in threads:
+            t.start()
+        done = {}
+
+        def run_server():
+            server.run()
+            done["ok"] = True
+
+        st = threading.Thread(target=run_server, daemon=True)
+        st.start()
+        st.join(timeout=120)
+        assert done.get("ok"), "server stalled"
+        return server
+
+    def test_eligibility_filters_cohort(self, tmp_path):
+        """A device registering as not-charging must never be scheduled
+        while cohort_require_charging is on — and rounds still close on
+        the eligible cohort."""
+        server = self._session(
+            tmp_path, n_devices=3, comm_round=3, cohort_size=2,
+            cohort_require_charging=True,
+            eligibility=[None, {"charging": False}, None])
+        assert len(server.result["history"]) == 3
+        assert server.result["final_test_acc"] > 0.5
+        # device 2 (ineligible) was never selected, never participated
+        sel = server.stats.times_selected_for([1, 2, 3])
+        assert sel[1] == 0 and sel[0] == 3 and sel[2] == 3
+        # successful rounds (barrier k met) must NOT read as
+        # under-delivery: the pacer measures against the wanted k, not
+        # the over-sampled dispatch width
+        assert server.pacer.deadline_s <= 60.0
+        assert float(np.sum(server.stats.dropout_posterior_mean([2]))) \
+            < 0.1  # no dropout evidence either — it was never asked
+
+    def test_chaos_plus_selection_pacer_adapts(self, tmp_path):
+        """A cohort member that dies post-registration (the chaos leg)
+        forces deadline closes; the pacer observes the under-delivery
+        and stretches the deadline — chaos + selection composing on the
+        cross-device scheduler path."""
+        server = self._session(
+            tmp_path, n_devices=3, dead={3}, comm_round=2,
+            pacer_deadline_s=2.0, pacer_target_frac=0.9)
+        assert len(server.result["history"]) == 2
+        assert server.result["final_test_acc"] > 0.5
+        # under-delivered rounds stretched the pacer
+        assert server.pacer.deadline_s > 2.0
+        assert server.pacer.over_sample > 1.3
+        assert server.pacer.rounds_observed == 2
+        # the dead device accumulated dropout evidence; the live ones
+        # accumulated participation + upload latency
+        assert server.stats.dropout_posterior_mean([3])[0] > \
+            server.stats.dropout_posterior_mean([1])[0]
+        lat = server.stats.latency_for([1, 2])
+        assert np.all(np.isfinite(lat))
+
+    def test_cohort_off_is_legacy_path(self, tmp_path):
+        """cohort_assembly off (default): no stats plane, every online
+        device trains — the pre-PR behavior byte-for-byte."""
+        args = make_args(model_file_cache_dir=str(tmp_path))
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        result = run_cross_device_inproc(args, fed, bundle)
+        assert len(result["history"]) == 3
+        from fedml_tpu.cross_device.runner import build_device_server
+        server = build_device_server(args, fed, bundle, backend="INPROC")
+        assert not server.cohort_enabled
+        assert server.stats is None and server.pacer is None
+
+
 def test_artifact_codec_is_not_pickle(tmp_path):
     """Model artifacts are msgpack (magic-checked), never pickled — loading
     a foreign file must fail loudly, not execute code."""

@@ -1,0 +1,584 @@
+"""Million-client control plane (core/selection at population scale).
+
+Covers (1) dense-vs-sparse stats-store parity — the same observation
+sequence must yield BIT-IDENTICAL posteriors and selections on both
+backends; (2) sparse-store persistence — compacted round-trip, restore
+from a legacy dense snapshot, crash-resume through RoundCheckpointer
+(orbax restoring saved shapes past a smaller template is load-bearing
+and pinned here), LRU eviction at capacity; (3) candidate-pool
+selection — partial top-k equivalence, pool knobs, O(m)-shaped draws;
+(4) the streaming sampler fast path (small-N draws unchanged, huge-N
+draws valid + deterministic); (5) streaming cohort assembly —
+brute-force equivalence, eligibility predicates, chunking independence;
+(6) the deadline pacer — deterministic given (knobs, history), bounded;
+(7) the SP simulator's selection seam (the PR 3/5 gap): strategies +
+crash-resume replay. The 1M-client smoke rides the slow gate.
+"""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.selection import (ClientStatsStore, DeadlinePacer,
+                                      SelectionManager,
+                                      SparseClientStatsStore,
+                                      StreamingCohortAssembler,
+                                      create_strategy, make_stats_store,
+                                      partial_top_k, pool_size,
+                                      population_chunks)
+from fedml_tpu.simulation.sampling import (FAST_SAMPLE_MIN_N,
+                                           client_sampling,
+                                           sample_ids_streaming)
+
+pytestmark = [pytest.mark.selection, pytest.mark.population]
+
+
+def make_args(**kw):
+    base = dict(dataset="synthetic_mnist", model="lr",
+                client_num_in_total=64, client_num_per_round=8,
+                comm_round=3, epochs=1, batch_size=16, learning_rate=0.1,
+                frequency_of_the_test=2, random_seed=42)
+    base.update(kw)
+    return Arguments(**base)
+
+
+def feed_observations(store, n=64, seed=0, rounds=12, k=8):
+    """One deterministic observation history, replayable into any
+    backend: selections, losses, availability, latencies, verdicts."""
+    rng = np.random.default_rng(seed)
+    for r in range(rounds):
+        ids = rng.choice(n, k, replace=False)
+        store.record_selected(r, [int(c) for c in ids])
+        for c in ids:
+            c = int(c)
+            store.record_loss(c, float(rng.gamma(2.0, 1.0)))
+            store.record_availability(c, participated=bool(rng.random()
+                                                           > 0.25),
+                                      work=float(rng.uniform(0.4, 1.0)))
+            if rng.random() > 0.5:
+                store.record_latency(c, float(rng.gamma(2.0, 3.0)))
+            if rng.random() > 0.6:
+                store.record_arrival(c, float(rng.gamma(2.0, 2.0)))
+        store.record_verdict([int(c) for c in ids],
+                             rng.uniform(0.0, 1.0, size=k))
+    return store
+
+
+# --- dense vs sparse parity --------------------------------------------------
+
+class TestDenseSparseParity:
+    def _pair(self, n=64):
+        dense = feed_observations(ClientStatsStore(n), n=n)
+        sparse = feed_observations(SparseClientStatsStore(n), n=n)
+        return dense, sparse
+
+    def test_posterior_queries_bit_identical(self):
+        dense, sparse = self._pair()
+        ids = np.arange(64)
+        for q in ("last_loss_for", "rms_loss_for", "reputation_for",
+                  "ema_work_for", "latency_for", "times_selected_for",
+                  "last_selected_for", "arrival_rate_for"):
+            a = getattr(dense, q)(ids)
+            b = getattr(sparse, q)(ids)
+            np.testing.assert_array_equal(a, b, err_msg=q)
+
+    def test_pooled_reductions_bit_identical(self):
+        dense, sparse = self._pair()
+        assert dense.population_dropout_mean() \
+            == sparse.population_dropout_mean()
+        assert dense.observed_rms_mean() == sparse.observed_rms_mean()
+        assert dense.observed_latency_median() \
+            == sparse.observed_latency_median()
+        assert dense._reputation_pop_mean() \
+            == sparse._reputation_pop_mean()
+        assert dense.num_touched() == sparse.num_touched()
+
+    def test_untouched_ids_answer_dense_defaults(self):
+        sparse = SparseClientStatsStore(100)
+        sparse.record_loss(3, 1.0)
+        ids = [0, 50, 99]
+        assert np.all(np.isinf(sparse.last_loss_for(ids)))
+        assert np.all(np.isnan(sparse.rms_loss_for(ids)))
+        np.testing.assert_array_equal(sparse.reputation_for(ids),
+                                      np.ones(3))
+        np.testing.assert_array_equal(sparse.ema_work_for(ids), np.ones(3))
+        np.testing.assert_array_equal(sparse.last_selected_for(ids),
+                                      np.full(3, -1))
+        prior = ClientStatsStore(4).dropout_posterior_mean()[0]
+        np.testing.assert_allclose(sparse.dropout_posterior_mean(ids),
+                                   np.full(3, prior))
+
+    @pytest.mark.parametrize("strategy", ["power_of_choice", "oort",
+                                          "reputation"])
+    @pytest.mark.parametrize("pool", [0, 24])
+    def test_selections_bit_identical(self, strategy, pool):
+        """Same observations, same knobs => the SAME cohorts off either
+        backend — the backend is an implementation detail, pool on or
+        off."""
+        dense, sparse = self._pair()
+        args = make_args(client_selection=strategy,
+                         selection_candidate_pool=pool)
+        sd = create_strategy(args, 64, dense)
+        ss = create_strategy(args, 64, sparse)
+        for r in range(1, 6):
+            assert sd.select(r, 8) == ss.select(r, 8), (strategy, pool, r)
+
+    def test_to_dense_roundtrip(self):
+        dense, sparse = self._pair()
+        twin = sparse.to_dense()
+        for f in ClientStatsStore._FIELDS:
+            np.testing.assert_array_equal(getattr(dense, f),
+                                          getattr(twin, f), err_msg=f)
+
+
+# --- sparse persistence ------------------------------------------------------
+
+class TestSparsePersistence:
+    def test_compacted_roundtrip(self):
+        sparse = feed_observations(SparseClientStatsStore(128), n=128)
+        st = sparse.state_dict()
+        # compacted: rows scale with touched clients, not population
+        assert st["ids"].shape[0] == sparse.num_touched() < 128
+        back = SparseClientStatsStore(128)
+        back.load_state_dict(st)
+        ids = np.arange(128)
+        np.testing.assert_array_equal(sparse.rms_loss_for(ids),
+                                      back.rms_loss_for(ids))
+        np.testing.assert_array_equal(sparse.reputation_for(ids),
+                                      back.reputation_for(ids))
+        assert sparse.population_dropout_mean() \
+            == back.population_dropout_mean()
+
+    def test_restores_from_dense_snapshot(self):
+        """The backend-switch story: a checkpoint written by the DENSE
+        store loads into the sparse store, touched rows only."""
+        dense = feed_observations(ClientStatsStore(64), n=64)
+        sparse = SparseClientStatsStore(64)
+        sparse.load_state_dict(dense.state_dict())
+        assert sparse.num_touched() == dense.num_touched()
+        ids = np.arange(64)
+        for q in ("last_loss_for", "rms_loss_for", "reputation_for",
+                  "times_selected_for"):
+            np.testing.assert_array_equal(getattr(dense, q)(ids),
+                                          getattr(sparse, q)(ids),
+                                          err_msg=q)
+        assert dense.population_dropout_mean() \
+            == sparse.population_dropout_mean()
+
+    def test_rejects_out_of_population_and_over_capacity(self):
+        sparse = feed_observations(SparseClientStatsStore(64), n=64)
+        st = sparse.state_dict()
+        with pytest.raises(ValueError, match="outside this population"):
+            SparseClientStatsStore(8).load_state_dict(st)
+        with pytest.raises(ValueError, match="capacity"):
+            SparseClientStatsStore(64, capacity=4).load_state_dict(st)
+
+    def test_crash_resume_through_round_checkpointer(self, tmp_path):
+        """The growing sparse columns ride orbax: a FRESH manager's
+        template has fewer rows than the checkpoint, and the restore
+        must come back with the SAVED rows (this is the orbax behavior
+        the sparse backend depends on — pinned here)."""
+        from fedml_tpu.core.checkpoint import RoundCheckpointer
+        args = make_args(client_selection="oort", selection_store="sparse",
+                         client_num_in_total=256)
+        mgr = SelectionManager(args, 256)
+        assert isinstance(mgr.store, SparseClientStatsStore)
+        feed_observations(mgr.store, n=256, rounds=6)
+        ck = RoundCheckpointer(str(tmp_path / "ck"), every_rounds=1)
+        ck.maybe_save(0, {"selection": mgr.state_dict()})
+        ck.flush()
+        fresh = SelectionManager(args, 256)  # template: zero rows
+        restored = ck.latest({"selection": fresh.state_dict()})
+        assert restored is not None
+        fresh.load_state_dict(restored[1]["selection"])
+        assert fresh.store.num_touched() == mgr.store.num_touched()
+        # identical restored history => identical future cohorts
+        for r in range(6, 10):
+            assert fresh.select(r, 8) == mgr.select(r, 8)
+        ck.close()
+
+    def test_lru_eviction_at_capacity(self):
+        sparse = SparseClientStatsStore(1000, capacity=4)
+        for c in (1, 2, 3, 4):
+            sparse.record_loss(c, float(c))
+        sparse.record_loss(1, 9.0)  # touch 1 again: 2 is now the LRU
+        sparse.record_loss(5, 5.0)  # evicts 2
+        assert sparse.num_touched() == 4
+        assert np.isinf(sparse.last_loss_for([2])[0])  # evicted -> cold
+        assert sparse.last_loss_for([1])[0] == 9.0
+        assert sparse.last_loss_for([5])[0] == 5.0
+
+
+# --- candidate pools + partial top-k ----------------------------------------
+
+class TestCandidatePools:
+    def test_partial_top_k_matches_stable_argsort(self):
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            scores = rng.choice([0.0, 1.0, 2.0, 3.0], size=50)  # many ties
+            k = int(rng.integers(1, 20))
+            full = np.argsort(-scores, kind="stable")[:k]
+            np.testing.assert_array_equal(partial_top_k(scores, k), full)
+
+    def test_pool_size_knobs(self):
+        # small N, auto: no pool (bit-identical legacy path)
+        assert pool_size(make_args(), 64, 8) is None
+        # explicit pool engages at any N, clamped to [k, n]
+        assert pool_size(make_args(selection_candidate_pool=32), 64, 8) \
+            == 32
+        assert pool_size(make_args(selection_candidate_pool=4), 64, 8) == 8
+        assert pool_size(make_args(selection_candidate_pool=999), 64,
+                         8) == 64
+        # auto above the threshold: factor * k
+        args = make_args(selection_pool_threshold=100,
+                         selection_pool_factor=16.0)
+        assert pool_size(args, 1000, 10) == 160
+
+    def test_pooled_oort_touches_only_pool_plus_explore(self):
+        """With a pool of m, one select() reads O(m) ids — pinned by
+        spying on the store's id-parameterized queries."""
+        args = make_args(client_selection="oort",
+                         selection_candidate_pool=32,
+                         client_num_in_total=10_000)
+        store = SparseClientStatsStore(10_000)
+        seen = []
+        orig = store.rms_loss_for
+        store.rms_loss_for = lambda ids: (seen.append(len(np.asarray(ids)))
+                                          or orig(ids))
+        strat = create_strategy(args, 10_000, store)
+        sampled, _ = strat.select(3, 8)
+        assert len(sampled) == len(set(sampled)) == 8
+        assert max(seen) <= 32  # never a full-population read
+
+    def test_poc_honors_pool_threshold(self):
+        """power_of_choice's population-scale draw switch rides the SAME
+        pool knobs as the other strategies: raising
+        selection_pool_threshold above n keeps the legacy rng.choice
+        draw even past the auto threshold."""
+        n = FAST_SAMPLE_MIN_N
+        store = SparseClientStatsStore(n)
+        pinned = create_strategy(
+            make_args(client_selection="power_of_choice",
+                      client_num_in_total=n,
+                      selection_pool_threshold=n * 10), n, store)
+        auto = create_strategy(
+            make_args(client_selection="power_of_choice",
+                      client_num_in_total=n), n, store)
+        rng = np.random.default_rng((42, 101, 3))  # (seed, _TAG_POC, r)
+        legacy_cands = rng.choice(n, 16, replace=False)
+        got, _ = pinned.select(3, 8)
+        assert set(got) <= set(int(c) for c in legacy_cands)
+        # and the auto path (threshold crossed) uses the streaming draw
+        assert auto.select(3, 8) != pinned.select(3, 8)
+
+    def test_pooled_selection_deterministic(self):
+        args = make_args(client_selection="oort",
+                         selection_candidate_pool=64,
+                         client_num_in_total=4096)
+        store = feed_observations(SparseClientStatsStore(4096), n=4096)
+        a = create_strategy(args, 4096, store).select(5, 16)
+        b = create_strategy(args, 4096, store).select(5, 16)
+        assert a == b
+
+    def test_full_pool_equals_legacy_path(self):
+        """m == n: the pooled scorer must pick the same cohort the
+        full-population argsort picks (pool membership is everyone; only
+        the top-k algorithm differs)."""
+        n = 64
+        store = feed_observations(ClientStatsStore(n), n=n)
+        legacy = create_strategy(make_args(client_selection="oort"), n,
+                                 store)
+        pooled = create_strategy(
+            make_args(client_selection="oort", selection_candidate_pool=n),
+            n, store)
+        for r in range(1, 5):
+            ls, _ = legacy.select(r, 8)
+            ps, _ = pooled.select(r, 8)
+            # explore slots ride different candidate ORDERINGS (pool is
+            # a permutation), so compare the exploit sets by utility:
+            # same top utilities selected
+            assert sorted(ls) != [] and len(ps) == len(ls)
+            u_l = legacy._utility_for(r, np.asarray(sorted(ls)))
+            u_p = legacy._utility_for(r, np.asarray(sorted(ps)))
+            np.testing.assert_allclose(np.sort(u_l), np.sort(u_p))
+
+
+# --- streaming sampler fast path ---------------------------------------------
+
+class TestStreamingSampler:
+    def test_small_n_seeded_draws_unchanged(self):
+        """Below the threshold the seeded stream must keep producing the
+        exact generator.choice draws (recorded-schedule compatibility)."""
+        for r in range(4):
+            gen = np.random.default_rng((123, r))
+            ref = [int(c) for c in gen.choice(500, 20, replace=False)]
+            assert client_sampling(r, 500, 20, random_seed=123,
+                                   stream="seeded") == ref
+
+    def test_huge_n_valid_and_deterministic(self):
+        n = FAST_SAMPLE_MIN_N * 4
+        a = client_sampling(2, n, 100, random_seed=9, stream="seeded")
+        b = client_sampling(2, n, 100, random_seed=9, stream="seeded")
+        c = client_sampling(2, n, 100, random_seed=10, stream="seeded")
+        assert a == b and a != c
+        assert len(a) == 100 == len(set(a))
+        assert all(0 <= x < n for x in a)
+
+    def test_floyd_uniformity_and_order(self):
+        """Every id equally likely, and sample ORDER is shuffled (the
+        first slot is not biased toward the tail ids Floyd's loop ends
+        on)."""
+        n, k, trials = 40, 8, 3000
+        counts = np.zeros(n)
+        first = np.zeros(n)
+        gen = np.random.default_rng(0)
+        for _ in range(trials):
+            s = sample_ids_streaming(gen, n, k)
+            assert len(np.unique(s)) == k
+            counts[s] += 1
+            first[s[0]] += 1
+        np.testing.assert_allclose(counts / trials, np.full(n, k / n),
+                                   atol=0.05)
+        np.testing.assert_allclose(first / trials, np.full(n, 1 / n),
+                                   atol=0.02)
+
+    def test_k_geq_n_returns_everyone(self):
+        gen = np.random.default_rng(0)
+        s = sample_ids_streaming(gen, 10, 15)
+        assert sorted(int(c) for c in s) == list(range(10))
+
+
+# --- streaming cohort assembly -----------------------------------------------
+
+def elig_even(ids):
+    return np.asarray(ids) % 2 == 0
+
+
+class TestCohortAssembly:
+    def _assembler(self, n=1000, **kw):
+        args = make_args(client_num_in_total=n, selection_store="sparse",
+                         **kw)
+        store = feed_observations(SparseClientStatsStore(n), n=n)
+        return StreamingCohortAssembler(args, store, n), store, args
+
+    def test_matches_brute_force_top_k(self):
+        asm, store, args = self._assembler(n=500)
+        res = asm.assemble(3, 20, population_chunks(500, chunk=64))
+        brute = np.argsort(-asm._score(3, np.arange(500)),
+                           kind="stable")[:20]
+        assert res.cohort == [int(c) for c in brute]
+        assert res.scanned == 500 and res.eligible == 500
+        assert len(res.cohort) == 20
+
+    def test_chunking_independent(self):
+        """The cohort is a property of (round, population, history) —
+        NOT of how the candidate stream was chunked (the jitter is a
+        per-id hash, not a sequential draw)."""
+        asm, _, _ = self._assembler(n=700)
+        a = asm.assemble(1, 25, population_chunks(700, chunk=13)).cohort
+        b = asm.assemble(1, 25, population_chunks(700, chunk=512)).cohort
+        assert a == b
+
+    def test_eligibility_filters(self):
+        asm, _, _ = self._assembler(n=300)
+        res = asm.assemble(0, 30, population_chunks(300, chunk=50),
+                           eligible_fn=elig_even)
+        assert res.eligible == 150
+        assert all(c % 2 == 0 for c in res.cohort)
+
+    def test_no_eligible_returns_empty(self):
+        asm, _, _ = self._assembler(n=100)
+        res = asm.assemble(0, 10, population_chunks(100),
+                           eligible_fn=lambda ids: np.zeros(len(ids),
+                                                            bool))
+        assert res.cohort == [] and res.eligible == 0
+
+    def test_cold_start_spreads_selection(self):
+        """Cold store: every candidate scores the neutral fill — the
+        seeded jitter must spread the cohort instead of taking the
+        lowest ids."""
+        args = make_args(client_num_in_total=10_000)
+        asm = StreamingCohortAssembler(args,
+                                       SparseClientStatsStore(10_000),
+                                       10_000)
+        res = asm.assemble(0, 50, population_chunks(10_000))
+        assert max(res.cohort) > 1000  # not ids 0..49
+        assert len(set(res.cohort)) == 50
+
+    def test_scoring_knob_validated(self):
+        with pytest.raises(ValueError, match="cohort_scoring"):
+            StreamingCohortAssembler(
+                make_args(cohort_scoring="mystery"),
+                SparseClientStatsStore(10), 10)
+
+
+# --- deadline pacer ----------------------------------------------------------
+
+class TestDeadlinePacer:
+    def test_deterministic_given_history(self):
+        history = [(8, 10, 30.0), (10, 10, 5.0), (3, 10, 60.0),
+                   (10, 10, 4.0), (10, 10, 50.0)]
+        a = DeadlinePacer.from_args(make_args(pacer_deadline_s=40.0))
+        b = DeadlinePacer.from_args(make_args(pacer_deadline_s=40.0))
+        for done, exp, wall in history:
+            a.observe_round(done, exp, wall)
+            b.observe_round(done, exp, wall)
+        assert (a.deadline_s, a.over_sample) == (b.deadline_s,
+                                                 b.over_sample)
+        assert a.rounds_observed == 5
+
+    def test_under_delivery_stretches_over_delivery_tightens(self):
+        p = DeadlinePacer(deadline_s=60.0, over_sample=1.3)
+        p.observe_round(2, 10, 60.0)  # 20% < target 80%
+        assert p.deadline_s > 60.0 and p.over_sample > 1.3
+        d, o = p.deadline_s, p.over_sample
+        p.observe_round(10, 10, 5.0)  # everyone, in a fraction of T
+        assert p.deadline_s < d and p.over_sample < o
+
+    def test_bounds_hold(self):
+        p = DeadlinePacer(deadline_s=60.0, max_deadline_s=100.0,
+                          max_over_sample=2.0, min_deadline_s=10.0)
+        for _ in range(50):
+            p.observe_round(0, 10, 100.0)
+        assert p.deadline_s == 100.0 and p.over_sample == 2.0
+        for _ in range(200):
+            p.observe_round(10, 10, 1.0)
+        assert p.deadline_s >= 10.0 and p.over_sample >= 1.0
+
+    def test_target_cohort_and_state_roundtrip(self):
+        p = DeadlinePacer(over_sample=1.3)
+        assert p.target_cohort(100) == 130
+        assert p.target_cohort(100, ceiling=110) == 110
+        p.observe_round(1, 10, 99.0)
+        q = DeadlinePacer()
+        q.load_state_dict(p.state_dict())
+        assert (q.deadline_s, q.over_sample, q.rounds_observed) \
+            == (p.deadline_s, p.over_sample, p.rounds_observed)
+
+
+# --- store factory -----------------------------------------------------------
+
+class TestStoreFactory:
+    def test_auto_flips_at_threshold(self):
+        args = make_args(selection_sparse_threshold=1000)
+        assert isinstance(make_stats_store(args, 999), ClientStatsStore)
+        assert isinstance(make_stats_store(args, 1000),
+                          SparseClientStatsStore)
+
+    def test_explicit_backends_and_validation(self):
+        assert isinstance(
+            make_stats_store(make_args(selection_store="sparse"), 8),
+            SparseClientStatsStore)
+        assert isinstance(
+            make_stats_store(make_args(selection_store="dense"), 10 ** 6),
+            ClientStatsStore)
+        with pytest.raises(ValueError, match="selection_store"):
+            make_stats_store(make_args(selection_store="csr"), 8)
+
+    def test_manager_rides_sparse_backend(self):
+        args = make_args(client_selection="oort", selection_store="sparse",
+                         client_num_in_total=128)
+        mgr = SelectionManager(args, 128)
+        assert isinstance(mgr.store, SparseClientStatsStore)
+        sampled, excluded = mgr.select(0, 8)
+        assert len(sampled) == 8 and excluded == []
+
+
+# --- SP simulator selection seam (the PR 3/5 gap) ---------------------------
+
+class TestSPSelection:
+    def _run(self, **kw):
+        import fedml_tpu
+        base = dict(client_num_in_total=12, client_num_per_round=4,
+                    comm_round=6, frequency_of_the_test=100)
+        base.update(kw)
+        return fedml_tpu.run_simulation(backend="sp", args=make_args(**base))
+
+    def test_oort_on_sp_records_history(self):
+        import fedml_tpu
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.core.algframe.client_trainer import \
+            ClassificationTrainer
+        from fedml_tpu.optimizers.registry import create_optimizer
+        from fedml_tpu.simulation.sp.simulator import SPSimulator
+        args = make_args(client_num_in_total=12, client_num_per_round=4,
+                         comm_round=6, client_selection="oort",
+                         frequency_of_the_test=100)
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        sim = SPSimulator(args, fed, bundle,
+                          create_optimizer(args, spec), spec)
+        assert sim.selection.track
+        sim.run()
+        st = sim.selection.store
+        assert st.num_touched() > 0
+        assert int(np.sum(st.times_selected_for(np.arange(12)))) == 6 * 4
+
+    def test_sp_crash_resume_replays_selections(self, tmp_path):
+        """Selection history rides the SP checkpoint: a run cut short
+        after round 3 (the SP loop has no chaos plan — truncation IS the
+        crash) must resume into the SAME rounds 4-5 trajectory as the
+        uninterrupted run, which requires replaying identical cohorts."""
+        kw = dict(client_num_in_total=12, client_num_per_round=4,
+                  client_selection="power_of_choice", comm_round=6,
+                  checkpoint_every_rounds=2, frequency_of_the_test=100)
+        a = self._run(checkpoint_dir=str(tmp_path / "a"), **kw)
+        self._run(checkpoint_dir=str(tmp_path / "b"),
+                  **dict(kw, comm_round=4))  # "crashes" after round 3
+        b = self._run(checkpoint_dir=str(tmp_path / "b"), **kw)
+        # identical selection history => a manager rebuilt from either
+        # run selects identical future cohorts
+        import jax
+        for x, y in zip(jax.tree_util.tree_leaves(a["params"]),
+                        jax.tree_util.tree_leaves(b["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_sp_default_has_passive_selection(self):
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.core.algframe.client_trainer import \
+            ClassificationTrainer
+        from fedml_tpu.optimizers.registry import create_optimizer
+        from fedml_tpu.simulation.sp.simulator import SPSimulator
+        args = make_args()
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        spec = ClassificationTrainer(bundle.apply)
+        sim = SPSimulator(args, fed, bundle,
+                          create_optimizer(args, spec), spec)
+        assert not sim.selection.track
+        assert "selection" not in sim._ckpt_state()
+
+
+# --- 1M-client smoke (slow gate) --------------------------------------------
+
+@pytest.mark.slow
+class TestMillionClientSmoke:
+    def test_assemble_and_select_at_1m(self):
+        """1M synthetic devices: sparse store + pooled oort select +
+        one full streaming assembly, all bounded — and selection cost
+        must not scale with the population (the ISSUE 15 acceptance
+        shape, asserted loosely here; the bench records the numbers)."""
+        import time as _time
+        n = 1_000_000
+        args = make_args(client_num_in_total=n, selection_store="sparse",
+                         client_selection="oort",
+                         sampling_stream="seeded")
+        mgr = SelectionManager(args, n)
+        assert isinstance(mgr.store, SparseClientStatsStore)
+        feed_observations(mgr.store, n=n, rounds=4, k=64)
+        t0 = _time.perf_counter()
+        for r in range(3):
+            sampled, _ = mgr.select(r, 128)
+            assert len(sampled) == len(set(sampled)) == 128
+        select_s = (_time.perf_counter() - t0) / 3
+        assert select_s < 1.0, f"pooled select took {select_s:.2f}s at 1M"
+        asm = StreamingCohortAssembler(args, mgr.store, n)
+        t0 = _time.perf_counter()
+        res = asm.assemble(0, 256, population_chunks(n),
+                           eligible_fn=elig_even)
+        wall = _time.perf_counter() - t0
+        assert len(res.cohort) == 256 and res.scanned == n
+        assert all(c % 2 == 0 for c in res.cohort)
+        assert wall < 30.0, f"1M assembly took {wall:.1f}s"
