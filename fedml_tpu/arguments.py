@@ -83,6 +83,21 @@ _SCHEMA: Dict[str, Any] = {
     # auto: feature-sharded (no host materialization) defense whenever the
     # configured defense supports it; false/host forces the host kernels
     "sharded_defense": "auto",
+    # perf knobs (ISSUE 16) — all off by default, off = bit-identical to
+    # the pre-knob programs:
+    # fused conv->GroupNorm->residual->ReLU Pallas kernel for the narrow
+    # (<= 64 channel) ResNet stages; true/pallas = the VMEM-resident
+    # kernel (interpret mode off-TPU), reference = same math via XLA.
+    # A mode STRING (bool coercion would eat "reference"); bools work too
+    "fused_conv_block": "",
+    # fold the [S] client-slot axis into the conv batch axis (FedSGD-style
+    # optimizers that evaluate shared params only); refuses configs that
+    # need per-client updates (robust/DP/tracking selection)
+    "client_slot_fold": False,
+    # quantize the fused robust path's all_to_all re-layout rows across
+    # the mesh: int8 (per-row scales, ~4x fewer re-layout wire bytes) or
+    # bf16 (~2x); None keeps the dense f32 re-layout byte-identical
+    "robust_relayout_quant": None,
     # donate params/server_state/client_states buffers to the round
     # programs (outputs replace them 1:1) — halves model-state HBM peak;
     # off-switch for debugging aliasing suspicions only

@@ -118,16 +118,18 @@ def effective_steps(cdata: ClientData, epochs: int,
     return jnp.maximum(jnp.ceil(epochs * real_batches * work_scale), 1.0)
 
 
-def full_batch_grad(
+def full_batch_grad_sum(
     spec: TrainerSpec,
     params: PyTree,
     cdata: ClientData,
     rng: jax.Array,
 ) -> Tuple[PyTree, Dict[str, jnp.ndarray]]:
-    """Masked full-dataset gradient of the loss at ``params`` — the per-batch
-    mean gradients are re-weighted by real-sample count so the result equals
-    the gradient of the mean loss over all real samples. Used by FedSGD and
-    Mime's server-statistics update."""
+    """Masked SUM of per-sample gradients of the loss at ``params`` (the
+    un-normalized numerator of :func:`full_batch_grad`): per-batch mean
+    gradients re-weighted by real-sample count and summed. This is the
+    quantity that is exactly additive across clients, which is what lets
+    the engine's client-slot batch folding replace S per-client passes
+    with one S-times-wider pass (ISSUE 16)."""
 
     def body(carry, inp):
         i, batch = inp
@@ -152,6 +154,20 @@ def full_batch_grad(
         body, (zero_g, zero_m),
         (jnp.arange(cdata.x.shape[0]),
          {"x": cdata.x, "y": cdata.y, "mask": cdata.mask}))
+    return acc_g, metrics
+
+
+def full_batch_grad(
+    spec: TrainerSpec,
+    params: PyTree,
+    cdata: ClientData,
+    rng: jax.Array,
+) -> Tuple[PyTree, Dict[str, jnp.ndarray]]:
+    """Masked full-dataset gradient of the loss at ``params`` — the per-batch
+    mean gradients are re-weighted by real-sample count so the result equals
+    the gradient of the mean loss over all real samples. Used by FedSGD and
+    Mime's server-statistics update."""
+    acc_g, metrics = full_batch_grad_sum(spec, params, cdata, rng)
     denom = jnp.maximum(metrics["count"], 1.0)
     grads = jax.tree_util.tree_map(
         lambda g: g / denom.astype(g.dtype), acc_g)

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the simulation compute plane.
+
+The LLM stack keeps its kernels next to its models (``llm/attention.py``);
+this package holds the kernels the FL simulator's CV models dispatch to —
+starting with the fused conv->GroupNorm->residual->ReLU block that kills
+the flagship's memory-bound elementwise stream (ISSUE 16).
+"""
+
+from .conv_block import fused_block, reference_block  # noqa: F401

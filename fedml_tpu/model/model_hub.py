@@ -53,6 +53,26 @@ class ModelBundle:
         return out.astype(jnp.float32)
 
 
+def _fused_conv_mode(args) -> str:
+    """``fused_conv_block`` knob -> BasicBlock ``fused`` mode. Off (the
+    default) keeps the original flax path, bit-compatible with every run
+    before the knob existed; true/pallas dispatches the VMEM-resident
+    Pallas kernel (interpret mode off-TPU); reference/xla runs the same
+    fused math through plain XLA (the kernel's numerical golden)."""
+    v = getattr(args, "fused_conv_block", None)
+    if v is None or v is False:
+        return ""
+    s = str(v).lower()
+    if s in ("", "false", "0", "no", "none", "off"):
+        return ""
+    if s in ("true", "1", "yes", "on", "pallas"):
+        return "pallas"
+    if s in ("reference", "xla"):
+        return "reference"
+    raise ValueError(
+        f"unknown fused_conv_block mode {v!r} (false|true|pallas|reference)")
+
+
 def _compute_dtype(args):
     p = str(getattr(args, "precision", "float32") or "float32").lower()
     if p in ("bf16", "bfloat16", "mixed", "mixed_bfloat16"):
@@ -106,7 +126,9 @@ def _create(args, output_dim: int):
         return ModelBundle(LendingClubMLP(output_dim), name)
     if name.startswith("resnet"):
         from .cv.resnet import create_resnet
-        return ModelBundle(create_resnet(name, output_dim), name)
+        return ModelBundle(
+            create_resnet(name, output_dim, fused=_fused_conv_mode(args)),
+            name)
     if name in ("rnn", "lstm", "rnn_shakespeare", "stacked_lstm"):
         dataset = str(getattr(args, "dataset", "")).lower()
         if "stackoverflow" in dataset:

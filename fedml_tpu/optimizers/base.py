@@ -33,6 +33,13 @@ class FedOptimizer:
 
     name = "FedAvg"
     has_client_state = False
+    # True only for optimizers whose client pass evaluates the SHARED
+    # global params with no per-client trajectory (FedSGD): the engine may
+    # then fold the [S] client-slot axis into the batch axis
+    # (``client_slot_fold``) because the weighted update sum is exactly
+    # additive over samples. Local-SGD optimizers iterate per-client
+    # params and can never fold.
+    folds_client_slots = False
 
     def __init__(self, args, spec: TrainerSpec):
         self.args = args

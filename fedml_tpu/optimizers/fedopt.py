@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..core.algframe.local_training import full_batch_grad
+from ..core.algframe.local_training import (full_batch_grad,
+                                            full_batch_grad_sum)
 from ..core.algframe.types import ClientOutput
 from .base import FedOptimizer, PyTree
 from .registry import register
@@ -91,6 +92,11 @@ class FedSGD(FedOptimizer):
     (``FedML_FEDERATED_OPTIMIZER_FEDSGD``, ``constants.py:59``)."""
 
     name = "FedSGD"
+    # every client's gradient is taken at the SAME global params, and the
+    # engine aggregate Σ_k n_k·upd_k = -Σ over all reporting samples g_i
+    # is additive over samples — so the [S] client-slot axis may fold
+    # into the batch axis (ISSUE 16 client_slot_fold)
+    folds_client_slots = True
 
     def __init__(self, args, spec):
         super().__init__(args, spec)
@@ -104,6 +110,18 @@ class FedSGD(FedOptimizer):
                             weight=cdata.num_samples.astype(jnp.float32),
                             client_state=client_state, extras={},
                             metrics=metrics)
+
+    def local_train_folded(self, global_params, folded_cdata, rng
+                           ) -> Tuple[PyTree, Dict[str, Any]]:
+        """One pass over a CLIENT-FOLDED batch (the engine reshapes the
+        [S] slot axis into the batch axis): returns the weight-scaled
+        update SUM ``-Σ_i g_i`` plus the summed metrics — exactly what the
+        slot scan's ``Σ_k w_k·upd_k`` accumulator would hold, computed
+        with S-times-larger per-op batches."""
+        grad_sum, metrics = full_batch_grad_sum(
+            self.spec, global_params, folded_cdata, rng)
+        update_sum = jax.tree_util.tree_map(lambda g: -g, grad_sum)
+        return update_sum, metrics
 
     def server_update(self, params, server_state, agg_update, agg_extras,
                       round_idx):

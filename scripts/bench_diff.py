@@ -53,6 +53,12 @@ from typing import Dict, List, Optional, Tuple
 # (cross_device_cohort_assembly_ms and its assembly_ms/select_*_ms
 # legs), `overhead` the 1M-vs-10k scaling ratios — both drive DOWN
 # (selection must stay sublinear in population).
+# fused-kernel additions (ISSUE 16): fedavg_resnet56_fused_block_step_ms
+# and its reference_ms/fused_ms legs ride the `_ms` marker (drive the
+# fused step DOWN), its speedup leg the `speedup` marker (UP); the
+# weak-scaling bench's new d{k}_int8 quantized-re-layout legs reuse
+# `efficiency` (UP) and collective_wire_bytes_per_round's `bytes`
+# marker (DOWN — the quantized all_to_all must shrink the wire).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
                   "goodput", "success", "hit_rate", "reused",
                   "efficiency", "swaps", "attributed")

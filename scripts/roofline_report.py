@@ -185,10 +185,16 @@ def print_recompiles(recompiles: List[dict], out=None) -> None:
 
 def print_compare(old: Dict[str, dict], new: Dict[str, dict],
                   out=None) -> None:
+    """Programs present in only one capture are legitimate (a new fused
+    kernel appears only in "after"; a host-path program disappears when a
+    knob fuses it away) — they are reported as added/removed rows rather
+    than silently dropped or KeyError'd."""
     out = out if out is not None else sys.stdout
     common = [p for p in old if p in new]
-    if not common:
-        print("no common programs between the two runs", file=out)
+    added = [p for p in new if p not in old]
+    removed = [p for p in old if p not in new]
+    if not common and not added and not removed:
+        print("no programs in either run", file=out)
         return
     print(f"{'program':<24} {'field':<26} {'old':>12} {'new':>12} "
           f"{'delta':>9}", file=out)
@@ -210,6 +216,20 @@ def print_compare(old: Dict[str, dict], new: Dict[str, dict],
                 delta = "-"
             print(f"{prog:<24} {fname:<26} {fmt(ov):>12} {fmt(nv):>12} "
                   f"{delta:>9}", file=out)
+    for progs, rec_of, tag in ((added, new, "added"),
+                               (removed, old, "removed")):
+        for prog in progs:
+            rec = rec_of[prog]
+            for fname, fmt in fields:
+                v = rec.get(fname)
+                if v is None:
+                    continue
+                ov = "-" if tag == "added" else fmt(v)
+                nv = fmt(v) if tag == "added" else "-"
+                print(f"{prog:<24} {fname + ' [' + tag + ']':<26} "
+                      f"{ov:>12} {nv:>12} {'-':>9}", file=out)
+    if not common:
+        print("(no common programs between the two runs)", file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,157 @@
+"""Client-slot batch folding (ISSUE 16 tentpole part 2).
+
+``client_slot_fold: true`` folds the [S] schedule-slot axis into the
+batch axis for optimizers whose aggregate is sample-additive at shared
+params (FedSGD): one big-batch pass replaces the slot scan, so every
+conv/matmul in the round sees an S-times-larger batch. Exactness is the
+contract — parity with the scan path up to float summation order — and
+configs that CANNOT fold (per-client trajectories, robust stack, DP,
+per-slot selection metrics) must refuse loudly, not silently degrade.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.algframe.types import TrainHyper
+
+
+def sim_args(**kw):
+    base = dict(dataset="synthetic_mnist", model="lr",
+                federated_optimizer="fedsgd", server_lr=0.5,
+                client_num_in_total=8, client_num_per_round=8,
+                comm_round=4, epochs=1, batch_size=32, learning_rate=0.1,
+                frequency_of_the_test=10_000, random_seed=5)
+    base.update(kw)
+    return Arguments(**base)
+
+
+def build_sim(args):
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.core.algframe.client_trainer import ClassificationTrainer
+    from fedml_tpu.optimizers.registry import create_optimizer
+    from fedml_tpu.simulation.tpu.engine import TPUSimulator
+
+    fed, output_dim = data_mod.load(args)
+    bundle = model_mod.create(args, output_dim)
+    spec = ClassificationTrainer(bundle.apply)
+    return TPUSimulator(args, fed, bundle, create_optimizer(args, spec),
+                        spec)
+
+
+def hyper_for(args):
+    return TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                      epochs=int(args.epochs))
+
+
+def assert_params_close(a, b, rtol=1e-5, atol=1e-6):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=rtol, atol=atol)
+
+
+class TestFoldParity:
+    def test_fedsgd_round_parity(self):
+        """Folded big-batch pass == slot scan, round for round."""
+        scan = build_sim(sim_args())
+        fold = build_sim(sim_args(client_slot_fold=True))
+        assert not scan._slot_fold and fold._slot_fold
+        hyper = hyper_for(sim_args())
+        for r in range(3):
+            scan.run_round(r, hyper)
+            fold.run_round(r, hyper)
+        assert_params_close(scan.params, fold.params)
+
+    def test_fold_rides_fused_blocks_single_dispatch(self):
+        """The folded core slots into the multi-round fused dispatch
+        unchanged: one dispatch, same params as the scan-path block."""
+        hyper = hyper_for(sim_args())
+        scan = build_sim(sim_args())
+        fold = build_sim(sim_args(client_slot_fold=True))
+        scan.run_rounds_fused(0, 4, hyper)
+        fold.run_rounds_fused(0, 4, hyper)
+        assert fold.dispatch_stats["dispatches"] == 1
+        assert_params_close(scan.params, fold.params)
+
+    def test_fold_parity_under_chaos_dropout(self):
+        """Slot masking becomes sample masking: a dropped client's rows
+        zero out of the folded sums exactly as the scan's report gate
+        zeroed its slot — chaos runs must stay in parity too."""
+        kw = dict(chaos_dropout_prob=0.3, chaos_seed=11, comm_round=3)
+        scan = build_sim(sim_args(**kw))
+        fold = build_sim(sim_args(client_slot_fold=True, **kw))
+        hyper = hyper_for(sim_args(**kw))
+        for r in range(3):
+            scan.run_round(r, hyper)
+            fold.run_round(r, hyper)
+        assert_params_close(scan.params, fold.params)
+
+    def test_fold_parity_with_partial_participation(self):
+        """Subsampled cohorts exercise the inactive padding slots of the
+        canonical schedule width — they must vanish from the folded sums."""
+        kw = dict(client_num_in_total=16, client_num_per_round=8)
+        scan = build_sim(sim_args(**kw))
+        fold = build_sim(sim_args(client_slot_fold=True, **kw))
+        hyper = hyper_for(sim_args(**kw))
+        scan.run_rounds_fused(0, 4, hyper)
+        fold.run_rounds_fused(0, 4, hyper)
+        assert_params_close(scan.params, fold.params)
+
+    def test_fold_compiles_once(self, xla_compile_counter):
+        args = sim_args(client_slot_fold=True, comm_round=12)
+        sim = build_sim(args)
+        hyper = hyper_for(args)
+        sim.run_rounds_fused(0, 4, hyper)
+        xla_compile_counter.reset()
+        sim.run_rounds_fused(4, 4, hyper)
+        sim.run_rounds_fused(8, 4, hyper)
+        assert xla_compile_counter.delta() == 0
+
+
+class TestFoldRefusals:
+    """Loud refusal, not silent fallback: the measured mode must be the
+    requested mode."""
+
+    def test_off_strings_stay_off(self):
+        for knob in (False, "false", "0"):
+            sim = build_sim(sim_args(client_slot_fold=knob))
+            assert not sim._slot_fold
+
+    def test_refuses_per_client_trajectory_optimizer(self):
+        """FedAvg runs local SGD trajectories — folding would change the
+        algorithm, not just the layout."""
+        with pytest.raises(ValueError, match="client_slot_fold"):
+            build_sim(sim_args(federated_optimizer="fedavg",
+                               client_slot_fold=True))
+
+    def test_refuses_robust_mode(self):
+        with pytest.raises(ValueError, match="robust"):
+            build_sim(sim_args(client_slot_fold=True, enable_defense=True,
+                               defense_type="rfa"))
+
+    def test_refuses_local_dp(self):
+        with pytest.raises(ValueError, match="DP"):
+            build_sim(sim_args(client_slot_fold=True, enable_dp=True,
+                               dp_type="local_dp", dp_epsilon=8.0))
+
+    def test_refuses_tracking_selection(self):
+        """Reputation-style selection consumes per-slot metrics, which a
+        folded pass cannot produce."""
+        with pytest.raises(ValueError, match="selection"):
+            build_sim(sim_args(client_slot_fold=True,
+                               client_num_in_total=16,
+                               client_num_per_round=8,
+                               client_selection="oort"))
+
+    def test_refusal_lists_every_reason(self):
+        """A multi-way-unfoldable config names ALL its blockers in one
+        error, so the user fixes the config once."""
+        with pytest.raises(ValueError) as ei:
+            build_sim(sim_args(federated_optimizer="fedavg",
+                               client_slot_fold=True, enable_defense=True,
+                               defense_type="rfa"))
+        msg = str(ei.value)
+        assert "folds_client_slots" in msg and "robust" in msg
