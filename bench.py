@@ -1635,6 +1635,239 @@ def bench_llm_serving_chaos(concurrency=8, requests=24, max_new=12):
     }), flush=True)
 
 
+def bench_llm_serving_fleet(replicas=3, tenants=8, sessions=32, turns=3,
+                            max_new=16, concurrency=256):
+    """Fleet serving soak (ISSUE 17): aggregate tokens/s on a sustained
+    mixed-tenant multi-turn workload (the seeded ``scripts/serving_load``
+    generator, c256) across 3+ in-process replicas behind the Gateway,
+    with seeded chaos connection drops on the gateway wire and one
+    deliberate replica loss mid-soak. ON = cache-aware routing +
+    generated-token suffix caching + SLO autoscaler; OFF = the PR-16
+    fleet (round-robin routing, prompt-only prefix cache, same chaos,
+    same loss). Same model, same seeded workload — the delta is the
+    fleet layer. Gate: >=1.3x aggregate tokens/s or >=1.5x mean-TTFT
+    reduction, nonzero suffix hits, 0 steady-state recompiles during the
+    fixed-fleet window (the post-loss replacement/scale-up is a cold
+    start by definition and is reported separately)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core import mlops
+    from fedml_tpu.core.chaos import (FaultLedger, FaultPlan,
+                                      ServingChaosInjector)
+    from fedml_tpu.llm.federated import build_llm
+    from fedml_tpu.serving.autoscale import (Autoscaler, EWMPolicy,
+                                             Gateway, ReplicaSet, SLOPolicy)
+    from fedml_tpu.serving.llm_template import (CausalLMPredictor,
+                                                ChatCompletionRunner)
+    from scripts.serving_load import LoadSpec, run_load
+
+    args = Arguments(
+        dataset="llm_synthetic", model="causal_lm",
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        epochs=1, batch_size=4, learning_rate=1e-3, random_seed=0,
+        llm_hidden_size=128, llm_num_layers=2, llm_num_heads=4,
+        llm_intermediate_size=352, llm_max_seq_len=1024, lora_rank=8)
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    # turn_chars=200: every user turn carries ~200 chars of
+    # per-session-unique text (pasted-log traffic), so beyond the shared
+    # per-tenant system prompt nothing aliases ACROSS sessions — turn-2/3
+    # prefill is paid in full unless the follow-up lands on the replica
+    # that served turn 1 (cache-aware routing) and the reply blocks were
+    # indexed at release (suffix cache)
+    spec = LoadSpec(tenants=tenants, sessions_per_tenant=sessions,
+                    turns_per_session=turns, seed=0, mean_gap_s=0.002,
+                    max_tokens=max_new, turn_chars=200)
+    total_requests = spec.total_requests
+
+    mlops.install_compile_counter()
+    legs = {}
+    for tag, fleet_on in (("fleet_off", False), ("fleet_on", True)):
+        ledger = FaultLedger()
+        chaos = ServingChaosInjector(
+            FaultPlan(seed=17, serving_conn_drop_prob=0.04), ledger=ledger)
+        # num_blocks: grow the KV pool past the slot default (slots x
+        # max_blocks_per_slot = 1024) so per-session conversation chains
+        # survive cascade eviction across 256 concurrent sessions; same
+        # pool both legs — the delta stays the fleet layer, not memory
+        opts = {"slots": 16, "block_size": 16, "prefill_chunk": 64,
+                "prefix_cache": True, "prefill_batch": 8,
+                "request_timeout_s": 600.0, "num_blocks": 8192,
+                "suffix_cache": fleet_on}
+
+        def factory(opts=opts):
+            return CausalLMPredictor(bundle, params, tokenizer=tok,
+                                     mode="batch", stream=True,
+                                     batch_opts=dict(opts))
+
+        rs = ReplicaSet(predictor_factory=factory, min_replicas=replicas,
+                        max_replicas=replicas + 1,
+                        runner_cls=ChatCompletionRunner,
+                        drain_grace_s=2.0 if fleet_on else 0.0)
+        gw = Gateway(rs, unhealthy_ttl_s=0.75, max_failovers=4,
+                     backoff_seed=0, chaos=chaos,
+                     cache_aware=fleet_on, heal_probe=fleet_on)
+        # ON: the SLO policy may add the +1 burst replica under queue /
+        # headroom breach. OFF: the PR-16 loop — health_check still
+        # replaces the lost replica (both legs heal), but the legacy
+        # policy never scales past min_replicas under this traffic.
+        policy = (SLOPolicy(queue_depth_per_replica=32.0,
+                            kv_headroom_min=1, cooldown_s=3.0)
+                  if fleet_on
+                  else EWMPolicy(target_qps_per_replica=1e9))
+        asc = Autoscaler(gw, policy, interval_s=0.25)
+        lock = threading.Lock()
+        ttfts, tokens, oks = [], [], []
+        post_loss_mark = [None]     # index into oks at the loss instant
+        steady_recompiles = [None]
+        done = threading.Event()
+
+        def send(messages, meta):
+            req = {"messages": messages, "stream": True,
+                   "max_tokens": int(meta["max_tokens"]),
+                   "temperature": float(meta["temperature"]),
+                   "seed": int(meta["seed"])}
+            t0 = time.perf_counter()
+            first, parts, usage = None, [], None
+            try:
+                for data in gw.stream(req, timeout=600.0):
+                    evt = json.loads(data)
+                    ch = evt["choices"][0]
+                    delta = ch.get("delta") or {}
+                    if delta.get("content"):
+                        if first is None:
+                            first = time.perf_counter() - t0
+                        parts.append(delta["content"])
+                    if ch.get("finish_reason"):
+                        usage = ch.get("usage") or {}
+            except Exception:
+                with lock:
+                    oks.append(False)
+                raise
+            with lock:
+                oks.append(True)
+                if first is not None:
+                    ttfts.append(first)
+                tokens.append(int((usage or {}).get(
+                    "completion_tokens", len(parts))))
+            return "".join(parts)
+
+        def disrupt():
+            # wait out the fixed-fleet (steady-state) window, snapshot
+            # the recompile count, then lose a replica and hand the
+            # fleet to the SLO autoscaler for the rest of the soak
+            while not done.is_set():
+                with lock:
+                    n = len(oks)
+                if n >= int(0.4 * total_requests):
+                    break
+                time.sleep(0.05)
+            if done.is_set():
+                return
+            steady_recompiles[0] = mlops.compile_count() - compiles0
+            with rs._lock:
+                victim = rs.replicas[-1] if rs.replicas else None
+            if victim is not None:
+                victim.stop()           # replica loss, mid-soak
+            with lock:
+                post_loss_mark[0] = len(oks)
+            while not done.is_set():
+                try:
+                    asc.step()   # heal + replace + SLO scale
+                except Exception:
+                    pass
+                done.wait(0.3)
+
+        try:
+            # warm every replica: compiles prefill/wave/COW/decode/sample
+            # and seeds each prefix index with nothing the soak measures
+            with rs._lock:
+                runners = list(rs.replicas)
+            import concurrent.futures as cf
+            for r in runners:
+                r.predictor.generate("fleet warmup", max_new_tokens=2)
+                with cf.ThreadPoolExecutor(8) as ex:
+                    list(ex.map(
+                        lambda i, p=r.predictor: p.generate(
+                            f"fleet warm turn {i}", max_new_tokens=2),
+                        range(8)))
+            compiles0 = mlops.compile_count()
+            watcher = threading.Thread(target=disrupt, daemon=True)
+            watcher.start()
+            t0 = time.perf_counter()
+            run_load(send, spec, concurrency=concurrency)
+            wall = time.perf_counter() - t0
+            done.set()
+            watcher.join(timeout=10.0)
+
+            with rs._lock:
+                engines = [r.predictor.engine for r in rs.replicas
+                           if getattr(r, "predictor", None) is not None
+                           and r.predictor.engine is not None]
+            sfx_hits = sfx_tokens = hits = misses = 0
+            for eng in engines:
+                idx = getattr(eng.scheduler, "_index", None)
+                if idx is None:
+                    continue
+                sfx_hits += idx.suffix_hits
+                sfx_tokens += idx.suffix_tokens_reused
+                hits += idx.hits
+                misses += idx.misses
+            mark = post_loss_mark[0]
+            with lock:
+                n_ok = sum(oks)
+                post = oks[mark:] if mark is not None else []
+                ttft_sorted = sorted(ttfts)
+                total_tokens = sum(tokens)
+            leg = {
+                "tokens_per_s": round(total_tokens / wall, 1),
+                "ttft_mean_s": round(
+                    sum(ttft_sorted) / max(len(ttft_sorted), 1), 4),
+                "ttft_p99_s": round(
+                    ttft_sorted[min(len(ttft_sorted) - 1,
+                                    int(0.99 * (len(ttft_sorted) - 1)
+                                        + 0.5))], 4) if ttft_sorted
+                else 0.0,
+                "success_rate": round(n_ok / max(len(oks), 1), 3),
+                "post_loss_success_rate": round(
+                    sum(post) / max(len(post), 1), 3),
+                "suffix_hits": int(sfx_hits),
+                "suffix_tokens_reused": int(sfx_tokens),
+                "prefix_hit_rate": round(
+                    hits / max(hits + misses, 1), 3),
+                "steady_state_recompiles": steady_recompiles[0],
+                "cold_start_compiles": mlops.compile_count() - compiles0
+                - (steady_recompiles[0] or 0),
+                "scale_events": int(asc.scale_events),
+                "injected_conn_drops": len(ledger.serving_events()),
+                "replicas_end": len(rs),
+                "routes": dict(gw.route_counts),
+            }
+            legs[tag] = leg
+        finally:
+            done.set()
+            rs.stop()
+
+    on, off = legs["fleet_on"], legs["fleet_off"]
+    ratio = on["tokens_per_s"] / max(off["tokens_per_s"], 1e-9)
+    ttft_ratio = off["ttft_mean_s"] / max(on["ttft_mean_s"], 1e-9)
+    print(json.dumps({
+        "metric": "llm_serving_fleet_tokens_per_s",
+        "value": on["tokens_per_s"],
+        "unit": f"aggregate tokens/s (c{concurrency}, {tenants} tenants x "
+                f"{sessions} sessions x {turns} turns, {replicas} "
+                f"replicas, chaos conn-drops + mid-soak replica loss, "
+                f"{jax.default_backend()})",
+        "vs_baseline": round(ratio, 2),
+        "ttft_reduction": round(ttft_ratio, 2),
+        "legs": legs,
+    }), flush=True)
+
+
 def bench_llm_serving_adapter_churn(concurrency=64, rounds=4, max_new=12,
                                     bank_size=8):
     """Sustained adapter churn (ISSUE 14 satellite, the ROADMAP's
@@ -2047,6 +2280,7 @@ def run():
              bench_llm_serving_adapter_churn),
             ("llm_serving_ttft", bench_llm_serving_ttft),
             ("llm_serving_chaos_goodput", bench_llm_serving_chaos),
+            ("llm_serving_fleet_tokens_per_s", bench_llm_serving_fleet),
             ("llm_train_step_mfu", bench_llm_mfu),
             ("llm_long_context_train_tokens_per_s", bench_long_context),
             ("llm_long_context_train_tokens_per_s_seq8192",

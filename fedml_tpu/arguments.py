@@ -267,6 +267,34 @@ _SCHEMA: Dict[str, Any] = {
     # the adapter version they started with
     "llm_adapter_watch_s": 0.0,
     "llm_adapter_dir": None,           # adapter-bank manifest dir to serve
+    # fleet-serving levers (ISSUE 17) — ALL off by default: wire bytes
+    # and decode tokens stay bit-identical to the pre-ISSUE-17 path.
+    # generated-token suffix caching (RadixAttention-style): index full
+    # decode blocks into the prefix index at slot release under the same
+    # refcount/COW discipline as prompt blocks, so a requeued or
+    # follow-up request (prior prompt + generated reply + new user turn)
+    # aliases the whole conversation prefix instead of re-prefilling
+    # tokens the engine itself produced. Implies the prefix index.
+    "llm_suffix_cache": False,
+    # cache-aware gateway routing: hash each request's leading prompt
+    # bytes (~ leading token blocks under the byte tokenizer) into a
+    # routing digest and stick same-digest traffic to the replica whose
+    # prefix cache is warm, with KV-headroom-aware spill to round-robin
+    # when the warm replica is saturated
+    "serving_cache_aware_routing": False,
+    # serving_slo_* — SLO-driven autoscaling (SLOPolicy): close the loop
+    # from the serving SLO instruments (TTFT/ITL percentiles, queue
+    # depth, KV headroom scraped from each replica's /healthz) to
+    # ReplicaSet scaling. Targets of 0 disable that signal; with both
+    # latency targets 0 the policy never scales on latency.
+    "serving_slo_ttft_p99_s": 0.0,     # scale up while p99 TTFT exceeds
+    "serving_slo_itl_p99_s": 0.0,      # scale up while p99 ITL exceeds
+    "serving_slo_queue_per_replica": 4.0,  # queue-depth bound per replica
+    "serving_slo_kv_headroom_min": 1,  # min KV admission headroom (reqs)
+    "serving_slo_cooldown_s": 5.0,     # min seconds between scale moves
+    # drain-before-kill on scale-down: give the victim replica this long
+    # to finish in-flight streams before stop (0 = legacy immediate stop)
+    "serving_drain_grace_s": 0.0,
     # federated-LoRA adapter export: after run_federated_llm, write the
     # global + per-silo personalized adapters as named artifacts the
     # serving adapter bank loads (None = off)

@@ -619,6 +619,28 @@ def record_llm_prefix_cache(cached_tokens: int, novel_tokens: int) -> None:
                          int(novel_tokens))
 
 
+def record_llm_suffix_cache(reused_tokens: int) -> None:
+    """Suffix-cache admission outcome: generated (decode-origin) tokens
+    a follow-up/requeued request aliased instead of re-prefilling."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("llm_suffix_hits_total",
+                     "admissions that aliased generated-token "
+                     "(decode-origin) cached blocks").inc(1)
+    REGISTRY.counter("llm_suffix_reused_tokens_total",
+                     "generated tokens served from cached KV blocks "
+                     "(never re-prefilled)").inc(int(reused_tokens))
+
+
+def record_llm_suffix_insert(blocks: int) -> None:
+    """Decode blocks indexed into the prefix cache at slot release."""
+    if not _cfg["enabled"] or not blocks:
+        return
+    REGISTRY.counter("llm_suffix_inserted_blocks_total",
+                     "generated-token KV blocks indexed at release").inc(
+                         int(blocks))
+
+
 def record_llm_prefix_evictions(n: int) -> None:
     """Cached prefix blocks evicted under KV pool pressure."""
     if not _cfg["enabled"] or not n:
@@ -704,6 +726,40 @@ def record_gateway_failover(reason: str) -> None:
     REGISTRY.counter("serving_gateway_failovers_total",
                      "requests re-routed off a failed/unhealthy replica",
                      labels=("reason",)).inc(1, reason=str(reason))
+
+
+def record_gateway_route(outcome: str) -> None:
+    """Cache-aware routing decision: ``warm_hit`` (digest stuck to its
+    warm replica), ``warm_spill`` (warm replica saturated — spilled to
+    round-robin without rehoming), ``cold`` (first sight of the digest,
+    round-robin pick recorded as the digest's home)."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("serving_gateway_routes_total",
+                     "cache-aware routing decisions by outcome",
+                     labels=("outcome",)).inc(1, outcome=str(outcome))
+
+
+def record_gateway_heal(port: int) -> None:
+    """A quarantined replica passed its recovery probe and rejoined the
+    rotation."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("serving_gateway_heals_total",
+                     "quarantined replicas healed back into "
+                     "rotation").inc(1)
+
+
+def record_fleet_scale(direction: str, replicas: int) -> None:
+    """One SLO-driven autoscaler move (``up`` / ``down``) landing on
+    ``replicas`` replicas."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("serving_fleet_scale_events_total",
+                     "autoscaler replica-count changes",
+                     labels=("direction",)).inc(1, direction=str(direction))
+    REGISTRY.gauge("serving_fleet_replicas",
+                   "current serving replica count").set(int(replicas))
 
 
 def record_watchdog_trip(component: str, reason: str) -> None:

@@ -42,6 +42,29 @@ class ByteTokenizer:
                      if i >= SPECIAL_TOKENS).decode("utf-8", "replace")
 
 
+class RoundTripByteTokenizer(ByteTokenizer):
+    """Round-trip-exact variant: ``encode(decode(ids)) == ids`` for every
+    byte-token sequence, including invalid UTF-8. ``decode`` maps
+    undecodable bytes to lone surrogates (``surrogateescape``) instead of
+    U+FFFD, and ``encode`` inverts them back to the original bytes; valid
+    UTF-8 text encodes identically to :class:`ByteTokenizer`. Lone
+    surrogates survive the JSON wire because ``json.dumps`` (default
+    ``ensure_ascii=True``) escapes them to ``\\udcXX`` and ``json.loads``
+    restores them. The suffix-cache chat surface needs this exactness:
+    a follow-up request re-encodes the assistant reply it was served, and
+    the re-encoded ids must equal the generated ids for the stored
+    decode-origin KV blocks to alias."""
+
+    def encode(self, text: str) -> List[int]:
+        return [b + SPECIAL_TOKENS
+                for b in text.encode("utf-8", "surrogateescape")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i - SPECIAL_TOKENS for i in ids
+                     if i >= SPECIAL_TOKENS).decode("utf-8",
+                                                    "surrogateescape")
+
+
 def synthetic_instruction_corpus(n: int, seed: int = 0
                                  ) -> List[Dict[str, str]]:
     """Deterministic toy instruction/response pairs (arithmetic, echo,

@@ -86,6 +86,89 @@ class LookbackPolicy:
         return desired
 
 
+@dataclasses.dataclass
+class FleetSLOView:
+    """One autoscaler step's aggregated fleet scrape: worst-replica
+    TTFT/ITL p99 from each replica's ``/healthz`` ``slo`` payload (exact
+    trailing-window percentiles, not bucketed exposition), summed queue
+    depth, the MINIMUM KV admission headroom across replicas (the
+    replica that will shed first), and the gateway's own p99."""
+    ttft_p99_s: float = 0.0
+    itl_p99_s: float = 0.0
+    queue_depth: int = 0
+    kv_headroom_min: Optional[int] = None    # None = no replica reported
+    gateway_p99_s: float = 0.0
+    replicas: int = 0
+
+
+@dataclasses.dataclass
+class SLOPolicy:
+    """SLO-driven autoscaling (ISSUE 17): close the loop from the
+    serving SLO instruments to replica count. Scale UP one replica while
+    any enabled target is breached — p99 TTFT / p99 ITL over target,
+    fleet queue depth past ``queue_depth_per_replica * current``, or
+    minimum KV admission headroom under ``kv_headroom_min`` (the
+    saturation signal: a replica about to shed). Scale DOWN one replica
+    only when every enabled tail sits under ``scale_down_idle_factor``
+    of its target AND the fleet queue is empty. ``cooldown_s`` gates
+    consecutive moves so one burst cannot staircase the fleet. Targets
+    of 0 disable that signal."""
+    ttft_p99_s: float = 0.0
+    itl_p99_s: float = 0.0
+    queue_depth_per_replica: float = 4.0
+    kv_headroom_min: int = 1
+    scale_down_idle_factor: float = 0.3
+    cooldown_s: float = 5.0
+    latency_signal: str = "p99"
+    _last_scale_ts: float = dataclasses.field(default=0.0, repr=False)
+
+    def breaches(self, fleet: FleetSLOView, current: int) -> List[str]:
+        """Which enabled scale-up signals are breached right now."""
+        out = []
+        if self.ttft_p99_s > 0 and fleet.ttft_p99_s > self.ttft_p99_s:
+            out.append("ttft_p99")
+        if self.itl_p99_s > 0 and fleet.itl_p99_s > self.itl_p99_s:
+            out.append("itl_p99")
+        if (self.queue_depth_per_replica > 0
+                and fleet.queue_depth > self.queue_depth_per_replica
+                * max(current, 1)):
+            out.append("queue_depth")
+        if (self.kv_headroom_min > 0 and fleet.kv_headroom_min is not None
+                and fleet.kv_headroom_min < self.kv_headroom_min):
+            out.append("kv_headroom")
+        return out
+
+    def desired_from_fleet(self, fleet: FleetSLOView, current: int) -> int:
+        now = time.time()
+        if now - self._last_scale_ts < self.cooldown_s:
+            return current
+        if self.breaches(fleet, current):
+            self._last_scale_ts = now
+            return current + 1
+        idle = fleet.queue_depth == 0
+        if self.ttft_p99_s > 0:
+            idle = idle and (fleet.ttft_p99_s
+                             < self.scale_down_idle_factor
+                             * self.ttft_p99_s)
+        if self.itl_p99_s > 0:
+            idle = idle and (fleet.itl_p99_s
+                             < self.scale_down_idle_factor
+                             * self.itl_p99_s)
+        if idle and current > 1:
+            self._last_scale_ts = now
+            return current - 1
+        return current
+
+    def desired_replicas(self, qps: float, latency_s: float,
+                         current: int) -> int:
+        """Legacy-signature fallback (an Autoscaler wired to a plain
+        gateway window): the gateway's ``latency_signal`` percentile
+        stands in for TTFT — breach scales up, deep idle scales down."""
+        fleet = FleetSLOView(ttft_p99_s=latency_s, gateway_p99_s=latency_s,
+                             queue_depth=0, replicas=current)
+        return self.desired_from_fleet(fleet, current)
+
+
 # ---------------------------------------------------------- replica set ----
 
 class SubprocessReplica:
@@ -187,7 +270,7 @@ class ReplicaSet:
 
     def __init__(self, predictor_factory=None, min_replicas: int = 1,
                  max_replicas: int = 8, replica_factory=None,
-                 runner_cls=None):
+                 runner_cls=None, drain_grace_s: float = 0.0):
         from . import FedMLInferenceRunner
         if (predictor_factory is None) == (replica_factory is None):
             raise ValueError("pass exactly one of predictor_factory / "
@@ -199,6 +282,10 @@ class ReplicaSet:
         self.replica_factory = replica_factory
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
+        # drain-before-kill on scale-down: a shrink victim leaves
+        # rotation immediately but gets this long to finish in-flight
+        # streams before stop() (0 = legacy immediate stop)
+        self.drain_grace_s = float(drain_grace_s)
         self.replicas: List = []
         self._lock = threading.Lock()
         # ports the gateway must route around while their replica
@@ -211,12 +298,45 @@ class ReplicaSet:
             return self.replica_factory()
         return self._runner_cls(self.predictor_factory())
 
-    def scale_to(self, n: int) -> int:
+    def _await_idle(self, port: int, grace_s: float) -> bool:
+        """Poll a shrink victim's ``/healthz`` until its in-flight work
+        drains (occupancy and queue depth both 0) or the grace expires.
+        The victim already left rotation — no new traffic lands on it —
+        so this only waits out streams it is mid-way through. A replica
+        that stopped answering (or one without the engine fields) reads
+        as idle: there is nothing left to wait for."""
+        deadline = time.time() + float(grace_s)
+        while time.time() < deadline:
+            try:
+                try:
+                    r = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1.0)
+                except urllib.error.HTTPError as e:
+                    r = e      # a 503 body still carries the health JSON
+                with r:
+                    h = json.load(r)
+            except Exception:  # noqa: BLE001 — gone/unreadable = idle
+                return True
+            busy = (int(h.get("occupancy", 0) or 0)
+                    + int(h.get("queue_depth", 0) or 0))
+            if not busy:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def scale_to(self, n: int, drain_grace_s: Optional[float] = None
+                 ) -> int:
         """Grow/shrink to ``n``. Replica start/stop happens OUTSIDE the
         set lock — a subprocess replica takes seconds to come up, and the
         gateway needs the same lock for every request; scaling up under
-        load must not stall the traffic it is scaling for."""
+        load must not stall the traffic it is scaling for.
+
+        ``drain_grace_s`` (None = the set default) > 0 makes shrink
+        drain-before-kill: the victim leaves rotation at once, then gets
+        up to the grace for in-flight streams to finish before stop."""
         n = min(max(n, self.min_replicas), self.max_replicas)
+        grace = (getattr(self, "drain_grace_s", 0.0)
+                 if drain_grace_s is None else float(drain_grace_s))
         while True:
             victim = None
             with self._lock:
@@ -227,6 +347,11 @@ class ReplicaSet:
                     # handed again — a stale drain mark would hide it
                     self._draining.discard(victim.port)
             if victim is not None:
+                if grace > 0 and victim.port is not None:
+                    if not self._await_idle(victim.port, grace):
+                        logger.warning(
+                            "replica :%d still busy after %.1fs drain "
+                            "grace — stopping anyway", victim.port, grace)
                 victim.stop()
                 logger.info("replica down (%d left)", len(self))
                 continue
@@ -460,7 +585,10 @@ class Gateway:
 
     def __init__(self, replica_set: ReplicaSet, window_s: float = 5.0,
                  unhealthy_ttl_s: float = 2.0, max_failovers: int = 3,
-                 backoff_seed: Optional[int] = None, chaos=None):
+                 backoff_seed: Optional[int] = None, chaos=None,
+                 cache_aware: bool = False, digest_chars: int = 128,
+                 scrape_ttl_s: float = 1.0, spill_headroom: int = 1,
+                 heal_probe: bool = False):
         from ..core.obs import metrics as obs_metrics
         self.replica_set = replica_set
         self.window_s = float(window_s)
@@ -468,10 +596,31 @@ class Gateway:
         self.max_failovers = int(max_failovers)
         self.backoff_seed = backoff_seed
         self._chaos = chaos      # optional ServingChaosInjector
+        # cache-aware routing (ISSUE 17, OFF = byte-identical routing):
+        # a digest of the request's leading prompt bytes maps to the
+        # replica whose prefix cache is warm for it; the warm pick is
+        # admission-checked against the replica's KV headroom (a cheap
+        # ttl-cached /healthz scrape) and spills to round-robin — without
+        # rehoming — when the warm replica is saturated
+        self.cache_aware = bool(cache_aware)
+        self.digest_chars = int(digest_chars)
+        self.scrape_ttl_s = float(scrape_ttl_s)
+        self.spill_headroom = int(spill_headroom)
+        # quarantine heal (satellite): OFF = legacy TTL-only rejoin;
+        # ON = a quarantined port stays out past its TTL until heal()
+        # probes it healthy (a sick replica can't flap back on a timer)
+        self.heal_probe = bool(heal_probe)
         self._i = 0
         self._lock = threading.Lock()
         self._window = obs_metrics.LatencyWindow(window_s=self.window_s)
         self._unhealthy: dict = {}   # port -> quarantine expiry ts
+        from collections import OrderedDict
+        self._warm: "OrderedDict[str, int]" = OrderedDict()
+        self._warm_cap = 4096
+        self._slo_cache: dict = {}   # port -> (scrape_ts, headroom|None)
+        # routing-decision tally (mirrors the obs counters; first-class
+        # so benches/tests can read the split without registry scrapes)
+        self.route_counts = {"warm_hit": 0, "warm_spill": 0, "cold": 0}
 
     # --- health cache ------------------------------------------------------
     def _mark_unhealthy(self, port: int, reason: str) -> None:
@@ -487,10 +636,61 @@ class Gateway:
             exp = self._unhealthy.get(int(port))
             if exp is None:
                 return False
-            if time.time() >= exp:
+            if time.time() < exp:
+                return True
+            if not self.heal_probe:
                 del self._unhealthy[int(port)]
                 return False
-            return True
+        # TTL expired under heal_probe: the port is eligible — probe it
+        # NOW (heal-on-demand). Routing must not depend on an external
+        # heal() loop running: without this, one conn-drop quarantines
+        # a warm home until the next autoscaler step, spilling every
+        # request homed there. Self-rate-limited — a failing probe
+        # re-arms the TTL, so a sick port costs at most one probe per
+        # TTL window.
+        return not self._heal_port(int(port))
+
+    def heal(self) -> int:
+        """Probe quarantined replicas whose TTL expired: a passing
+        ``/healthz`` rejoins the port to rotation; a failing one re-arms
+        the quarantine for another TTL. No-op with ``heal_probe`` off
+        (legacy timer-only rejoin). Returns the number healed. The
+        autoscaler calls this each step."""
+        if not self.heal_probe:
+            return 0
+        now = time.time()
+        with self._lock:
+            expired = [p for p, exp in self._unhealthy.items()
+                       if now >= exp]
+        return sum(1 for port in expired if self._heal_port(port))
+
+    def _heal_port(self, port: int) -> bool:
+        """Probe ONE quarantine-expired port: a passing ``/healthz``
+        rejoins it to rotation (True); a failing one re-arms the
+        quarantine for another TTL (False). Called from ``heal()`` and
+        inline from ``_is_quarantined`` (heal-on-demand at pick time)."""
+        from ..core.obs import metrics as obs_metrics
+        ok = False
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=1.0) as r:
+                ok = r.status == 200
+        except Exception:  # noqa: BLE001 — any failure = still sick
+            ok = False
+        with self._lock:
+            if port not in self._unhealthy:
+                return True   # raced with a concurrent heal/mark
+            if ok:
+                del self._unhealthy[port]
+            else:
+                self._unhealthy[port] = (time.time()
+                                         + self.unhealthy_ttl_s)
+        if ok:
+            obs_metrics.record_gateway_heal(port)
+            logger.info("gateway: replica :%d healed — rejoining "
+                        "rotation", port)
+        return ok
 
     def probe_health(self, port: int, timeout: float = 1.0) -> bool:
         """GET the replica's ``/healthz``; non-200 (a tripped watchdog,
@@ -506,14 +706,99 @@ class Gateway:
         self._mark_unhealthy(port, "healthz")
         return False
 
-    def _pick_port(self, tried: set, verify_health: bool) -> Optional[int]:
+    # --- cache-aware routing ----------------------------------------------
+    def _routing_digest(self, request: dict) -> Optional[str]:
+        """Digest of the request's leading prompt bytes. Under the byte
+        tokenizer one char is one token, so the first ``digest_chars``
+        characters ARE the leading token blocks — same-system-prompt
+        (and same-conversation-head) traffic shares a digest and sticks
+        to the replica whose prefix cache already holds those blocks."""
+        try:
+            msgs = request.get("messages")
+            if msgs:
+                text = "\n".join(str(m.get("content", ""))
+                                 for m in msgs if isinstance(m, dict))
+            else:
+                text = str(request.get("prompt")
+                           or request.get("inputs") or "")
+        except Exception:  # noqa: BLE001 — routing must never raise
+            return None
+        if not text:
+            return None
+        import hashlib
+        return hashlib.sha1(
+            text[:self.digest_chars].encode("utf-8", "replace")
+        ).hexdigest()[:16]
+
+    def _remember_warm(self, digest: str, port: int) -> None:
+        with self._lock:
+            self._warm[digest] = int(port)
+            self._warm.move_to_end(digest)
+            while len(self._warm) > self._warm_cap:
+                self._warm.popitem(last=False)
+
+    def _replica_headroom(self, port: int) -> Optional[int]:
+        """KV admission headroom from a ttl-cached ``/healthz`` scrape —
+        the warm pick's saturation check. None = unknown (no engine slo
+        payload, or the replica did not answer); unknown never blocks
+        routing."""
+        now = time.time()
+        with self._lock:
+            ent = self._slo_cache.get(int(port))
+            if ent is not None and now - ent[0] < self.scrape_ttl_s:
+                return ent[1]
+        headroom: Optional[int] = None
+        try:
+            try:
+                r = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=0.5)
+            except urllib.error.HTTPError as e:
+                r = e          # 503 still carries the health JSON body
+            with r:
+                h = json.load(r)
+            hr = (h.get("slo") or {}).get("kv_headroom_requests")
+            if hr is not None and int(hr) >= 0:
+                headroom = int(hr)
+        except Exception:  # noqa: BLE001
+            headroom = None
+        with self._lock:
+            self._slo_cache[int(port)] = (now, headroom)
+        return headroom
+
+    def _pick_port(self, tried: set, verify_health: bool,
+                   digest: Optional[str] = None) -> Optional[int]:
         """Next routable port: round-robin over live, non-draining,
         non-quarantined ports the request has not tried yet. With
         ``verify_health`` (retry attempts), the candidate's ``/healthz``
         is consulted before traffic lands on it. Falls back to
         quarantined-but-untried ports rather than refusing — a wrong
-        quarantine must not 503 the fleet."""
+        quarantine must not 503 the fleet.
+
+        With cache-aware routing and a ``digest``, the digest's warm
+        replica wins while it is routable and has KV admission headroom;
+        a saturated warm replica spills this request to round-robin
+        WITHOUT rehoming the digest (its cache stays warm where it is);
+        a digest whose home left the fleet — or one never seen — records
+        the round-robin pick as its new home."""
         ports = self.replica_set.ports()
+        route_outcome: Optional[str] = None
+        if self.cache_aware and digest is not None and ports:
+            from ..core.obs import metrics as obs_metrics
+            with self._lock:
+                warm = self._warm.get(digest)
+            if warm is not None and warm in ports:
+                if warm not in tried and not self._is_quarantined(warm):
+                    headroom = self._replica_headroom(warm)
+                    if headroom is None \
+                            or headroom >= self.spill_headroom:
+                        with self._lock:
+                            self.route_counts["warm_hit"] += 1
+                        obs_metrics.record_gateway_route("warm_hit")
+                        return warm
+                # saturated / tried / quarantined: spill, keep the home
+                route_outcome = "warm_spill"
+            else:
+                route_outcome = "cold"   # new digest or home scaled away
         candidates = [p for p in ports
                       if p not in tried and not self._is_quarantined(p)]
         if not candidates:
@@ -531,6 +816,13 @@ class Gateway:
                     and not self.probe_health(port):
                 candidates.remove(port)
                 continue
+            if route_outcome is not None:
+                from ..core.obs import metrics as obs_metrics
+                if route_outcome == "cold":
+                    self._remember_warm(digest, port)
+                with self._lock:
+                    self.route_counts[route_outcome] += 1
+                obs_metrics.record_gateway_route(route_outcome)
             return port
         return None
 
@@ -550,10 +842,13 @@ class Gateway:
             headers["traceparent"] = cur.traceparent()
         delays = backoff_delays(base_s=0.05, factor=2.0, max_s=0.5,
                                 seed=self.backoff_seed)
+        digest = (self._routing_digest(request)
+                  if self.cache_aware else None)
         tried: set = set()
         last_exc: Optional[Exception] = None
         for attempt in range(self.max_failovers + 1):
-            port = self._pick_port(tried, verify_health=attempt > 0)
+            port = self._pick_port(tried, verify_health=attempt > 0,
+                                   digest=digest)
             if port is None:
                 break   # every live port tried (or none live)
             tried.add(port)
@@ -665,21 +960,80 @@ class Autoscaler:
         self.gateway = gateway
         self.policy = policy
         self.interval_s = float(interval_s)
+        self.scale_events = 0            # replica-count changes applied
+        self.last_fleet: Optional[FleetSLOView] = None
         self._running = False
         self._thread: Optional[threading.Thread] = None
+
+    def _fleet_slo(self) -> FleetSLOView:
+        """Scrape every replica's ``/healthz`` ``slo`` payload into one
+        :class:`FleetSLOView` (worst-replica tails, summed queue, min
+        headroom). Draining replicas are included — their in-flight tail
+        is still the user's latency."""
+        ports = self.gateway.replica_set.ports(include_draining=True)
+        ttft: List[float] = []
+        itl: List[float] = []
+        queue = 0
+        headrooms: List[int] = []
+        for port in ports:
+            try:
+                try:
+                    r = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1.0)
+                except urllib.error.HTTPError as e:
+                    r = e      # a 503 body still carries the health JSON
+                with r:
+                    h = json.load(r)
+            except Exception:  # noqa: BLE001 — a dead replica scores 0
+                continue
+            queue += int(h.get("queue_depth", 0) or 0)
+            slo = h.get("slo") or {}
+            if int(slo.get("ttft_n", 0) or 0) > 0:
+                ttft.append(float(slo.get("ttft_p99_s", 0.0)))
+            if int(slo.get("itl_n", 0) or 0) > 0:
+                itl.append(float(slo.get("itl_p99_s", 0.0)))
+            hr = slo.get("kv_headroom_requests")
+            if hr is not None and int(hr) >= 0:
+                headrooms.append(int(hr))
+        m = self.gateway.metrics()
+        return FleetSLOView(
+            ttft_p99_s=max(ttft) if ttft else 0.0,
+            itl_p99_s=max(itl) if itl else 0.0,
+            queue_depth=queue,
+            kv_headroom_min=min(headrooms) if headrooms else None,
+            gateway_p99_s=m.p99, replicas=len(ports))
 
     def step(self) -> int:
         """One evaluation: heal -> metrics -> desired -> scale. Returns the
         new replica count (also usable directly, without the daemon
         thread). Policies declaring a ``latency_signal`` ("mean" | "p50" |
         "p99") are fed that percentile from the gateway window — tail-
-        latency-targeting autoscaling."""
+        latency-targeting autoscaling. A policy with a
+        ``desired_from_fleet`` method (:class:`SLOPolicy`) is instead fed
+        the aggregated per-replica SLO scrape."""
+        from ..core.obs import metrics as obs_metrics
         self.gateway.replica_set.health_check()
-        m = self.gateway.metrics()
-        lat = m.signal(getattr(self.policy, "latency_signal", "mean"))
-        desired = self.policy.desired_replicas(
-            m.qps, lat, len(self.gateway.replica_set))
-        return self.gateway.replica_set.scale_to(desired)
+        heal = getattr(self.gateway, "heal", None)
+        if callable(heal):
+            heal()
+        current = len(self.gateway.replica_set)
+        if hasattr(self.policy, "desired_from_fleet"):
+            self.last_fleet = self._fleet_slo()
+            desired = self.policy.desired_from_fleet(
+                self.last_fleet, current)
+        else:
+            m = self.gateway.metrics()
+            lat = m.signal(getattr(self.policy, "latency_signal", "mean"))
+            desired = self.policy.desired_replicas(m.qps, lat, current)
+        got = self.gateway.replica_set.scale_to(desired)
+        after = len(self.gateway.replica_set)
+        if after != current:
+            self.scale_events += 1
+            obs_metrics.record_fleet_scale(
+                "up" if after > current else "down", after)
+            logger.info("autoscaler: scaled %d -> %d replicas",
+                        current, after)
+        return got
 
     def start(self) -> None:
         self._running = True

@@ -155,6 +155,11 @@ class BatchingEngine:
         self._wave_seq = 0       # piggybacked-prefill wave stamp
         self._req_wall_ema: Optional[float] = None   # Retry-After input
         self._last_fault_step = -1   # one plan consult per step index
+        # SLO instruments for /healthz: trailing exact-percentile TTFT /
+        # ITL windows the autoscaler's SLOPolicy and the gateway's
+        # saturation check read without Prometheus parsing
+        self._ttft_window = obs_metrics.LatencyWindow(window_s=30.0)
+        self._itl_window = obs_metrics.LatencyWindow(window_s=10.0)
         # --- black box + watchdog ------------------------------------------
         self.flight = obs_flight.FlightRecorder(
             "serving_engine", capacity=int(flight_records))
@@ -590,6 +595,7 @@ class BatchingEngine:
             req.span.set_attr("ttft_s",
                               round(now - req.submitted_ts, 6))
             obs_metrics.record_llm_ttft(now - req.submitted_ts)
+            self._ttft_window.observe(now - req.submitted_ts)
         req.span.add_event("admit", slot=slot,
                            recompute=not first_admit)
         obs_metrics.record_llm_admit()
@@ -630,7 +636,9 @@ class BatchingEngine:
         self.flight.note("preempt", slot=victim.slot,
                          tokens_kept=len(victim.out_ids))
         self._inflight.pop(victim.slot, None)
-        self.scheduler.release(victim.slot)
+        # suffix-seam release: the victim's generated blocks stay warm,
+        # so its requeue re-admits against its own cached chain
+        self._release_slot(victim)
         victim.slot = None
         self._note_kv_pool()
         if self._requeue(victim, "pressure"):
@@ -832,9 +840,21 @@ class BatchingEngine:
     def _retire(self, req: _Request) -> None:
         if req.slot is not None:
             self._inflight.pop(req.slot, None)
-            self.scheduler.release(req.slot)
+            self._release_slot(req)
             req.slot = None
             self._note_kv_pool()
+
+    def _release_slot(self, req: _Request) -> None:
+        """Release through the suffix-cache seam when the scheduler has
+        one: the full token chain (prompt + generated) rides along so
+        fully-written decode blocks get indexed for follow-up/requeued
+        aliasing. getattr-gated — stub schedulers keep their single-arg
+        ``release``."""
+        if getattr(self.scheduler, "suffix_cache", False):
+            self.scheduler.release(req.slot,
+                                   final_ids=req.ids + req.out_ids)
+        else:
+            self.scheduler.release(req.slot)
 
     def _finish(self, req: _Request, reason: str) -> None:
         if req.future.done():
@@ -936,6 +956,7 @@ class BatchingEngine:
         # experienced this inter-token gap (per-step, not per-slot, so
         # the hot loop stays one bisect regardless of occupancy)
         obs_metrics.record_llm_itl(wall_s)
+        self._itl_window.observe(wall_s)
         self.flight.note("step", tokens=tokens_out,
                          occupancy=self.scheduler.active_count(),
                          queue_depth=self.queue_depth(),
@@ -1009,6 +1030,21 @@ class BatchingEngine:
                "reset_budget_remaining": max(
                    self.max_resets - len(self._reset_times), 0),
                "flight_records": len(self.flight)}
+        # the fleet-control payload: exact trailing percentiles + KV
+        # admission headroom in one cheap scrape — what the SLOPolicy
+        # autoscaler and the cache-aware gateway's spill check consume
+        _, _, _, ttft_p99, ttft_n = self._ttft_window.stats()
+        _, _, _, itl_p99, itl_n = self._itl_window.stats()
+        try:
+            headroom = int(
+                self.scheduler.kv_pool_stats()["headroom_requests"])
+        except Exception:  # noqa: BLE001 — health must answer, not raise
+            headroom = -1
+        out["slo"] = {"ttft_p99_s": round(ttft_p99, 6),
+                      "ttft_n": int(ttft_n),
+                      "itl_p99_s": round(itl_p99, 6),
+                      "itl_n": int(itl_n),
+                      "kv_headroom_requests": headroom}
         if self._failed is not None:
             out["failed_reason"] = self._failed
         return out

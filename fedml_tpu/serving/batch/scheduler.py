@@ -77,7 +77,8 @@ class DecodeScheduler:
                  num_blocks: Optional[int] = None,
                  prefill_chunk: int = 32,
                  prefix_cache: bool = False,
-                 prefill_batch: int = 0):
+                 prefill_batch: int = 0,
+                 suffix_cache: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -100,8 +101,12 @@ class DecodeScheduler:
             num_blocks=int(num_blocks) if num_blocks is not None
             else self.slots * (cfg.max_seq_len // int(block_size)))
         self.alloc = kvc.BlockAllocator(self.cache_cfg)
+        # suffix caching (generated-token reuse at release) needs the
+        # same exact-content index to match follow-up prompts against,
+        # so turning it on implies the prefix index
+        self.suffix_cache = bool(suffix_cache)
         self._index = (kvc.PrefixIndex(self.cache_cfg.block_size)
-                       if prefix_cache else None)
+                       if (prefix_cache or self.suffix_cache) else None)
         self._kp, self._vp = kvc.init_pools(self.cache_cfg,
                                             cfg.compute_dtype)
         s, mb = self.slots, self.cache_cfg.max_blocks_per_slot
@@ -190,6 +195,18 @@ class DecodeScheduler:
                 key, row / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
             return jnp.where(temp > 0, sampled, greedy)
 
+        # Every serving program is SPLIT into a read-only compute pass
+        # (pools are plain operands — gathers cost O(touched blocks))
+        # and a write-only scatter pass with the pools DONATED. A fused
+        # read+write program defeats XLA's in-place aliasing — it
+        # cannot prove the gathered rows and scattered rows are
+        # disjoint, so it copies the ENTIRE pool every dispatch:
+        # O(num_blocks) per step, ~300 ms at an 8k-block pool on CPU.
+        # Split, the write pass is a bare coordinate scatter XLA applies
+        # in place (O(slots)), and ordering is enforced by data flow:
+        # the write consumes the compute pass's outputs. Cost: one
+        # extra dispatch (~0.1 ms) per step/chunk/COW.
+
         def decode_step(params, stack, kp, vp, tables, pos, active, aidx,
                         last_tok, temps, seeds):
             views = [(kvc.gather_view(kp[i], tables),
@@ -211,12 +228,17 @@ class DecodeScheduler:
             finite = jnp.all(jnp.where(active[:, None],
                                        jnp.isfinite(row), True))
             nxt = jax.vmap(sample)(row, temps, seeds, pos + 1)
-            for i, (kc, vc) in enumerate(kvs):
-                kp = kp.at[i].set(kvc.scatter_token(
-                    kp[i], tables, pos, kc[:, 0], active, bs, trash))
-                vp = vp.at[i].set(kvc.scatter_token(
-                    vp[i], tables, pos, vc[:, 0], active, bs, trash))
-            return nxt, finite, kp, vp
+            kcs = jnp.stack([kc[:, 0] for kc, _ in kvs])   # [L, S, H, D]
+            vcs = jnp.stack([vc[:, 0] for _, vc in kvs])
+            return nxt, finite, kcs, vcs
+
+        def decode_write(kp, vp, tables, pos, active, kcs, vcs):
+            for i in range(n_layers):
+                kp = kvc.scatter_token(kp, i, tables, pos, kcs[i],
+                                       active, bs, trash)
+                vp = kvc.scatter_token(vp, i, tables, pos, vcs[i],
+                                       active, bs, trash)
+            return kp, vp
 
         def prefill_chunk(params, stack, kp, vp, table_row, tokens, p0,
                           n_valid, aidx):
@@ -235,12 +257,21 @@ class DecodeScheduler:
             logits, kvs = self.module.apply(
                 {"params": params}, tokens[None], positions=q_pos[None],
                 kv_view=views, adapters=adapters, lora_scale=scale)
-            for i, (kc, vc) in enumerate(kvs):
-                kp = kp.at[i].set(kvc.scatter_chunk(
-                    kp[i], table_row, positions, kc[0], valid, bs, trash))
-                vp = vp.at[i].set(kvc.scatter_chunk(
-                    vp[i], table_row, positions, vc[0], valid, bs, trash))
-            return logits[0], kp, vp
+            kcs = jnp.stack([kc[0] for kc, _ in kvs])   # [L, C, H, D]
+            vcs = jnp.stack([vc[0] for _, vc in kvs])
+            return logits[0], kcs, vcs
+
+        def chunk_write(kp, vp, table_row, p0, n_valid, kcs, vcs):
+            c = kcs.shape[1]
+            offs = jnp.arange(c, dtype=jnp.int32)
+            positions = p0 + offs
+            valid = offs < n_valid
+            for i in range(n_layers):
+                kp = kvc.scatter_chunk(kp, i, table_row, positions,
+                                       kcs[i], valid, bs, trash)
+                vp = kvc.scatter_chunk(vp, i, table_row, positions,
+                                       vcs[i], valid, bs, trash)
+            return kp, vp
 
         def prefill_wave(params, stack, kp, vp, table_rows, tokens, p0,
                          n_valid, aidx):
@@ -263,24 +294,46 @@ class DecodeScheduler:
             logits, kvs = self.module.apply(
                 {"params": params}, tokens, positions=q_pos,
                 kv_view=views, adapters=adapters, lora_scale=scale)
-            for i, (kc, vc) in enumerate(kvs):
-                kp = kp.at[i].set(kvc.scatter_chunk_batch(
-                    kp[i], table_rows, positions, kc, valid, bs, trash))
-                vp = vp.at[i].set(kvc.scatter_chunk_batch(
-                    vp[i], table_rows, positions, vc, valid, bs, trash))
-            return logits, kp, vp
+            kcs = jnp.stack([kc for kc, _ in kvs])   # [L, B, C, H, D]
+            vcs = jnp.stack([vc for _, vc in kvs])
+            return logits, kcs, vcs
 
-        def cow_copy(kp, vp, src, dst, n_rows):
-            # admission-time copy-on-write: the partially matched cached
-            # block's first n_rows move into a block the slot owns
-            return (kvc.copy_block_rows(kp, src, dst, n_rows),
-                    kvc.copy_block_rows(vp, src, dst, n_rows))
+        def wave_write(kp, vp, table_rows, p0, n_valid, kcs, vcs):
+            c = kcs.shape[2]
+            offs = jnp.arange(c, dtype=jnp.int32)[None, :]
+            positions = p0[:, None] + offs
+            valid = offs < n_valid[:, None]
+            for i in range(n_layers):
+                kp = kvc.scatter_chunk_batch(kp, i, table_rows,
+                                             positions, kcs[i], valid,
+                                             bs, trash)
+                vp = kvc.scatter_chunk_batch(vp, i, table_rows,
+                                             positions, vcs[i], valid,
+                                             bs, trash)
+            return kp, vp
 
-        self._step_fn = jax.jit(decode_step, donate_argnums=(2, 3))
-        self._prefill_fn = jax.jit(prefill_chunk, donate_argnums=(2, 3))
-        self._prefill_wave_fn = jax.jit(prefill_wave,
-                                        donate_argnums=(2, 3))
-        self._cow_fn = jax.jit(cow_copy, donate_argnums=(0, 1))
+        def cow_read(kp, vp, src, dst, n_rows):
+            # admission-time copy-on-write, read half: merge the
+            # partially matched cached block's first n_rows over the
+            # destination block's tail — [L, bs, H, D] per pool, tiny
+            keep = (jnp.arange(bs) < n_rows)[None, :, None, None]
+            return (jnp.where(keep, kp[:, src], kp[:, dst]),
+                    jnp.where(keep, vp[:, src], vp[:, dst]))
+
+        def cow_write(kp, vp, dst, mk, mv):
+            # write half: one dynamic-update-slice per pool, in place
+            # under donation — the slot owns dst, the source block is
+            # never written
+            return kp.at[:, dst].set(mk), vp.at[:, dst].set(mv)
+
+        self._step_fn = jax.jit(decode_step)
+        self._step_write_fn = jax.jit(decode_write, donate_argnums=(0, 1))
+        self._prefill_fn = jax.jit(prefill_chunk)
+        self._chunk_write_fn = jax.jit(chunk_write, donate_argnums=(0, 1))
+        self._prefill_wave_fn = jax.jit(prefill_wave)
+        self._wave_write_fn = jax.jit(wave_write, donate_argnums=(0, 1))
+        self._cow_read_fn = jax.jit(cow_read)
+        self._cow_write_fn = jax.jit(cow_write, donate_argnums=(0, 1))
         self._sample_fn = jax.jit(sample)
 
     def _stack(self):
@@ -364,9 +417,12 @@ class DecodeScheduler:
             # copy-on-write: the reusable head of the partially matched
             # block moves into the slot's OWN block; the shared source
             # is read, never written
-            self._kp, self._vp = self._cow_fn(
+            dst_d = jnp.int32(int(row[n_alias]))
+            mk, mv = self._cow_read_fn(
                 self._kp, self._vp, jnp.int32(int(chain[n_alias])),
-                jnp.int32(int(row[n_alias])), jnp.int32(n_copy))
+                dst_d, jnp.int32(n_copy))
+            self._kp, self._vp = self._cow_write_fn(
+                self._kp, self._vp, dst_d, mk, mv)
         self._reserved.add(slot)
         if self._index is not None:
             # account reuse only now — the admission COMMITTED to this
@@ -378,9 +434,20 @@ class DecodeScheduler:
                 self._index.misses += 1
             obs_metrics.record_llm_prefix_cache(matched,
                                                 len(ids) - matched)
+            # suffix-cache accounting: fully-aliased blocks whose tokens
+            # the engine GENERATED (indexed at a prior slot's release) —
+            # a multi-turn follow-up aliasing its own earlier reply
+            n_decode = self._index.count_suffix_reuse(chain[:n_alias])
+            if n_decode > 0:
+                self._index.suffix_hits += 1
+                self._index.suffix_tokens_reused += n_decode * bs
+                obs_metrics.record_llm_suffix_cache(n_decode * bs)
+        else:
+            n_decode = 0
         info = {"cached_tokens": matched,
                 "novel_tokens": len(ids) - matched,
-                "aliased_blocks": n_alias, "cow_rows": n_copy}
+                "aliased_blocks": n_alias, "cow_rows": n_copy,
+                "suffix_tokens": n_decode * bs}
         self.last_admit_info = info
         return _PendingAdmit(slot=slot, row=row, ids=ids,
                              novel_start=matched, aidx=int(adapter_idx),
@@ -436,11 +503,15 @@ class DecodeScheduler:
             chunk = p.ids[j:j + c]
             n_valid = len(chunk)
             chunk = chunk + [0] * (c - n_valid)
-            logits_last, self._kp, self._vp = self._dispatch(
+            j_d, nv_d = jnp.int32(j), jnp.int32(n_valid)
+            logits_last, kcs, vcs = self._dispatch(
                 "llm_prefill_chunk", self._prefill_fn,
                 self.params, stack, self._kp, self._vp, row_dev,
-                jnp.asarray(chunk, jnp.int32), jnp.int32(j),
-                jnp.int32(n_valid), jnp.int32(p.aidx))
+                jnp.asarray(chunk, jnp.int32), j_d, nv_d,
+                jnp.int32(p.aidx))
+            self._kp, self._vp = self._dispatch(
+                "llm_prefill_write", self._chunk_write_fn,
+                self._kp, self._vp, row_dev, j_d, nv_d, kcs, vcs)
             last_valid = n_valid
         return logits_last[last_valid - 1]
 
@@ -478,11 +549,14 @@ class DecodeScheduler:
                     toks[i, :len(chunk)] = chunk
                     p0[i] = start
                     n_valid[i] = len(chunk)
-                logits, self._kp, self._vp = self._dispatch(
+                p0_d, nv_d = jnp.asarray(p0), jnp.asarray(n_valid)
+                logits, kcs, vcs = self._dispatch(
                     "llm_prefill_wave", self._prefill_wave_fn,
                     self.params, stack, self._kp, self._vp, rows_dev,
-                    jnp.asarray(toks), jnp.asarray(p0),
-                    jnp.asarray(n_valid), aidx_dev)
+                    jnp.asarray(toks), p0_d, nv_d, aidx_dev)
+                self._kp, self._vp = self._dispatch(
+                    "llm_wave_write", self._wave_write_fn,
+                    self._kp, self._vp, rows_dev, p0_d, nv_d, kcs, vcs)
                 for i, p in enumerate(group):
                     if j == counts[i] - 1:
                         lasts[g0 + i] = logits[i, int(n_valid[i]) - 1]
@@ -529,8 +603,29 @@ class DecodeScheduler:
             raise
         return pending.slot, first
 
-    def release(self, slot: int) -> None:
-        self.alloc.free(int(slot))
+    def release(self, slot: int, final_ids=None) -> None:
+        """Return a slot's blocks to the pool. Under suffix caching the
+        caller passes ``final_ids`` — the request's full token chain
+        (prompt + generated) — and every fully WRITTEN decode block is
+        indexed first, under the same pin discipline as prompt blocks,
+        so a follow-up or requeued request aliases the whole
+        conversation prefix. The insert must precede the free: ``retain``
+        requires a live reference, which the slot still holds here.
+
+        Only positions ``0.._pos[slot]-1`` have KV in the pool (the
+        final sampled token was never scattered — the slot retired
+        before its next step), so indexing caps at ``_pos[slot]``."""
+        slot = int(slot)
+        if (self.suffix_cache and self._index is not None
+                and final_ids is not None and self._active[slot]):
+            n = min(int(self._pos[slot]), len(final_ids))
+            if n >= self.cache_cfg.block_size:
+                added = self._index.insert(
+                    [int(t) for t in final_ids[:n]], self._tables[slot],
+                    n, self.alloc, origin="decode")
+                if added:
+                    obs_metrics.record_llm_suffix_insert(added)
+        self.alloc.free(slot)
         self._active[slot] = False
         self._tables[slot] = self.cache_cfg.trash_block
 
@@ -545,13 +640,18 @@ class DecodeScheduler:
         jnp = self._jnp
         if not self._active.any():
             return {}
-        nxt, finite, self._kp, self._vp = self._dispatch(
+        tables_d = jnp.asarray(self._tables)
+        pos_d = jnp.asarray(self._pos)
+        active_d = jnp.asarray(self._active)
+        nxt, finite, kcs, vcs = self._dispatch(
             "llm_decode_step", self._step_fn,
             self.params, self._stack(), self._kp, self._vp,
-            jnp.asarray(self._tables), jnp.asarray(self._pos),
-            jnp.asarray(self._active), jnp.asarray(self._aidx),
+            tables_d, pos_d, active_d, jnp.asarray(self._aidx),
             jnp.asarray(self._last), jnp.asarray(self._temp),
             jnp.asarray(self._seed))
+        self._kp, self._vp = self._dispatch(
+            "llm_decode_write", self._step_write_fn,
+            self._kp, self._vp, tables_d, pos_d, active_d, kcs, vcs)
         toks = np.asarray(nxt)
         self.last_step_finite = bool(finite)
         self.steps_run += 1
@@ -579,20 +679,27 @@ class DecodeScheduler:
         used = ccfg.num_blocks - free
         per_req = ccfg.blocks_needed(ccfg.max_seq_len)
         written = int(self._pos[self._active].sum()) if used else 0
+        reclaimable = 0
         if self._index is not None:
+            reclaimable = self._index.reclaimable(self.alloc)
             # index-only cached blocks are FULL by construction (only
             # completely written prompt blocks are indexed) — without
             # this an idle pool holding a warm cache reads as 100%
             # fragmented
-            written += (self._index.reclaimable(self.alloc)
-                        * ccfg.block_size)
+            written += reclaimable * ccfg.block_size
         capacity = used * ccfg.block_size
         # aliasing REDUCES fragmentation: two slots reading one physical
         # block count their positions against a single block's capacity
         # (clamped at 0 when sharing overshoots)
         frag = 1.0 - written / capacity if capacity else 0.0
+        # headroom counts reclaimable cache blocks as free: admission
+        # evicts refcount-0 cached blocks on demand, so a full-but-warm
+        # pool can still admit. Counting only the free list makes a
+        # replica look MORE loaded the warmer its cache gets, and a
+        # cache-aware gateway would spill away from exactly the
+        # replicas it tried to keep warm.
         return {"used_blocks": used, "free_blocks": free,
-                "headroom_requests": free // per_req,
+                "headroom_requests": (free + reclaimable) // per_req,
                 "fragmentation": round(max(frag, 0.0), 4),
                 "aliased_blocks": self.alloc.aliased_blocks(),
                 "cached_blocks": (self._index.cached_blocks
@@ -627,7 +734,8 @@ class DecodeScheduler:
                    "max_seq_len": self.cfg.max_seq_len,
                    "prefill_chunk": self.prefill_chunk,
                    "prefill_batch": self.prefill_batch,
-                   "prefix_cache": self._index is not None}}
+                   "prefix_cache": self._index is not None,
+                   "suffix_cache": self.suffix_cache}}
         if self._index is not None:
             # the live-diagnosis payload an aliasing bug needs: the
             # index's hit/eviction counters plus every allocated block's

@@ -94,6 +94,22 @@ class CausalLMPredictor(FedMLPredictor):
         self._default_aidx = 0
         self._request_timeout_s = float(
             (batch_opts or {}).get("request_timeout_s", 120.0))
+        # suffix caching changes how multi-turn chats ENCODE: the
+        # follow-up must reproduce the prior request's exact token chain
+        # (prompt ++ SEP ++ generated reply) for the generated blocks to
+        # alias; knob off keeps the legacy "\n"-joined prompt byte-for-
+        # byte
+        self._suffix_chat = bool(
+            (batch_opts or {}).get("suffix_cache", False))
+        if self._suffix_chat:
+            # the byte tokenizer's "replace" decode is lossy on invalid
+            # UTF-8 (an untrained model emits it freely), which would
+            # break encode(decode(ids)) == ids — the equality the whole
+            # suffix-alias path rests on. Swap in the round-trip-exact
+            # variant; for valid UTF-8 it is byte-identical.
+            from ..llm.data import ByteTokenizer, RoundTripByteTokenizer
+            if type(self.tokenizer) is ByteTokenizer:
+                self.tokenizer = RoundTripByteTokenizer()
         if self.mode == "batch":
             self._build_engine(batch_opts or {})
 
@@ -123,7 +139,8 @@ class CausalLMPredictor(FedMLPredictor):
             num_blocks=opts.get("num_blocks"),
             prefill_chunk=int(opts.get("prefill_chunk", 32)),
             prefix_cache=bool(opts.get("prefix_cache", False)),
-            prefill_batch=int(opts.get("prefill_batch", 0) or 0))
+            prefill_batch=int(opts.get("prefill_batch", 0) or 0),
+            suffix_cache=bool(opts.get("suffix_cache", False)))
         self._engine = BatchingEngine(
             scheduler,
             default_deadline_s=float(opts.get("deadline_s", 0.0)),
@@ -208,6 +225,8 @@ class CausalLMPredictor(FedMLPredictor):
                     getattr(args, "llm_prefix_cache", False)),
                 "prefill_batch": int(
                     getattr(args, "llm_prefill_batch", 0) or 0),
+                "suffix_cache": bool(
+                    getattr(args, "llm_suffix_cache", False)),
             })
             # seeded serving chaos (engine-side stall/NaN injection);
             # None unless a chaos_serving_* knob is live, so the default
@@ -243,6 +262,35 @@ class CausalLMPredictor(FedMLPredictor):
         for ``max_new_tokens`` of completion."""
         from ..llm.data import BOS, SEP
         ids = [BOS] + self.tokenizer.encode(prompt) + [SEP]
+        reserve = max(1, min(int(max_new_tokens), self.max_seq_len - 1))
+        budget = max(1, self.max_seq_len - reserve)
+        if len(ids) > budget:
+            ids = ids[-budget:]
+        return ids
+
+    def _encode_chat(self, messages, max_new_tokens: int) -> List[int]:
+        """Suffix-cache chat encoding: assistant turns ride behind a
+        ``SEP`` (instruction ++ SEP ++ response — the shape the engine's
+        own decode wrote into the KV pool), so a follow-up's token chain
+        is EXACTLY the prior request's chain ++ the new user turn, and
+        the generated-token blocks alias instead of re-prefilling. The
+        byte tokenizer encodes per character, so concatenating per-turn
+        encodes equals encoding the concatenation — turn-1 requests
+        produce the same ids as :meth:`_encode_prompt`."""
+        from ..llm.data import BOS, SEP
+        ids: List[int] = [BOS]
+        first = True
+        for m in messages:
+            content = m.get("content") if isinstance(m, dict) else None
+            if not content:
+                continue
+            if isinstance(m, dict) and m.get("role") == "assistant":
+                ids += [SEP] + self.tokenizer.encode(str(content))
+            else:
+                ids += self.tokenizer.encode(
+                    str(content) if first else "\n" + str(content))
+            first = False
+        ids.append(SEP)
         reserve = max(1, min(int(max_new_tokens), self.max_seq_len - 1))
         budget = max(1, self.max_seq_len - reserve)
         if len(ids) > budget:
@@ -396,15 +444,31 @@ class CausalLMPredictor(FedMLPredictor):
         prompt = "\n".join(str(m.get("content", "")) for m in messages
                            if m.get("content"))
         seed = request.get("seed")
+        max_new = int(request.get("max_tokens", 64))
+        # suffix-cache encoding (knob-gated): token-level chat layout so
+        # follow-ups alias their own generated turns; knob off keeps the
+        # legacy string prompt byte-identical
+        use_suffix = self._suffix_chat and self._engine is not None
+        ids = self._encode_chat(messages, max_new) if use_suffix else None
         if (self.stream_enabled and request.get("stream")
                 and self._engine is not None):
-            return self._chat_stream(request, prompt, seed)
-        out = self.generate(
-            prompt,
-            max_new_tokens=int(request.get("max_tokens", 64)),
-            temperature=request.get("temperature"),
-            seed=None if seed is None else int(seed),
-            adapter=self._resolve_adapter(request))
+            return self._chat_stream(request, prompt, seed, ids=ids)
+        if use_suffix:
+            import os as _os
+            temp = (self.temperature
+                    if request.get("temperature") is None
+                    else float(request.get("temperature")))
+            rseed = (int.from_bytes(_os.urandom(4), "little") & 0x7FFFFFFF
+                     if seed is None else int(seed))
+            out = self._generate_batched(ids, max_new, temp, rseed,
+                                         self._resolve_adapter(request))
+        else:
+            out = self.generate(
+                prompt,
+                max_new_tokens=max_new,
+                temperature=request.get("temperature"),
+                seed=None if seed is None else int(seed),
+                adapter=self._resolve_adapter(request))
         # OpenAI's finish_reason enum has no server-side eviction values:
         # "stop" stays "stop", every server-cut reason ("length",
         # "deadline", "preempted") maps to "length" for client compat,
@@ -430,7 +494,8 @@ class CausalLMPredictor(FedMLPredictor):
             },
         }
 
-    def _chat_stream(self, request: Any, prompt: str, seed) -> Any:
+    def _chat_stream(self, request: Any, prompt: str, seed,
+                     ids: Optional[List[int]] = None) -> Any:
         """SSE token streaming: submit with a stream queue and emit one
         OpenAI ``chat.completion.chunk`` per decoded text delta, closed
         by a finish frame carrying ``finish_reason`` +
@@ -450,7 +515,8 @@ class CausalLMPredictor(FedMLPredictor):
             seed = int.from_bytes(_os.urandom(4), "little") & 0x7FFFFFFF
         max_new = int(request.get("max_tokens", 64))
         obs_metrics.record_llm_stream_request()
-        ids = self._encode_prompt(prompt, max_new)
+        if ids is None:
+            ids = self._encode_prompt(prompt, max_new)
         q: "_queue.SimpleQueue" = _queue.SimpleQueue()
         # submit BEFORE returning the stream: an Overloaded/validation
         # verdict still surfaces as the ordinary HTTP error, not a
@@ -486,10 +552,21 @@ class CausalLMPredictor(FedMLPredictor):
                         f"{self._request_timeout_s}")
                 if kind == "token":
                     toks.append(int(val))
-                    text = self.tokenizer.decode(toks)
-                    delta = text[len(emitted):]
+                    if self._suffix_chat:
+                        # per-token deltas: the full-redecode slicing
+                        # below silently drops bytes whenever a multi-
+                        # byte sequence resolves retroactively (text
+                        # changes without growing), so the client's
+                        # concatenated reply would not re-encode to the
+                        # generated ids. One token -> one lossless delta
+                        # keeps the follow-up's re-encode exact.
+                        delta = self.tokenizer.decode([int(val)])
+                    else:
+                        text = self.tokenizer.decode(toks)
+                        delta = text[len(emitted):]
+                        if delta:
+                            emitted = text
                     if delta:
-                        emitted = text
                         yield chunk({"content": delta})
                 elif kind == "finish":
                     native = str(val)

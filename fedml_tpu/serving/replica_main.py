@@ -75,14 +75,36 @@ def main() -> None:
             args, spec["params_path"], int(spec["output_dim"]))
         runner = FedMLInferenceRunner(predictor, chaos=chaos)
     port = runner.start()
+    # graceful SIGTERM drain (the drain-before-kill scale-down path):
+    # stop accepting, let the engine finish/flush, then exit 0 — so a
+    # scale-down victim's in-flight work resolves instead of dying
+    # mid-stream. SIGKILL remains the crash path chaos exercises.
+    import signal
+    import threading
+    stop_evt = threading.Event()
+
+    def _graceful(_sig, _frm):
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
     port_file = spec.get("port_file")
     if port_file:
         tmp = f"{port_file}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             f.write(str(port))
         os.replace(tmp, port_file)
-    # serve until killed; the runner's server thread is non-daemon via join
-    runner._thread.join()
+    # serve until terminated; the server thread keeps running while the
+    # main thread waits on the shutdown signal
+    while not stop_evt.wait(0.5):
+        if not runner._thread.is_alive():
+            return
+    close = getattr(predictor, "close", None)
+    if callable(close):
+        try:
+            close()   # engine stop: drains the loop + flushes metrics
+        except Exception:
+            pass
+    runner.stop()
 
 
 if __name__ == "__main__":

@@ -59,13 +59,20 @@ from typing import Dict, List, Optional, Tuple
 # weak-scaling bench's new d{k}_int8 quantized-re-layout legs reuse
 # `efficiency` (UP) and collective_wire_bytes_per_round's `bytes`
 # marker (DOWN — the quantized all_to_all must shrink the wire).
+# fleet-serving additions (ISSUE 17): llm_serving_fleet_tokens_per_s
+# rides `per_s` (UP) and its ttft_mean_s/ttft_p99_s legs the `ttft`
+# marker (DOWN); `hits` covers suffix_hits (UP — generated-token blocks
+# aliased), `compiles` covers cold_start_compiles alongside the
+# steady-state `recompiles` gauge (both DOWN), `scale_events` bounds
+# the SLO autoscaler's move count (DOWN — a stable fleet does not
+# staircase), `drops` the seeded chaos conn-drop count (DOWN).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
-                  "goodput", "success", "hit_rate", "reused",
+                  "goodput", "success", "hit_rate", "hits", "reused",
                   "efficiency", "swaps", "attributed")
-LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles",
+LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles", "compiles",
                  "time_to", "step_time", "wall", "round_s",
                  "resets", "trips", "faults", "fragmentation", "ttft",
-                 "bound_share", "_ms", "overhead")
+                 "bound_share", "_ms", "overhead", "scale_events", "drops")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

@@ -1,0 +1,652 @@
+"""Fleet-fast serving (ISSUE 17): generated-token suffix caching
+(scheduler chain insert + chat-surface re-encode round trip), cache-aware
+gateway routing with KV-headroom spill, quarantine heal-by-probe,
+SLO-driven autoscaling, drain-before-kill scale-down under live SSE
+streams, and the deterministic mixed-tenant load generator — plus the
+knob-off defaults that keep the PR 16 wire byte-identical.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.llm.data import BOS, SEP, ByteTokenizer, RoundTripByteTokenizer
+from fedml_tpu.llm.federated import build_llm
+from fedml_tpu.serving.autoscale import (Autoscaler, FleetSLOView, Gateway,
+                                         ReplicaSet, SLOPolicy)
+from fedml_tpu.serving.batch import DecodeScheduler
+from fedml_tpu.serving.llm_template import (CausalLMPredictor,
+                                            ChatCompletionRunner)
+
+pytestmark = pytest.mark.serving
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+
+def _args(**kw):
+    base = dict(dataset="llm_synthetic", model="causal_lm",
+                client_num_in_total=2, client_num_per_round=2,
+                comm_round=1, epochs=1, batch_size=4, learning_rate=1e-3,
+                random_seed=3, llm_hidden_size=32, llm_num_layers=2,
+                llm_num_heads=2, llm_intermediate_size=64,
+                llm_max_seq_len=128, lora_rank=4)
+    base.update(kw)
+    return Arguments(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    args = _args()
+    _, bundle, _, tok = build_llm(args)
+    params = bundle.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    return args, bundle, params, tok
+
+
+def _sched(bundle, **kw):
+    opts = dict(slots=4, block_size=8, prefill_chunk=8)
+    opts.update(kw)
+    return DecodeScheduler(bundle.module, bundle.cfg, bundle.base_params,
+                           None, **opts)
+
+
+def _run(sched, ids, n=6, seed=0, temp=0.0, final=True):
+    slot, first = sched.admit(ids, seed=seed, temperature=temp,
+                              max_new_tokens=n)
+    out = [first]
+    for _ in range(n - 1):
+        out.append(sched.step()[slot])
+    if final:
+        sched.release(slot, final_ids=list(ids) + out)
+    else:
+        sched.release(slot)
+    return out
+
+
+# ------------------------------------------------------------ tokenizer ----
+
+class TestRoundTripTokenizer:
+    def test_exact_inverse_over_every_byte_token(self):
+        tok = RoundTripByteTokenizer()
+        ids = list(range(4, 260))          # every byte token
+        assert tok.encode(tok.decode(ids)) == ids
+        # invalid UTF-8 runs — the sequences an untrained model emits
+        bad = [244, 199, 132, 250, 250]
+        assert tok.encode(tok.decode(bad)) == bad
+
+    def test_matches_byte_tokenizer_on_valid_utf8(self):
+        lossy, exact = ByteTokenizer(), RoundTripByteTokenizer()
+        for text in ("hello fleet", "héllo — ünïcode", "日本語"):
+            assert exact.encode(text) == lossy.encode(text)
+            assert exact.decode(exact.encode(text)) == text
+
+    def test_lone_surrogates_survive_the_json_wire(self):
+        tok = RoundTripByteTokenizer()
+        ids = [119, 244, 199, 132, 120]
+        text = tok.decode(ids)
+        back = json.loads(json.dumps({"content": text}).encode())["content"]
+        assert back == text and tok.encode(back) == ids
+
+
+# -------------------------------------------- scheduler-level suffix cache ----
+
+class TestSuffixScheduler:
+    def test_followup_aliases_generated_blocks(self, setup):
+        _, bundle, _, tok = setup
+        sched = _sched(bundle, prefix_cache=True, suffix_cache=True)
+        ids = [BOS] + tok.encode("suffix caching turn one, long enough "
+                                 "to span KV blocks") + [SEP]
+        out = _run(sched, ids, n=16, seed=5)
+        idx = sched._index
+        assert idx.debug_state().get("decode_blocks", 0) >= 1
+        # follow-up: prior prompt ++ generated reply ++ new user turn
+        ids2 = ids + out + tok.encode("\nand turn two") + [SEP]
+        before = idx.suffix_tokens_reused
+        slot, _ = sched.admit(ids2, seed=6, temperature=0.0,
+                              max_new_tokens=4)
+        assert idx.suffix_hits >= 1
+        assert idx.suffix_tokens_reused > before
+        sched.release(slot)
+
+    def test_suffix_reuse_is_bit_identical(self, setup):
+        _, bundle, _, tok = setup
+        warm = _sched(bundle, prefix_cache=True, suffix_cache=True)
+        ids = [BOS] + tok.encode("bit identity over aliased decode "
+                                 "blocks must hold exactly") + [SEP]
+        out = _run(warm, ids, n=16, seed=9)
+        ids2 = ids + out + tok.encode("\nsecond turn") + [SEP]
+        reused = _run(warm, ids2, n=8, seed=11)
+        assert warm._index.suffix_hits >= 1
+        cold = _sched(bundle, prefix_cache=False, suffix_cache=False)
+        ref = _run(cold, ids2, n=8, seed=11, final=False)
+        assert reused == ref
+
+    def test_short_chain_never_indexes_partial_blocks(self, setup):
+        _, bundle, _, tok = setup
+        sched = _sched(bundle, prefix_cache=True, suffix_cache=True)
+        ids = [BOS] + tok.encode("ti") + [SEP]
+        _run(sched, ids, n=3, seed=1)   # 4 + 3 < block_size: no full block
+        assert sched._index.debug_state().get("decode_blocks", 0) == 0
+
+    def test_knob_off_keeps_legacy_release_and_no_decode_blocks(self, setup):
+        _, bundle, _, tok = setup
+        sched = _sched(bundle, prefix_cache=True)
+        assert sched.suffix_cache is False
+        ids = [BOS] + tok.encode("knob off path stays put") + [SEP]
+        out = _run(sched, ids, n=16, seed=2, final=False)
+        assert len(out) == 16
+        assert sched._index.debug_state().get("decode_blocks", 0) == 0
+        assert sched._index.suffix_hits == 0
+
+
+# ------------------------------------------------ chat-surface suffix cache ----
+
+@pytest.fixture(scope="module")
+def suffix_pred(setup):
+    _, bundle, params, tok = setup
+    pred = CausalLMPredictor(bundle, params, tokenizer=tok, mode="batch",
+                             stream=True,
+                             batch_opts={"slots": 4, "block_size": 8,
+                                         "prefill_chunk": 8,
+                                         "prefix_cache": True,
+                                         "suffix_cache": True})
+    yield pred
+    pred.close()
+
+
+def _chat(pred, messages, max_tokens=16, seed=7, stream=False):
+    req = {"messages": messages, "max_tokens": max_tokens,
+           "temperature": 0.0, "seed": seed}
+    if stream:
+        req["stream"] = True
+        acc, usage = "", None
+        for ev in pred.chat(req).events:
+            ch = ev["choices"][0]
+            acc += ch["delta"].get("content", "")
+            if ch.get("finish_reason"):
+                usage = ch.get("usage")
+        return acc, usage
+    out = pred.chat(req)
+    return (out["choices"][0]["message"]["content"], out["usage"])
+
+
+class TestSuffixChatSurface:
+    MSGS = [{"role": "system", "content": "you are the fleet test bot"},
+            {"role": "user", "content": "say something"}]
+
+    def test_multi_turn_followup_hits_suffix_cache(self, suffix_pred):
+        idx = suffix_pred.engine.scheduler._index
+        reply, usage = _chat(suffix_pred, self.MSGS, seed=7)
+        assert usage["completion_tokens"] > 0
+        h0, t0 = idx.suffix_hits, idx.suffix_tokens_reused
+        msgs2 = self.MSGS + [
+            {"role": "assistant", "content": reply},
+            {"role": "user", "content": "and again please"}]
+        _chat(suffix_pred, msgs2, seed=8)
+        assert idx.suffix_hits > h0
+        assert idx.suffix_tokens_reused > t0
+
+    def test_stream_deltas_reencode_to_generated_ids(self, suffix_pred):
+        idx = suffix_pred.engine.scheduler._index
+        reply, _ = _chat(suffix_pred, self.MSGS, seed=7)
+        acc, usage = _chat(suffix_pred, self.MSGS, seed=7, stream=True)
+        # per-token lossless deltas concatenate to the non-stream reply
+        assert acc == reply and usage["completion_tokens"] > 0
+        h0 = idx.suffix_hits
+        msgs3 = self.MSGS + [
+            {"role": "assistant", "content": acc},
+            {"role": "user", "content": "third turn now"}]
+        _chat(suffix_pred, msgs3, max_tokens=8, seed=9)
+        assert idx.suffix_hits > h0
+
+    def test_warm_repeat_is_bit_identical(self, suffix_pred):
+        r1, u1 = _chat(suffix_pred, self.MSGS, seed=7)
+        r2, u2 = _chat(suffix_pred, self.MSGS, seed=7)
+        assert r1 == r2
+        assert u1["completion_tokens"] == u2["completion_tokens"]
+
+    def test_no_recompile_across_suffix_reuse(self, suffix_pred,
+                                              xla_compile_counter):
+        reply, _ = _chat(suffix_pred, self.MSGS, seed=7)   # warm programs
+        msgs2 = self.MSGS + [
+            {"role": "assistant", "content": reply},
+            {"role": "user", "content": "steady state turn"}]
+        _chat(suffix_pred, msgs2, seed=8)
+        xla_compile_counter.reset()
+        reply_b, _ = _chat(suffix_pred, [
+            {"role": "system", "content": "you are the other tenant bot"},
+            {"role": "user", "content": "different text same shapes"}],
+            seed=12)
+        _chat(suffix_pred, [
+            {"role": "system", "content": "you are the other tenant bot"},
+            {"role": "user", "content": "different text same shapes"},
+            {"role": "assistant", "content": reply_b},
+            {"role": "user", "content": "follow up"}], seed=13)
+        assert xla_compile_counter.delta() == 0
+
+    def test_tokenizer_swapped_only_when_knob_on(self, setup, suffix_pred):
+        _, bundle, params, tok = setup
+        assert isinstance(suffix_pred.tokenizer, RoundTripByteTokenizer)
+        off = CausalLMPredictor(bundle, params, tokenizer=tok, mode="batch",
+                                stream=True,
+                                batch_opts={"slots": 2, "block_size": 8,
+                                            "prefill_chunk": 8})
+        try:
+            assert off.tokenizer is tok        # knob off: untouched
+            assert off._suffix_chat is False
+            assert off.engine.scheduler.suffix_cache is False
+        finally:
+            off.close()
+
+
+# ------------------------------------------------------ cache-aware routing ----
+
+class _FakePorts:
+    def __init__(self, ports):
+        self._p = list(ports)
+
+    def ports(self, include_draining=False):
+        return list(self._p)
+
+
+def _routed_gateway(ports, monkeypatch, headroom=8, **kw):
+    gw = Gateway(_FakePorts(ports), cache_aware=True, **kw)
+    hr = dict((p, headroom) for p in ports)
+    monkeypatch.setattr(Gateway, "_replica_headroom",
+                        lambda self, port: hr.get(port), raising=True)
+    return gw, hr
+
+
+class TestCacheAwareRouting:
+    def test_same_digest_sticks_to_its_warm_replica(self, monkeypatch):
+        gw, _ = _routed_gateway([7001, 7002, 7003], monkeypatch)
+        d = gw._routing_digest({"messages": [
+            {"role": "system", "content": "tenant zero system prompt"}]})
+        assert d is not None
+        home = gw._pick_port(set(), False, digest=d)
+        for _ in range(6):   # round-robin pointer moves; the digest wins
+            assert gw._pick_port(set(), False, digest=d) == home
+        # a different digest may land elsewhere without evicting the home
+        other = gw._routing_digest({"prompt": "completely different lead"})
+        gw._pick_port(set(), False, digest=other)
+        assert gw._warm[d] == home
+
+    def test_digest_keys_on_leading_bytes_only(self):
+        gw = Gateway(_FakePorts([7001]), cache_aware=True, digest_chars=32)
+        head = "x" * 40
+        d1 = gw._routing_digest({"prompt": head + "tail one"})
+        d2 = gw._routing_digest({"prompt": head + "another tail"})
+        assert d1 == d2
+        assert gw._routing_digest({"prompt": "y" + head}) != d1
+
+    def test_saturated_warm_replica_spills_without_rehoming(self,
+                                                            monkeypatch):
+        gw, hr = _routed_gateway([7001, 7002], monkeypatch,
+                                 spill_headroom=2)
+        d = gw._routing_digest({"prompt": "sticky tenant prompt"})
+        home = gw._pick_port(set(), False, digest=d)
+        hr[home] = 0                       # saturate the home replica
+        picks = {gw._pick_port(set(), False, digest=d) for _ in range(4)}
+        assert home not in picks or len(picks) > 1   # traffic spilled
+        assert gw._warm[d] == home         # cache home NOT rehomed
+        hr[home] = 8
+        assert gw._pick_port(set(), False, digest=d) == home
+
+    def test_departed_home_rehomes_to_a_live_replica(self, monkeypatch):
+        gw, _ = _routed_gateway([7001, 7002], monkeypatch)
+        d = gw._routing_digest({"prompt": "rehome on scale-down"})
+        home = gw._pick_port(set(), False, digest=d)
+        gw.replica_set._p.remove(home)
+        fresh = gw._pick_port(set(), False, digest=d)
+        assert fresh != home and fresh in gw.replica_set.ports()
+        assert gw._warm[d] == fresh
+
+    def test_unknown_headroom_never_blocks_the_warm_pick(self, monkeypatch):
+        gw, hr = _routed_gateway([7001, 7002], monkeypatch)
+        d = gw._routing_digest({"prompt": "scrape-less replica"})
+        home = gw._pick_port(set(), False, digest=d)
+        hr[home] = None                    # no slo payload / no answer
+        assert gw._pick_port(set(), False, digest=d) == home
+
+    def test_cache_off_is_plain_round_robin(self):
+        gw = Gateway(_FakePorts([7001, 7002]))
+        assert gw.cache_aware is False
+        picks = [gw._pick_port(set(), False, digest=None)
+                 for _ in range(4)]
+        assert picks == [7001, 7002, 7001, 7002]
+        assert not gw._warm
+
+    def test_warm_map_is_lru_bounded(self, monkeypatch):
+        gw, _ = _routed_gateway([7001], monkeypatch)
+        gw._warm_cap = 8
+        for i in range(40):
+            gw._pick_port(set(), False,
+                          digest=gw._routing_digest({"prompt": f"t{i}"}))
+        assert len(gw._warm) <= 8
+
+
+# -------------------------------------------------------- quarantine heal ----
+
+class _FlappingPredictor:
+    """Stub whose /healthz flips between ok and sick on demand — the
+    flapping replica the heal probe must keep OUT of rotation."""
+
+    def __init__(self, state):
+        self._state = state
+
+    def predict(self, request):
+        return {"pong": 1}
+
+    def ready(self):
+        return True
+
+    def health(self):
+        return {"status": "ok" if self._state["ok"] else "degraded",
+                "queue_depth": 0}
+
+
+class TestQuarantineHeal:
+    def test_heal_probe_gates_rejoin_and_rearms_on_failure(self):
+        from fedml_tpu.serving import FedMLInferenceRunner
+        state = {"ok": False}
+        runner = FedMLInferenceRunner(_FlappingPredictor(state))
+        port = runner.start()
+        try:
+            gw = Gateway(_FakePorts([port]), unhealthy_ttl_s=0.05,
+                         heal_probe=True)
+            gw._mark_unhealthy(port, "test")
+            time.sleep(0.1)   # TTL expired
+            # probe-gated: expiry alone does NOT rejoin a sick replica
+            assert gw._is_quarantined(port)
+            assert gw.heal() == 0          # failing probe re-arms
+            assert gw._is_quarantined(port)
+            state["ok"] = True
+            time.sleep(0.1)   # wait out the re-armed TTL
+            assert gw.heal() == 1
+            assert not gw._is_quarantined(port)
+        finally:
+            runner.stop()
+
+    def test_legacy_ttl_rejoin_with_probe_off(self):
+        gw = Gateway(_FakePorts([7009]), unhealthy_ttl_s=0.05)
+        gw._mark_unhealthy(7009, "test")
+        assert gw._is_quarantined(7009)
+        time.sleep(0.1)
+        assert not gw._is_quarantined(7009)   # timer-only rejoin
+        assert gw.heal() == 0                 # no-op with probe off
+
+
+# ------------------------------------------------------------ SLO policy ----
+
+class TestSLOPolicy:
+    def _fleet(self, **kw):
+        base = dict(ttft_p99_s=0.0, itl_p99_s=0.0, queue_depth=0,
+                    kv_headroom_min=None, gateway_p99_s=0.0, replicas=2)
+        base.update(kw)
+        return FleetSLOView(**base)
+
+    def test_each_breach_signal_scales_up(self):
+        p = SLOPolicy(ttft_p99_s=0.5, itl_p99_s=0.1,
+                      queue_depth_per_replica=4.0, kv_headroom_min=1,
+                      cooldown_s=0.0)
+        assert p.breaches(self._fleet(ttft_p99_s=0.9), 2) == ["ttft_p99"]
+        assert p.breaches(self._fleet(itl_p99_s=0.2), 2) == ["itl_p99"]
+        assert p.breaches(self._fleet(queue_depth=9), 2) == ["queue_depth"]
+        assert p.breaches(self._fleet(kv_headroom_min=0), 2) \
+            == ["kv_headroom"]
+        assert p.desired_from_fleet(self._fleet(queue_depth=9), 2) == 3
+
+    def test_disabled_targets_never_breach(self):
+        p = SLOPolicy(ttft_p99_s=0.0, itl_p99_s=0.0,
+                      queue_depth_per_replica=0.0, kv_headroom_min=0,
+                      cooldown_s=0.0)
+        assert p.breaches(self._fleet(ttft_p99_s=99, itl_p99_s=99,
+                                      queue_depth=999,
+                                      kv_headroom_min=0), 2) == []
+
+    def test_cooldown_gates_consecutive_moves(self):
+        p = SLOPolicy(queue_depth_per_replica=4.0, cooldown_s=60.0)
+        assert p.desired_from_fleet(self._fleet(queue_depth=99), 2) == 3
+        # inside the cooldown the same breach holds the fleet
+        assert p.desired_from_fleet(self._fleet(queue_depth=99), 3) == 3
+
+    def test_idle_fleet_scales_down_one_step(self):
+        p = SLOPolicy(ttft_p99_s=1.0, queue_depth_per_replica=4.0,
+                      cooldown_s=0.0)
+        idle = self._fleet(ttft_p99_s=0.01, queue_depth=0)
+        assert p.desired_from_fleet(idle, 3) == 2
+        assert p.desired_from_fleet(idle, 1) == 1   # never below one
+        # near-target tails are NOT idle: hold
+        warm = self._fleet(ttft_p99_s=0.8, queue_depth=0)
+        assert p.desired_from_fleet(warm, 3) == 3
+
+    def test_legacy_signature_feeds_the_gateway_tail(self):
+        p = SLOPolicy(ttft_p99_s=0.5, cooldown_s=0.0)
+        assert p.desired_replicas(10.0, 0.9, 2) == 3
+        assert p.desired_replicas(10.0, 0.01, 2) == 1
+
+
+class TestAutoscalerFleetLoop:
+    def test_slo_step_scales_on_queue_and_headroom(self):
+        state = {"ok": True}
+
+        class _Busy(_FlappingPredictor):
+            def health(self):
+                return {"status": "ok", "queue_depth": state["queue"],
+                        "slo": {"ttft_p99_s": 0.0, "ttft_n": 0,
+                                "itl_p99_s": 0.0, "itl_n": 0,
+                                "kv_headroom_requests": state["headroom"]}}
+
+        state.update(queue=0, headroom=8)
+        rs = ReplicaSet(lambda: _Busy(state), min_replicas=1,
+                        max_replicas=3)
+        gw = Gateway(rs)
+        asc = Autoscaler(gw, SLOPolicy(queue_depth_per_replica=4.0,
+                                       kv_headroom_min=1, cooldown_s=0.0))
+        try:
+            state["queue"] = 9            # queue breach -> +1
+            assert asc.step() == 2
+            # the scrape feeding the move saw the pre-scale single replica
+            assert asc.last_fleet.queue_depth == 9
+            state["queue"] = 0
+            state["headroom"] = 0         # saturation breach -> +1
+            assert asc.step() == 3
+            assert asc.last_fleet.kv_headroom_min == 0
+            state["headroom"] = 8         # idle fleet drains back
+            assert asc.step() == 2
+            assert asc.scale_events == 3
+        finally:
+            rs.stop()
+
+
+# ------------------------------------- drain-before-kill under live streams ----
+
+class TestFleetDrainZeroDrops:
+    def _stream(self, gw, results, i):
+        acc, finish, usage = "", None, None
+        try:
+            for ev in gw.stream({"messages": [
+                    {"role": "system", "content": "drain test bot"},
+                    {"role": "user", "content": f"stream {i} please"}],
+                    "stream": True, "max_tokens": 6, "temperature": 0.0,
+                    "seed": 40 + i}, timeout=120.0):
+                ch = json.loads(ev)["choices"][0]
+                acc += (ch.get("delta") or {}).get("content", "")
+                if ch.get("finish_reason"):
+                    finish = ch["finish_reason"]
+                    usage = ch.get("usage")
+            results[i] = (finish, usage, acc, None)
+        except Exception as e:  # noqa: BLE001 — recorded, asserted below
+            results[i] = (None, None, acc, e)
+
+    def test_restart_and_scale_down_drop_zero_tokens(self, setup):
+        _, bundle, params, tok = setup
+
+        def factory():
+            return CausalLMPredictor(
+                bundle, params, tokenizer=tok, mode="batch", stream=True,
+                batch_opts={"slots": 4, "block_size": 8,
+                            "prefill_chunk": 8, "prefix_cache": True,
+                            "suffix_cache": True})
+
+        rs = ReplicaSet(predictor_factory=factory, min_replicas=1,
+                        max_replicas=2, runner_cls=ChatCompletionRunner,
+                        drain_grace_s=30.0)
+        try:
+            rs.scale_to(2)
+            gw = Gateway(rs)
+            for i in range(2):   # warm both replicas' programs
+                gw.predict({"messages": [
+                    {"role": "user", "content": "warm up"}],
+                    "max_tokens": 2, "temperature": 0.0, "seed": 1},
+                    timeout=120.0, path="/v1/chat/completions")
+
+            # live streams across a rolling drain-restart
+            results = {}
+            ths = [threading.Thread(target=self._stream,
+                                    args=(gw, results, i))
+                   for i in range(3)]
+            for t in ths:
+                t.start()
+            time.sleep(0.3)
+            rs.rolling_restart(grace_s=2.0)
+            for t in ths:
+                t.join(timeout=120)
+            assert len(results) == 3
+            for finish, usage, acc, err in results.values():
+                assert err is None, err
+                assert finish in ("stop", "length")
+                assert usage["completion_tokens"] >= 1
+                assert len(acc) >= 1
+
+            # live streams across a drain-before-kill scale-down
+            results = {}
+            ths = [threading.Thread(target=self._stream,
+                                    args=(gw, results, i))
+                   for i in range(3)]
+            for t in ths:
+                t.start()
+            time.sleep(0.3)
+            rs.scale_to(1)          # uses the set's drain grace
+            for t in ths:
+                t.join(timeout=120)
+            assert len(rs) == 1
+            assert len(results) == 3
+            for finish, usage, acc, err in results.values():
+                assert err is None, err
+                assert finish in ("stop", "length")
+                assert usage["completion_tokens"] >= 1
+        finally:
+            rs.stop()
+
+
+# --------------------------------------------------------- load generator ----
+
+class TestServingLoadGenerator:
+    def test_schedule_is_deterministic_and_tenant_interleaved(self):
+        import serving_load
+        spec = serving_load.LoadSpec(tenants=3, sessions_per_tenant=2,
+                                     turns_per_session=2, seed=5)
+        a = serving_load.build_sessions(spec)
+        b = serving_load.build_sessions(spec)
+        assert a == b
+        assert len(a) == spec.total_sessions
+        offs = [s["arrival_s"] for s in a]
+        assert offs == sorted(offs)
+        assert [s["tenant"] for s in a[:3]] == [0, 1, 2]   # interleaved
+        c = serving_load.build_sessions(
+            serving_load.LoadSpec(tenants=3, sessions_per_tenant=2,
+                                  turns_per_session=2, seed=6))
+        assert [s["arrival_s"] for s in c] != offs   # seed moves arrivals
+
+    def test_multi_turn_feeds_replies_back(self):
+        import serving_load
+        spec = serving_load.LoadSpec(tenants=2, sessions_per_tenant=1,
+                                     turns_per_session=3, seed=0,
+                                     mean_gap_s=0.0)
+        seen = []
+        lock = threading.Lock()
+
+        def send(messages, meta):
+            with lock:
+                seen.append([dict(m) for m in messages])
+            return f"reply-{meta['tenant']}-{meta['turn']}"
+
+        recs = serving_load.run_load(send, spec, concurrency=2)
+        assert len(recs) == spec.total_requests
+        assert all(r["ok"] for r in recs)
+        turn3 = [m for m in seen if sum(
+            1 for x in m if x["role"] == "user") == 3]
+        assert turn3   # third turns carry BOTH prior assistant replies
+        for msgs in turn3:
+            replies = [x["content"] for x in msgs
+                       if x["role"] == "assistant"]
+            assert len(replies) == 2 and all(
+                r.startswith("reply-") for r in replies)
+
+    def test_turn_chars_pads_with_session_unique_filler(self):
+        import serving_load
+        spec = serving_load.LoadSpec(tenants=2, sessions_per_tenant=2,
+                                     turns_per_session=2, seed=0,
+                                     turn_chars=300)
+        a = serving_load.build_sessions(spec)
+        assert a == serving_load.build_sessions(spec)   # deterministic
+        turns = [t for s in a for t in s["turns"]]
+        assert all(len(t) == 300 for t in turns)
+        assert len(set(turns)) == len(turns)   # unique per (t, s, turn)
+        # beyond the shared system prompt, no two SESSIONS share a
+        # prefix — the padded body is what defeats cross-session
+        # prefix-cache aliasing in the soak's pasted-log traffic shape
+        first = [s["turns"][0] for s in a]
+        for i in range(len(first)):
+            for j in range(i + 1, len(first)):
+                assert first[i][:80] != first[j][:80]
+        # default stays the short shape — existing workloads unchanged
+        short = serving_load.user_turn(1, 2, 3)
+        assert short == serving_load.user_turn(1, 2, 3, chars=0)
+        assert len(short) < 80
+
+    def test_failed_turn_stops_its_session_only(self):
+        import serving_load
+        spec = serving_load.LoadSpec(tenants=1, sessions_per_tenant=2,
+                                     turns_per_session=3, seed=0,
+                                     mean_gap_s=0.0)
+
+        def send(messages, meta):
+            if meta["session"] == 0 and meta["turn"] == 1:
+                raise RuntimeError("boom")
+            return "ok"
+
+        recs = serving_load.run_load(send, spec, concurrency=2)
+        s0 = [r for r in recs if r["session"] == 0]
+        s1 = [r for r in recs if r["session"] == 1]
+        assert len(s0) == 2 and not s0[-1]["ok"]   # stopped after failure
+        assert len(s1) == 3 and all(r["ok"] for r in s1)
+
+
+# ---------------------------------------------------------- knob defaults ----
+
+class TestKnobDefaults:
+    def test_all_fleet_knobs_default_off(self):
+        args = _args()
+        assert args.llm_suffix_cache is False
+        assert args.serving_cache_aware_routing is False
+        assert args.serving_slo_ttft_p99_s == 0.0
+        assert args.serving_slo_itl_p99_s == 0.0
+        assert args.serving_drain_grace_s == 0.0
+
+    def test_gateway_and_scheduler_defaults_match_pr16(self, setup):
+        _, bundle, _, _ = setup
+        gw = Gateway(_FakePorts([7001]))
+        assert gw.cache_aware is False and gw.heal_probe is False
+        sched = _sched(bundle)
+        assert sched.suffix_cache is False
+        rs = ReplicaSet.__new__(ReplicaSet)
+        assert getattr(rs, "drain_grace_s", 0.0) == 0.0
