@@ -159,6 +159,27 @@ _SCHEMA: Dict[str, Any] = {
     "oort_explore_frac": 0.1,        # cohort fraction exploring new clients
     "oort_alpha": 2.0,               # system-utility latency exponent
     "oort_pref_latency_s": 0.0,      # 0 = observed median latency
+    # fleet_args — durable multi-tenant fleet plane (core/fleet; ISSUE
+    # 18). ALL off by default: no registry file is opened and the
+    # single-tenant cohort path stays bit-identical.
+    # sqlite registry path (None = in-memory only, the amnesiac PR 15
+    # behavior); servers sharing one path are tenants of one fleet
+    "fleet_registry": None,
+    # this server's task name in the registry (None = "train" for the
+    # FL server, "fa" for the analytics server)
+    "fleet_task_id": None,
+    # per-device fairness: at most this many participations (any task)
+    # in the trailing window (0 = uncapped); one-task-per-round is
+    # always enforced by the registry's claims table
+    "fleet_max_rounds_per_window": 0,
+    "fleet_fairness_window_s": 3600.0,
+    # pacer-driven cohort sizing (Oort: grow k when the cohort's
+    # aggregate statistical utility saturates; off = k never moves)
+    "pacer_adapt_cohort": False,
+    "pacer_util_window": 4,          # rounds per utility comparison window
+    "pacer_util_saturation": 0.05,   # relative improvement below = plateau
+    "pacer_min_cohort_scale": 1.0,   # k multiplier bounds
+    "pacer_max_cohort_scale": 4.0,
     # cross-silo: a timed-out round aggregates only if at least
     # ceil(frac * expected) silos reported; below quorum the server keeps
     # waiting (another timeout interval) instead of averaging a sliver

@@ -407,6 +407,28 @@ def record_cohort_assembly(wall_s: float, scanned: int, eligible: int,
                            float(over_sample))
 
 
+def record_fleet_round(task_id: str, cohort: int, denied_busy: int,
+                       denied_cap: int) -> None:
+    """Multi-tenant fleet-plane seam (core/fleet): per-task selected
+    devices plus the fairness arbiter's denial counts — ``busy`` is the
+    one-task-per-round rule firing, ``cap`` the trailing-window
+    participation cap. A healthy single-tenant fleet shows zero of
+    both; a saturated multi-tenant one shows busy denials growing."""
+    if not _cfg["enabled"]:
+        return
+    c = REGISTRY.counter("fed_fleet_devices_total",
+                         "fleet-plane per-task device decisions",
+                         labels=("task", "outcome"))
+    c.inc(int(cohort), task=str(task_id), outcome="selected")
+    if denied_busy:
+        c.inc(int(denied_busy), task=str(task_id), outcome="denied_busy")
+    if denied_cap:
+        c.inc(int(denied_cap), task=str(task_id), outcome="denied_cap")
+    REGISTRY.gauge("fed_fleet_cohort_size",
+                   "devices granted to the most recent fleet round",
+                   labels=("task",)).set(int(cohort), task=str(task_id))
+
+
 def record_checkpoint_flush(wall_s: float) -> None:
     if not _cfg["enabled"]:
         return

@@ -21,7 +21,11 @@ cross-device plane, shaped so no step ever materializes the population:
 * :class:`DeadlinePacer` — adjusts the round deadline and the cohort
   over-sample factor from observed (completed, expected, wall) outcomes:
   under-delivering rounds stretch the deadline and over-sample harder,
-  comfortably-early rounds tighten both. A pure function of the
+  comfortably-early rounds tighten both. With ``pacer_adapt_cohort`` it
+  also moves the cohort size k itself (Oort §5's pacer rule): when the
+  aggregate statistical utility of consecutive windows saturates, grow
+  k to harvest more parallelism per round; while utility is still
+  climbing, decay back toward the configured k. A pure function of the
   observation history (no RNG), so trajectories are replayable.
 
 Scoring adds a tiny seeded per-id jitter — a hash of ``(seed, round,
@@ -200,6 +204,15 @@ class DeadlinePacer:
     max_deadline_s: float = 3600.0
     max_over_sample: float = 3.0
     rounds_observed: int = field(default=0)
+    # --- utility-driven cohort sizing (pacer_adapt_cohort; off = the
+    # configured k never moves — paced_cohort() is the identity) -------
+    adapt_cohort: bool = False
+    cohort_scale: float = 1.0
+    min_cohort_scale: float = 1.0
+    max_cohort_scale: float = 4.0
+    util_window: int = 4
+    util_saturation: float = 0.05
+    _util_hist: List[float] = field(default_factory=list)
 
     @classmethod
     def from_args(cls, args) -> "DeadlinePacer":
@@ -219,7 +232,16 @@ class DeadlinePacer:
             max_deadline_s=float(getattr(args, "pacer_max_deadline_s",
                                          3600.0) or 3600.0),
             max_over_sample=float(getattr(args, "pacer_max_over_sample",
-                                          3.0) or 3.0))
+                                          3.0) or 3.0),
+            adapt_cohort=bool(getattr(args, "pacer_adapt_cohort", False)),
+            min_cohort_scale=float(getattr(args, "pacer_min_cohort_scale",
+                                           1.0) or 1.0),
+            max_cohort_scale=float(getattr(args, "pacer_max_cohort_scale",
+                                           4.0) or 4.0),
+            util_window=max(int(getattr(args, "pacer_util_window", 4)
+                                or 4), 1),
+            util_saturation=float(getattr(args, "pacer_util_saturation",
+                                          0.05) or 0.05))
 
     def target_cohort(self, k: int, ceiling: Optional[int] = None) -> int:
         """Over-sampled dispatch size for a wanted cohort of ``k``."""
@@ -227,6 +249,43 @@ class DeadlinePacer:
         if ceiling is not None:
             t = min(t, int(ceiling))
         return max(t, 1)
+
+    def paced_cohort(self, k: int) -> int:
+        """The live cohort size for a configured k: identity unless
+        ``adapt_cohort`` is on, else k scaled by the utility-driven
+        ``cohort_scale`` (bounded; callers still ceiling by population)."""
+        k = max(int(k), 1)
+        if not self.adapt_cohort:
+            return k
+        return max(int(round(k * self.cohort_scale)), 1)
+
+    def observe_utility(self, utility: float) -> None:
+        """One round's aggregate statistical utility (the assembled
+        cohort's summed scores). Every ``util_window`` observations the
+        pacer compares the window mean against the previous window:
+        saturation (no relative improvement past ``util_saturation``)
+        grows the cohort scale — more devices per round keep progress
+        moving once per-device utility plateaus (Oort's rule) — while a
+        still-improving utility decays the scale back toward 1× (the
+        configured k already harvests well). No-op when adaptation is
+        off, so default-path trajectories carry no hidden state."""
+        if not self.adapt_cohort:
+            return
+        self._util_hist.append(float(utility))
+        w = self.util_window
+        if len(self._util_hist) < 2 * w:
+            return
+        prev = float(np.mean(self._util_hist[-2 * w:-w]))
+        cur = float(np.mean(self._util_hist[-w:]))
+        rel = (cur - prev) / max(abs(prev), 1e-12)
+        if rel <= self.util_saturation:
+            self.cohort_scale = min(self.cohort_scale * (1.0 + self.step),
+                                    self.max_cohort_scale)
+        else:
+            self.cohort_scale = max(self.cohort_scale * (1.0 - self.step / 2),
+                                    self.min_cohort_scale)
+        # the decided-on window becomes the next comparison's baseline
+        self._util_hist = self._util_hist[-w:]
 
     def observe_round(self, completed: int, expected: int,
                       wall_s: float) -> None:
@@ -249,11 +308,27 @@ class DeadlinePacer:
                                    1.0)
 
     def state_dict(self) -> dict:
+        # util_hist rides as a FIXED [2 * util_window] NaN-padded array:
+        # template-based checkpoint restores (orbax-style) need stable
+        # shapes between save and resume
+        hist = np.full(2 * self.util_window, np.nan, np.float64)
+        tail = self._util_hist[-len(hist):]
+        if tail:
+            hist[:len(tail)] = tail
         return {"deadline_s": np.float64(self.deadline_s),
                 "over_sample": np.float64(self.over_sample),
-                "rounds_observed": np.int64(self.rounds_observed)}
+                "rounds_observed": np.int64(self.rounds_observed),
+                "cohort_scale": np.float64(self.cohort_scale),
+                "util_hist": hist}
 
     def load_state_dict(self, state: dict) -> None:
         self.deadline_s = float(state["deadline_s"])
         self.over_sample = float(state["over_sample"])
         self.rounds_observed = int(state["rounds_observed"])
+        # cohort-sizing fields postdate checkpoints in the wild: absent
+        # means "resume with the configured scale", never a refusal
+        if "cohort_scale" in state:
+            self.cohort_scale = float(state["cohort_scale"])
+        if "util_hist" in state:
+            hist = np.asarray(state["util_hist"], np.float64).reshape(-1)
+            self._util_hist = [float(v) for v in hist[np.isfinite(hist)]]

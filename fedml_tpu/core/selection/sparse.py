@@ -326,6 +326,11 @@ class SparseClientStatsStore:
     def num_touched(self) -> int:
         return self._size
 
+    def touched_ids(self) -> np.ndarray:
+        """Ascending ids of ever-touched clients — O(size log size) on
+        this backend (the row → id map IS the answer), never O(n)."""
+        return np.sort(self.ids[:self._size].astype(np.int64))
+
     # --- pooled / whole-population queries ----------------------------------
     def dropout_posterior_mean(self,
                                ids: Optional[Iterable[int]] = None

@@ -295,6 +295,12 @@ class ClientStatsStore:
         backend's answer is a scan; the sparse backend's is its size."""
         return int(np.sum(self._touched_mask()))
 
+    def touched_ids(self) -> np.ndarray:
+        """Ascending ids of clients carrying ANY observed evidence — the
+        fleet plane's restart diagnostics (which devices does a resumed
+        posture actually remember?). Dense backend: a scan."""
+        return np.flatnonzero(self._touched_mask()).astype(np.int64)
+
     def _touched_mask(self) -> np.ndarray:
         return ((self.loss_count > 0) | (self.part_obs > 0)
                 | (self.drop_obs > 0) | (self.incl_obs + self.excl_obs > 0)

@@ -153,6 +153,73 @@ class DeviceServerManager(FedMLCommManager):
             self.required_elig = required_eligibility(args)
             self.cohort_k = int(getattr(args, "cohort_size", 0) or 0) \
                 or self.expected_devices
+        self._round_k = getattr(self, "cohort_k", self.expected_devices)
+        self._round_utility = 0.0
+        # per-round (round_idx, cohort) trail — restart-and-resume tests
+        # assert a restarted server replays these identically
+        self.cohort_log: list = []
+        # --- durable fleet plane (fleet_registry knob; off = the
+        # in-memory single-tenant path above, bit-identical). The sqlite
+        # registry remembers every device across restarts, the fairness
+        # tables arbitrate concurrent tasks sharing the file, and the
+        # checkpointed stats/pacer posture makes a restarted server
+        # resume the learned fleet posture instead of re-learning it.
+        self.fleet = None
+        self.fleet_task = str(getattr(args, "fleet_task_id", "") or "train")
+        reg_path = getattr(args, "fleet_registry", None)
+        if reg_path:
+            from ..core.fleet import DeviceRegistry
+            self.fleet = DeviceRegistry(str(reg_path))
+            self.fleet_cap = int(getattr(args,
+                                         "fleet_max_rounds_per_window", 0)
+                                 or 0)
+            self.fleet_window_s = float(getattr(
+                args, "fleet_fairness_window_s", 3600.0) or 3600.0)
+            if self.cohort_enabled:
+                self._fleet_restore()
+
+    # --- durable fleet plane -----------------------------------------------
+    def _fleet_restore(self) -> None:
+        """Resume the persisted control-plane posture: the fleet-wide
+        stats snapshot, this task's pacer, and its round cursor. A fresh
+        registry has none of them — start cold, exactly like fleet-off."""
+        st = self.fleet.load_state("fleet:stats")
+        if st is not None:
+            try:
+                self.stats.load_state_dict(st)
+            except ValueError as e:
+                logger.warning("fleet: persisted stats incompatible with "
+                               "this population (%s) — resuming cold", e)
+        pst = self.fleet.load_state(f"fleet:pacer:{self.fleet_task}")
+        if pst is not None:
+            self.pacer.load_state_dict(pst)
+        sst = self.fleet.load_state(f"fleet:server:{self.fleet_task}")
+        if sst is not None:
+            self.round_idx = int(sst["round_idx"])
+            if "model" in sst:
+                from ..core.distributed.communication.message import \
+                    loads_tree
+                self.aggregator.global_params = loads_tree(
+                    sst["model"].tobytes())
+            logger.info(
+                "fleet: task %r resumes at round %d (%d devices "
+                "remembered)", self.fleet_task, self.round_idx,
+                self.fleet.device_count())
+
+    def _fleet_save(self) -> None:
+        """Checkpoint the control plane after every closed round — a
+        crash between rounds restarts into the NEXT round with the
+        learned posture AND the aggregated global model intact (the
+        model rides along as a wire-codec blob, never pickle)."""
+        from ..core.distributed.communication.message import dumps_tree
+        self.fleet.save_state("fleet:stats", self.stats.state_dict())
+        self.fleet.save_state(f"fleet:pacer:{self.fleet_task}",
+                              self.pacer.state_dict())
+        blob = np.frombuffer(dumps_tree(self.aggregator.global_params),
+                             dtype=np.uint8)
+        self.fleet.save_state(f"fleet:server:{self.fleet_task}",
+                              {"round_idx": np.int64(self.round_idx),
+                               "model": blob})
 
     # --- FSM ---------------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -162,6 +229,13 @@ class DeviceServerManager(FedMLCommManager):
             DeviceMessage.MSG_TYPE_D2S_MODEL, self.handle_device_model)
 
     def handle_register(self, msg: Message) -> None:
+        # idempotent: a device re-registering under the same id (network
+        # flap, app restart) refreshes its eligibility in place — the
+        # online-table slot is keyed by id, the registry write is an
+        # UPSERT preserving first_seen/participation, and the
+        # is_initialized guard below keeps a re-register from dispatching
+        # a second session. Its stats history is keyed by id in the
+        # stats store and is never touched here.
         did = int(msg.get(DeviceMessage.ARG_DEVICE_ID))
         self.devices_online[did] = {
             "os": msg.get(DeviceMessage.ARG_DEVICE_OS, "?"),
@@ -174,6 +248,8 @@ class DeviceServerManager(FedMLCommManager):
             "unmetered": bool(msg.get(DeviceMessage.ARG_DEVICE_UNMETERED,
                                       True)),
         }
+        if self.fleet is not None:
+            self.fleet.register(did, self.devices_online[did])
         logger.info("server: device %d online (%s/%s), %d/%d", did,
                     self.devices_online[did]["os"],
                     self.devices_online[did]["engine"],
@@ -181,6 +257,13 @@ class DeviceServerManager(FedMLCommManager):
         if (len(self.devices_online) >= self.expected_devices
                 and not self.is_initialized):
             self.is_initialized = True
+            if self.round_idx >= self.round_num:
+                # fleet-resumed past the final round: the session this
+                # registry remembers already completed
+                logger.info("fleet: session already complete at round %d",
+                            self.round_idx)
+                self.finish_session()
+                return
             mlops.log_aggregation_status("RUNNING")
             self._dispatch_round(DeviceMessage.MSG_TYPE_S2D_INIT)
 
@@ -199,25 +282,74 @@ class DeviceServerManager(FedMLCommManager):
         if not self.cohort_enabled:
             return online
         from ..core import mlops
+        from ..core.obs import metrics as obs_metrics
         from ..core.selection.cohort import eligible_mask
-        target = self.pacer.target_cohort(self.cohort_k,
-                                          ceiling=len(online))
+        k = self.pacer.paced_cohort(self.cohort_k)
+        self._round_k = k
+        target = self.pacer.target_cohort(k, ceiling=len(online))
         ids = np.asarray(online, np.int64)
         metas = [self.devices_online[d] for d in online]
         mask = eligible_mask(metas, self.required_elig)
 
         def elig(chunk: np.ndarray) -> np.ndarray:
-            # the online table is one in-memory chunk here; a
-            # registry-backed deployment pages through its device table
+            # the online table is one in-memory chunk here; the fleet
+            # path below pages the persistent registry instead
             pos = np.searchsorted(ids, chunk)
             return mask[pos]
 
+        candidates = [ids]
+        eligible_fn = elig
+        if self.fleet is not None:
+            # registry-backed candidates: page every device the fleet
+            # has EVER heard from (chunked — the population is never
+            # materialized), sieved by liveness (must be online right
+            # now to receive a dispatch), the handshake predicate, and
+            # the trailing-window fairness cap
+            candidates = self.fleet.iter_id_chunks(self.assembler.chunk)
+
+            def fleet_elig(chunk: np.ndarray) -> np.ndarray:
+                pos = np.searchsorted(ids, chunk)
+                pos = np.minimum(pos, max(len(ids) - 1, 0))
+                m = (len(ids) > 0) & (ids[pos] == chunk) & mask[pos]
+                if self.fleet_cap and m.any():
+                    counts = self.fleet.participation_counts(
+                        chunk[m], self.fleet_window_s)
+                    keep = counts < self.fleet_cap
+                    m[np.flatnonzero(m)] = keep
+                return m
+
+            eligible_fn = fleet_elig
         res = self.assembler.assemble(
-            self.round_idx, target, [ids], eligible_fn=elig,
+            self.round_idx, target, candidates, eligible_fn=eligible_fn,
             deadline_s=self.pacer.deadline_s,
             over_sample=self.pacer.over_sample)
         cohort = sorted(res.cohort)
+        self._round_utility = (float(np.sum(res.scores))
+                               if res.scores is not None
+                               and len(res.scores) else 0.0)
+        if self.fleet is not None and cohort:
+            # atomic multi-tenant arbitration: a concurrent task sharing
+            # the registry cannot co-schedule a device this round
+            granted, busy, capped = self.fleet.claim(
+                self.fleet_task, cohort, self.round_idx,
+                cap=self.fleet_cap, window_s=self.fleet_window_s)
+            obs_metrics.record_fleet_round(self.fleet_task, len(granted),
+                                           busy, capped)
+            if busy or capped:
+                logger.info(
+                    "fleet round %d: %d denied busy, %d denied by the "
+                    "participation cap", self.round_idx, busy, capped)
+            cohort = sorted(granted)
         if not cohort:
+            if self.fleet is not None:
+                # fairness denials are binding — never bulldoze the cap
+                # by falling back to the whole online table; the dead-
+                # round leash closes this round and the next one retries
+                logger.warning(
+                    "fleet round %d: no claimable device — empty round",
+                    self.round_idx)
+                self.cohort_log.append((self.round_idx, []))
+                return []
             logger.warning(
                 "cohort assembly round %d: no eligible device of %d "
                 "online — dispatching to every online device",
@@ -235,6 +367,7 @@ class DeviceServerManager(FedMLCommManager):
             "(deadline %.1fs, over-sample %.2f, assembly %.2fms)",
             self.round_idx, res.eligible, len(online), len(cohort),
             self.pacer.deadline_s, self.pacer.over_sample, res.wall_ms)
+        self.cohort_log.append((self.round_idx, list(cohort)))
         return cohort
 
     def _round_deadline_s(self) -> float:
@@ -252,9 +385,10 @@ class DeviceServerManager(FedMLCommManager):
         with self._lock:
             self._round_closed = False
             self._cohort = list(cohort)
-            # cohort mode: the barrier closes on the WANTED k, not the
-            # over-sampled dispatch width — first k reports win
-            self._barrier = (min(self.cohort_k, len(cohort))
+            # cohort mode: the barrier closes on the WANTED k (the
+            # pacer-scaled live k, when cohort adaptation is on), not
+            # the over-sampled dispatch width — first k reports win
+            self._barrier = (min(self._round_k, len(cohort))
                              if self.cohort_enabled
                              else self.aggregator.client_num)
             self.aggregator.set_round_expected(self._barrier)
@@ -392,6 +526,19 @@ class DeviceServerManager(FedMLCommManager):
                 completed=len(reported),
                 expected=self._barrier,
                 wall_s=max(time.time() - self._dispatch_ts, 0.0))
+            # cohort-size adaptation (pacer_adapt_cohort; no-op off):
+            # the assembled cohort's aggregate utility is the
+            # saturation signal that grows/shrinks the live k
+            self.pacer.observe_utility(self._round_utility)
+            if self.fleet is not None:
+                # close the fleet round: claims released, participation
+                # recorded for the devices that actually served,
+                # last_heard refreshed
+                served = sorted(reported)
+                self.fleet.release(self.fleet_task, self.round_idx,
+                                   served)
+                if served:
+                    self.fleet.touch(served)
         self.aggregator.aggregate()
 
     def _advance_round(self) -> None:
@@ -408,6 +555,8 @@ class DeviceServerManager(FedMLCommManager):
         self.history.append(rec)
         mlops.log_round_info(self.round_num, self.round_idx)
         self.round_idx += 1
+        if self.fleet is not None and self.cohort_enabled:
+            self._fleet_save()
         if self.round_idx >= self.round_num:
             self.finish_session()
             return

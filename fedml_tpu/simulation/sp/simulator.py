@@ -68,6 +68,13 @@ class SPSimulator:
         # passive at defaults — uniform + legacy stream delegates to the
         # reference draw, trajectories stay bit-identical
         self.selection = SelectionManager(args, self.fed.num_clients)
+        # pacer-driven cohort sizing (pacer_adapt_cohort; off = the
+        # configured client_num_per_round, bit-identical): Oort's rule —
+        # grow k once the sampled cohort's summed loss utility saturates
+        self.pacer = None
+        if bool(getattr(args, "pacer_adapt_cohort", False)):
+            from ...core.selection import DeadlinePacer
+            self.pacer = DeadlinePacer.from_args(args)
         self.ckpt = RoundCheckpointer(
             getattr(args, "checkpoint_dir", None),
             int(getattr(args, "checkpoint_every_rounds", 0) or 0))
@@ -81,26 +88,31 @@ class SPSimulator:
             # selection history rides the checkpoint so crash-resume
             # replays IDENTICAL cohorts (same contract as the engine)
             st["selection"] = self.selection.state_dict()
+        if self.pacer is not None:
+            # pacer posture too: a resumed run keeps its learned cohort
+            # scale instead of re-learning the saturation point
+            st["pacer"] = self.pacer.state_dict()
         return st
 
     def _ckpt_latest(self):
         """Tolerant restore (mirrors the engine): the optional
-        ``selection`` leaf's presence can flip between save and resume
-        (knob change, version skew) — retry without it rather than
-        refusing a valid checkpoint."""
+        ``selection``/``pacer`` leaves' presence can flip between save
+        and resume (knob change, version skew) — retry without them
+        rather than refusing a valid checkpoint."""
         template = self._ckpt_state()
+        optional = [k for k in ("selection", "pacer") if k in template]
         try:
             return self.ckpt.latest(template)
         except Exception as e:
-            if "selection" not in template:
+            if not optional:
                 raise
             restored = self.ckpt.latest(
-                {k: v for k, v in template.items() if k != "selection"})
+                {k: v for k, v in template.items() if k not in optional})
             if restored is not None:
                 logger.warning(
                     "checkpoint restore succeeded only without the "
-                    "selection leaf (%s: %s) — selection history resumes "
-                    "cold", type(e).__name__, e)
+                    "optional %s leaves (%s: %s) — their history resumes "
+                    "cold", optional, type(e).__name__, e)
             return restored
 
     def _load_ckpt_state(self, st):
@@ -111,6 +123,8 @@ class SPSimulator:
         self.dp.load_state_dict(st["dp"])
         if "selection" in st and self.selection.stateful:
             self.selection.load_state_dict(st["selection"])
+        if "pacer" in st and self.pacer is not None:
+            self.pacer.load_state_dict(st["pacer"])
 
     def _client_data(self, cid: int) -> ClientData:
         return jax.tree_util.tree_map(lambda a: a[cid], self.fed.train)
@@ -182,8 +196,12 @@ class SPSimulator:
             # client_sampling draw, bit-identical); a reputation
             # strategy's benched clients are simply not trained here —
             # the SP loop has no work-0 slot channel to renormalize
+            k_round = int(args.client_num_per_round)
+            if self.pacer is not None:
+                k_round = min(self.pacer.paced_cohort(k_round),
+                              self.fed.num_clients)
             full_sampled, excluded = self.selection.select(
-                round_idx, int(args.client_num_per_round))
+                round_idx, k_round)
             excl = set(excluded)
             sampled = [c for c in full_sampled if c not in excl]
             self.selection.note_schedule(
@@ -219,6 +237,12 @@ class SPSimulator:
                     if c > 0:
                         self.selection.store.record_loss(
                             int(cid), float(m["loss_sum"]) / c)
+            if self.pacer is not None:
+                # summed per-client mean loss = the round's aggregate
+                # statistical utility (Oort); saturation moves k
+                util = sum(float(m["loss_sum"]) / max(float(m["count"]), 1.0)
+                           for m in metrics)
+                self.pacer.observe_utility(util)
             w = jnp.stack(weights)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *updates)
             agg_update = self._aggregate_robust(stacked, w, sampled,

@@ -66,13 +66,19 @@ from typing import Dict, List, Optional, Tuple
 # steady-state `recompiles` gauge (both DOWN), `scale_events` bounds
 # the SLO autoscaler's move count (DOWN — a stable fleet does not
 # staircase), `drops` the seeded chaos conn-drop count (DOWN).
+# fleet-plane additions (ISSUE 18): the multi-tenant bench's headline
+# rides `per_hour` (UP) and its assign_ms leg `_ms` (DOWN);
+# `violations` covers fairness_violations and `overlap` the
+# overlap_devices isolation column — both must stay pinned at 0, so any
+# increase is a regression (DOWN).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
                   "goodput", "success", "hit_rate", "hits", "reused",
                   "efficiency", "swaps", "attributed")
 LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles", "compiles",
                  "time_to", "step_time", "wall", "round_s",
                  "resets", "trips", "faults", "fragmentation", "ttft",
-                 "bound_share", "_ms", "overhead", "scale_events", "drops")
+                 "bound_share", "_ms", "overhead", "scale_events", "drops",
+                 "violations", "overlap")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

@@ -270,6 +270,50 @@ class TestCohortAssembly:
         lat = server.stats.latency_for([1, 2])
         assert np.all(np.isfinite(lat))
 
+    def test_reregister_is_idempotent(self, tmp_path):
+        """A device re-registering under the same id (network flap, app
+        restart) refreshes its handshake in place: no duplicate online
+        slot, no duplicate registry row, no stats reset, no second
+        session dispatch (ISSUE 18 satellite)."""
+        from fedml_tpu.core.distributed.communication.inproc import \
+            InProcBroker
+        from fedml_tpu.core.distributed.communication.message import \
+            Message
+        from fedml_tpu.cross_device import build_device_server
+        from fedml_tpu.cross_device.message_define import DeviceMessage
+
+        args = make_args(model_file_cache_dir=str(tmp_path),
+                         client_num_in_total=2, client_num_per_round=2,
+                         cohort_assembly=True,
+                         fleet_registry=str(tmp_path / "fleet.db"))
+        args.inproc_broker = InProcBroker()
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        server = build_device_server(args, fed, bundle, backend="INPROC")
+        server.stats.record_availability(1, participated=True)
+
+        def reg_msg(did, charging=True):
+            msg = Message(DeviceMessage.MSG_TYPE_D2S_REGISTER, did, 0)
+            msg.add_params(DeviceMessage.ARG_DEVICE_ID, did)
+            msg.add_params(DeviceMessage.ARG_DEVICE_OS, "test")
+            msg.add_params(DeviceMessage.ARG_DEVICE_ENGINE, "jax")
+            msg.add_params(DeviceMessage.ARG_DEVICE_CHARGING, charging)
+            return msg
+
+        server.handle_register(reg_msg(1, charging=True))
+        server.handle_register(reg_msg(1, charging=False))  # flap
+        # one online slot, refreshed in place; still waiting for dev 2
+        assert len(server.devices_online) == 1
+        assert server.devices_online[1]["charging"] is False
+        assert not server.is_initialized
+        # one registry row, counted registrations, history intact
+        row = server.fleet.device(1)
+        assert server.fleet.device_count() == 1
+        assert row["registrations"] == 2
+        assert row["charging"] is False
+        # the stats evidence recorded before the flap survived
+        assert float(server.stats.dropout_posterior_mean([1])[0]) < 0.5
+
     def test_cohort_off_is_legacy_path(self, tmp_path):
         """cohort_assembly off (default): no stats plane, every online
         device trains — the pre-PR behavior byte-for-byte."""
