@@ -266,6 +266,189 @@ def bench_time_to_acc(target_acc=0.90, max_rounds=80):
     }), flush=True)
 
 
+def _secagg_wire_leg(target_acc=0.90, rounds=40, bits=4):
+    """SecAgg-compatible lane compression column (ISSUE 19): the digits
+    FedAvg trajectory driven through the REAL secure-uplink wire math —
+    ``core/wire.field_encode`` (EF + stochastic lane quantization),
+    pairwise ``core/mpc.expand_mask`` masks, mod-p summation, and
+    ``lane_dequantize_sum`` — once over dense field vectors (the
+    frac_bits=16 layout, 4 B/coord) and once over ``bits``-bit lanes
+    (k_max=4 silos -> 5 lanes/word, 0.8 B/coord). The Bonawitz FSM
+    itself needs the ``cryptography`` package (absent here); this
+    harness is the same per-round algebra with the key agreement
+    elided, so the masked bytes and the mask-cancellation bit-exactness
+    it reports are exactly what the FSM would put on the wire.
+    Every round asserts masked-sum == unmasked quantized sum."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.algframe.client_trainer import make_trainer_spec
+    from fedml_tpu.core.algframe.local_training import run_local_sgd
+    from fedml_tpu.core.algframe.types import TrainHyper
+    from fedml_tpu.core.collectives import (tree_flatten_to_vector,
+                                            vector_to_tree_like)
+    from fedml_tpu.core.mpc import P, dequantize, expand_mask, quantize
+    from fedml_tpu.core.wire import (field_encode, lane_dequantize_sum,
+                                     plan_for, suggest_scale)
+    from fedml_tpu.cross_silo.horizontal.runner import _make_eval_fn
+    from fedml_tpu.data import load
+    from fedml_tpu.model import create
+    from fedml_tpu.optimizers.registry import create_optimizer
+
+    K = 4
+    args = Arguments(
+        dataset="digits", model="lr", client_num_in_total=K,
+        client_num_per_round=K, comm_round=rounds, epochs=1,
+        batch_size=32, learning_rate=0.3, frequency_of_the_test=1,
+        random_seed=0, training_type="cross_silo")
+    fed, output_dim = load(args)
+    bundle = create(args, output_dim)
+    spec = make_trainer_spec(fed, bundle)
+    opt = create_optimizer(args, spec)
+    eval_fn = _make_eval_fn(spec, fed)
+    hyper = TrainHyper(learning_rate=jnp.float32(args.learning_rate),
+                       epochs=1)
+    init_rng, _ = jax.random.split(jax.random.PRNGKey(0))
+    params0 = jax.device_get(bundle.init(init_rng, fed.train.x[0, 0]))
+    d = int(np.asarray(tree_flatten_to_vector(params0)).shape[0])
+
+    def impl(params, cdata, rng, hyper):
+        inner = opt.make_inner_opt(hyper)
+        new_params, _, _ = run_local_sgd(
+            spec, inner, params, cdata, rng, hyper,
+            grad_transform=opt.grad_transform,
+            ctx={"global_params": params, "server_state": {},
+                 "client_state": {}, "hyper": hyper})
+        return new_params
+
+    train_jit = jax.jit(impl)
+
+    def local_vec(global_p, cidx, rnd):
+        cdata = jax.tree_util.tree_map(lambda a: a[cidx], fed.train)
+        key = jax.random.fold_in(jax.random.PRNGKey(17 + cidx), rnd)
+        new_p = train_jit(jax.tree_util.tree_map(jnp.asarray, global_p),
+                          cdata, key, hyper)
+        return np.asarray(tree_flatten_to_vector(jax.device_get(new_p)),
+                          np.float32)
+
+    def leg(use_lanes: bool):
+        plan = plan_for(bits, K) if use_lanes else None
+        scale = suggest_scale(4.0, plan) if plan else None
+        residuals = [None] * K
+        global_p = params0
+        plen = plan.packed_len(d) if plan else d
+        hit, acc, exact = None, 0.0, True
+        for rnd in range(rounds):
+            qs = []
+            for k in range(K):
+                vec = local_vec(global_p, k, rnd)
+                if plan:
+                    packed, residuals[k] = field_encode(
+                        vec, scale, plan, residuals[k],
+                        np.random.default_rng((k + 1) * 1000003 + rnd))
+                    qs.append(packed.astype(np.uint64))
+                else:
+                    qs.append(np.asarray(quantize(jnp.asarray(vec)),
+                                         np.uint64))
+            # pairwise mask algebra over the packed length: +s_ij for
+            # i<j, -s_ij for i>j — sums cancel bit-for-bit mod p
+            masked, plain = np.zeros(plen, np.uint64), np.zeros(plen,
+                                                                np.uint64)
+            for i in range(K):
+                m = qs[i] % P
+                for j in range(K):
+                    if i == j:
+                        continue
+                    seed = (rnd << 16) ^ (min(i, j) << 8) ^ max(i, j)
+                    s = expand_mask(seed, plen).astype(np.uint64)
+                    m = (m + s) % P if i < j else (m + P - s) % P
+                masked = (masked + m) % P
+                plain = (plain + qs[i]) % P
+            exact = exact and bool(np.array_equal(masked, plain))
+            if plan:
+                ssum = lane_dequantize_sum(masked.astype(np.uint32), K,
+                                           scale, plan, d)
+                avg = ssum / K
+                # auto-scale EMA, mirroring SecAggServerManager
+                per_client = float(np.abs(ssum).max()) / K
+                scale = 0.5 * scale + 0.5 * suggest_scale(
+                    max(2.0 * per_client, 1e-8), plan)
+            else:
+                avg = np.asarray(dequantize(jnp.asarray(
+                    masked.astype(np.uint32))), np.float32)[:d] / K
+            global_p = jax.tree_util.tree_map(
+                np.asarray, vector_to_tree_like(np.asarray(avg, np.float32),
+                                                params0))
+            stats = eval_fn(global_p) or {}
+            acc = float(stats.get("test_acc", 0.0))
+            if hit is None and acc >= target_acc:
+                hit = rnd
+        return {"bytes_per_round": float(plen * 4 * K),
+                "rounds_to_target": hit, "final_acc": round(acc, 4),
+                "mask_sum_bit_exact": exact}
+
+    dense = leg(use_lanes=False)
+    lanes = leg(use_lanes=True)
+    return {
+        "bytes_per_round": lanes["bytes_per_round"],
+        "dense_field_bytes_per_round": dense["bytes_per_round"],
+        "reduction_vs_dense_field": round(
+            dense["bytes_per_round"] / lanes["bytes_per_round"], 2),
+        "rounds_to_target": lanes["rounds_to_target"],
+        "dense_field_rounds_to_target": dense["rounds_to_target"],
+        "final_acc": lanes["final_acc"],
+        "dense_field_final_acc": dense["final_acc"],
+        "mask_sum_bit_exact": bool(lanes["mask_sum_bit_exact"]
+                                   and dense["mask_sum_bit_exact"]),
+        "bits": bits, "k_max": K,
+    }
+
+
+def _gossip_wire_leg(rounds=8):
+    """Gossip delta-chain compression column (ISSUE 19): the synthetic
+    gossip session dense vs ``gossip_compression: topk_qsgd`` — N2N
+    model-bearing bytes per round off the same ``WireStats`` ledger."""
+    from fedml_tpu import data as data_mod
+    from fedml_tpu import model as model_mod
+    from fedml_tpu.arguments import Arguments
+    from fedml_tpu.core.distributed.communication.message import WIRE_STATS
+    from fedml_tpu.cross_silo.decentralized import GossipMsg,\
+        run_gossip_inproc
+
+    def session(**kw):
+        args = Arguments(
+            dataset="digits", model="lr", client_num_in_total=4,
+            client_num_per_round=4, comm_round=rounds, epochs=1,
+            batch_size=32, learning_rate=0.3, random_seed=0,
+            training_type="cross_silo", **kw)
+        fed, output_dim = data_mod.load(args)
+        bundle = model_mod.create(args, output_dim)
+        WIRE_STATS.reset()
+        result = run_gossip_inproc(args, fed, bundle)
+        by_type = WIRE_STATS.snapshot()["by_type"]
+        rec = by_type.get(str(GossipMsg.N2N_PARAMS),
+                          by_type.get(GossipMsg.N2N_PARAMS, {"bytes": 0}))
+        return {"bytes_per_round": rec["bytes"] / rounds,
+                "final_acc": result.get("final_test_acc"),
+                "consensus_dist": result.get("consensus_dist")}
+
+    off = session()
+    on = session(gossip_compression="topk_qsgd", comm_compression_ratio=0.1)
+    return {
+        "bytes_per_round": round(on["bytes_per_round"], 1),
+        "dense_bytes_per_round": round(off["bytes_per_round"], 1),
+        "reduction_vs_dense": round(
+            off["bytes_per_round"] / on["bytes_per_round"], 2)
+        if on["bytes_per_round"] else None,
+        "final_acc": on["final_acc"],
+        "dense_final_acc": off["final_acc"],
+        "consensus_dist": round(on["consensus_dist"], 4)
+        if on["consensus_dist"] is not None else None,
+    }
+
+
 def bench_cross_silo_wire(target_acc=0.90, rounds=40):
     """Wire-efficiency axis (QSGD + error-feedback top-k, ISSUE 1): the
     digits FedAvg session runs twice over the in-proc WAN FSM — dense
@@ -327,6 +510,14 @@ def bench_cross_silo_wire(target_acc=0.90, rounds=40):
         "dense_rounds_to_target": off["rounds_to_target"],
         "compressed_wall_s": round(on["wall_s"], 2),
         "dense_wall_s": round(off["wall_s"], 2),
+        # ISSUE 19 columns: SecAgg-compatible lane compression (masked
+        # uplink bytes vs the dense field layout, same trajectory gate)
+        # and the gossip delta-chain (N2N bytes dense vs compressed).
+        # Under `legs` so scripts/bench_diff.py flattens + gates them.
+        "legs": {
+            "secagg_compressed": _secagg_wire_leg(target_acc=target_acc),
+            "gossip_compressed": _gossip_wire_leg(),
+        },
     }), flush=True)
 
 

@@ -112,6 +112,16 @@ _SCHEMA: Dict[str, Any] = {
     "comm_compression_ratio": 0.1,       # sparsifier keep-ratio in (0, 1]
     "comm_quantize_levels": 127,         # QSGD levels (int8 wire, <= 127)
     "comm_compression_broadcast": "full",  # server->client: full|bf16|compress
+    # unified wire pipeline (core/wire, ISSUE 19). ALL off by default:
+    # every transport's wire stays byte-identical.
+    "comm_compression_adaptive": False,  # stats-driven per-round keep-ratio
+    "comm_compression_ratio_min": None,  # adaptive bounds (None -> ratio/4)
+    "comm_compression_ratio_max": None,  # adaptive bounds (None -> ratio)
+    "comm_compression_latency_budget_s": None,  # uplink s == full pressure
+    "secagg_compress_bits": 0,           # 0=dense field; 4|8|16-bit lanes
+    "secagg_compress_clip": 4.0,         # round-0 clip (auto-scaled after)
+    "gossip_compression": None,          # decentralized neighbor deltas
+    "device_wire_compression": None,     # cross-device uplink artifacts
     # chaos_args — deterministic fault injection (core/chaos). ALL off by
     # default: a default run injects nothing, the simulator programs and
     # the cross-silo wire stay byte/bit-identical.

@@ -29,6 +29,10 @@ class WireStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._by_type: Dict[Any, Dict[str, int]] = {}
+        # per-pipeline-stage byte attribution (core/wire): msg_type ->
+        # stage name -> bytes. Stages are recorded by the pipeline
+        # (raw / sparsified / masked); framing totals live in by_type.
+        self._by_stage: Dict[Any, Dict[str, int]] = {}
         self._total_bytes = 0
         self._total_msgs = 0
 
@@ -45,12 +49,23 @@ class WireStats:
         # registry has its own
         obs_metrics.record_wire(msg_type, nbytes)
 
+    def record_stage(self, msg_type: Any, stage: str, nbytes: int) -> None:
+        """Attribute bytes to one wire-pipeline stage (``core/wire``) for
+        a message type — the where-did-the-bytes-go ledger behind the
+        framed totals in :meth:`record`."""
+        with self._lock:
+            ent = self._by_stage.setdefault(msg_type, {})
+            ent[stage] = ent.get(stage, 0) + int(nbytes)
+        obs_metrics.record_wire_stage(msg_type, stage, nbytes)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {"total_bytes": self._total_bytes,
                     "total_messages": self._total_msgs,
                     "by_type": {str(t): dict(v)
-                                for t, v in self._by_type.items()}}
+                                for t, v in self._by_type.items()},
+                    "by_stage": {str(t): dict(v)
+                                 for t, v in self._by_stage.items()}}
 
     @property
     def total_bytes(self) -> int:
@@ -60,6 +75,7 @@ class WireStats:
     def reset(self) -> None:
         with self._lock:
             self._by_type.clear()
+            self._by_stage.clear()
             self._total_bytes = 0
             self._total_msgs = 0
 

@@ -313,6 +313,19 @@ def record_wire(msg_type: Any, nbytes: int) -> None:
                      labels=("msg_type",)).inc(1, msg_type=t)
 
 
+def record_wire_stage(msg_type: Any, stage: str, nbytes: int) -> None:
+    """``core/wire`` pipeline seam: bytes attributed to one pipeline
+    stage (raw / sparsified / masked) by message type — the per-stage
+    ledger behind the framed totals of :func:`record_wire`."""
+    if not _cfg["enabled"]:
+        return
+    REGISTRY.counter("fed_wire_stage_bytes_total",
+                     "bytes by wire-pipeline stage and message type",
+                     labels=("msg_type", "stage")).inc(
+                         int(nbytes), msg_type=str(msg_type),
+                         stage=str(stage))
+
+
 def record_dispatch(name: str, wall_s: float, rounds: int,
                     compiles: int) -> None:
     """Engine ``_traced`` seam: dispatch wall time + compile counter."""

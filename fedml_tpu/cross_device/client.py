@@ -25,9 +25,12 @@ import numpy as np
 
 from ..core.algframe.local_training import run_local_sgd
 from ..core.algframe.types import TrainHyper
+from ..core.collectives import tree_flatten_to_vector
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..core.wire import encode_update
 from ..serving import load_model, save_model
+from ..utils.compression import CommCompressionSpec
 from ..utils.paths import confine_path
 from .message_define import DeviceMessage
 
@@ -65,6 +68,22 @@ class DeviceClientManager(FedMLCommManager):
         self.rng = jax.random.fold_in(
             jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 31),
             self.device_id)
+        # uplink wire compression (device_wire_compression; off = dense
+        # artifacts, byte-identical). The device always has the round's
+        # base in hand — the global it just trained from — so only the
+        # error-feedback residual persists across rounds.
+        method = getattr(args, "device_wire_compression", None)
+        self._wire_spec = None
+        self._wire_residual: Optional[np.ndarray] = None
+        if method:
+            self._wire_spec = CommCompressionSpec(
+                method=str(method),
+                ratio=float(getattr(args, "comm_compression_ratio", 0.1)),
+                levels=int(getattr(args, "comm_quantize_levels", 127)))
+            self._wire_rng = jax.random.fold_in(
+                jax.random.PRNGKey(
+                    int(getattr(args, "random_seed", 0)) + 977),
+                self.device_id)
         self._train_jit = None
         self._native = None
         if self.engine == "native":
@@ -140,7 +159,17 @@ class DeviceClientManager(FedMLCommManager):
         out_path = os.path.join(
             self.cache_dir,
             f"device_{self.device_id}_round_{round_idx}.npk")
-        save_model(new_params, out_path)
+        artifact = new_params
+        if self._wire_spec is not None:
+            enc = encode_update(
+                np.asarray(tree_flatten_to_vector(new_params), np.float32),
+                base=np.asarray(tree_flatten_to_vector(params), np.float32),
+                spec=self._wire_spec, residual=self._wire_residual,
+                rng=jax.random.fold_in(self._wire_rng, round_idx),
+                msg_type=DeviceMessage.MSG_TYPE_D2S_MODEL)
+            self._wire_residual = enc.residual
+            artifact = enc.payload
+        save_model(artifact, out_path)
         reply = Message(DeviceMessage.MSG_TYPE_D2S_MODEL, self.device_id, 0)
         reply.add_params(DeviceMessage.ARG_DEVICE_ID, self.device_id)
         reply.add_params(DeviceMessage.ARG_MODEL_FILE, out_path)

@@ -24,8 +24,11 @@ import jax
 import numpy as np
 
 from ..core import mlops
+from ..core.collectives import tree_flatten_to_vector, vector_to_tree_like
 from ..core.distributed.communication.message import Message
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..core.wire import decode_update
+from ..utils.compression import is_compressed_payload
 from ..simulation.sampling import FAST_SAMPLE_MIN_N, sample_ids_streaming
 from ..serving import check_model_magic, load_model, save_model
 from ..utils.paths import confine_path
@@ -62,13 +65,27 @@ class DeviceAggregator:
         return len(self.model_files) >= self._expected
 
     def aggregate(self):
+        # compressed uplinks (device_wire_compression): artifacts are
+        # delta blobs vs the round's dispatched global — still this
+        # round's ``global_params``, which aggregate() only replaces at
+        # the end. Flatten that base once, lazily.
+        base_vec = None
         loaded = []
         for did, path in sorted(self.model_files.items()):
             try:
                 # artifacts were magic-validated at receive time; a file
                 # that still fails here (deleted/truncated in between) is
                 # skipped, never fatal to the round-closing thread
-                loaded.append((self.sample_nums[did], load_model(path)))
+                params = load_model(path)
+                if is_compressed_payload(params):
+                    if base_vec is None:
+                        base_vec = np.asarray(
+                            tree_flatten_to_vector(self.global_params),
+                            np.float32)
+                    params = vector_to_tree_like(
+                        decode_update(params, base=base_vec),
+                        self.global_params)
+                loaded.append((self.sample_nums[did], params))
             except (ValueError, OSError) as e:
                 logger.warning("aggregate: skipping device %d: %s", did, e)
         self.model_files.clear()

@@ -25,8 +25,10 @@ from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
                                                        tree_to_wire,
                                                        wire_to_tree)
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
-from ...utils.compression import (decompress_vec, ef_compress_vec,
-                                  is_compressed_payload, spec_from_args)
+from ...core.wire import (decode_update, encode_update, pack_optional_vec,
+                          unpack_optional_vec, wire_checkpointer,
+                          wire_state_template)
+from ...utils.compression import is_compressed_payload, spec_from_args
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
@@ -37,6 +39,7 @@ class ClientMasterManager(FedMLCommManager):
     # stay callable on partially-constructed instances (tests via __new__)
     chaos = FaultPlan()
     _async_mode = False
+    _wire_ckpt = None
 
     def __init__(self, args, trainer, comm=None, rank: int = 1,
                  size: int = 0, backend: str = "INPROC"):
@@ -64,6 +67,47 @@ class ClientMasterManager(FedMLCommManager):
         self._cc_rng = jax.random.fold_in(
             jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 97),
             self.rank)
+        # crash-resume: the EF residual and the broadcast base join the
+        # round checkpoint (core/wire/state) — losing either silently
+        # drops accumulated compression error or corrupts later deltas.
+        # Gated on the session's checkpoint knobs AND an active spec.
+        self._wire_ckpt = None
+        if self.cc_spec is not None and self.cc_spec.method is not None:
+            self._wire_ckpt = wire_checkpointer(args, f"client_{self.rank}")
+            self._restore_wire_state()
+
+    # --- wire-state checkpointing (ISSUE 19 satellite) ----------------------
+    def _wire_dim(self) -> int:
+        return int(np.asarray(self.trainer.params_to_vec(
+            self.trainer.params_template)).shape[0])
+
+    def _wire_state(self, d: int) -> dict:
+        rf, res = pack_optional_vec(self._cc_residual, d)
+        gf, gv = pack_optional_vec(self._global_vec, d)
+        return {"round": np.asarray(self.round_idx, np.int32),
+                "residual_set": rf, "residual": res,
+                "global_vec_set": gf, "global_vec": gv}
+
+    def _save_wire_state(self) -> None:
+        if self._wire_ckpt is None or not self._wire_ckpt.enabled:
+            return
+        d = self._wire_dim()
+        self._wire_ckpt.maybe_save(self.round_idx, self._wire_state(d))
+
+    def _restore_wire_state(self) -> None:
+        if self._wire_ckpt is None or not self._wire_ckpt.enabled:
+            return
+        got = self._wire_ckpt.latest(
+            wire_state_template(self._wire_dim(), ("residual", "global_vec")))
+        if got is None:
+            return
+        step, st = got
+        self._cc_residual = unpack_optional_vec(st["residual_set"],
+                                                st["residual"])
+        self._global_vec = unpack_optional_vec(st["global_vec_set"],
+                                               st["global_vec"])
+        logger.info("client rank %d: restored wire state from round %d",
+                    self.rank, step)
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -123,7 +167,7 @@ class ClientMasterManager(FedMLCommManager):
             if self._global_vec is None:
                 raise RuntimeError(
                     "compressed sync before a dense init model")
-            self._global_vec = self._global_vec + decompress_vec(update)
+            self._global_vec = decode_update(update, base=self._global_vec)
             return self.trainer.vec_to_params(self._global_vec)
         wire = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         if msg.get(MyMessage.MSG_ARG_KEY_WIRE_DTYPE) == WIRE_DTYPE_BF16:
@@ -179,12 +223,23 @@ class ClientMasterManager(FedMLCommManager):
                       self.server_rank)
         if self.cc_spec is not None and self.cc_spec.method is not None:
             # broadcast-only specs (method None, e.g. bf16 downlink) keep
-            # the dense uplink below
-            delta = self.trainer.params_to_vec(new_params) - self._global_vec
-            blob, self._cc_residual = ef_compress_vec(
-                delta, self._cc_residual, self.cc_spec,
-                jax.random.fold_in(self._cc_rng, self.round_idx))
-            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_UPDATE, blob)
+            # the dense uplink below. The uplink runs through the shared
+            # core/wire pipeline: delta vs the received global, then EF
+            # sparsify/quantize. When the server's adaptive schedule
+            # tagged the sync with a keep-ratio, this round honors it.
+            spec = self.cc_spec
+            ratio = msg.get(MyMessage.MSG_ARG_KEY_CC_RATIO)
+            if ratio is not None:
+                import dataclasses
+                spec = dataclasses.replace(spec, ratio=float(ratio))
+            enc = encode_update(
+                self.trainer.params_to_vec(new_params),
+                base=self._global_vec, spec=spec,
+                residual=self._cc_residual,
+                rng=jax.random.fold_in(self._cc_rng, self.round_idx),
+                msg_type=MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER)
+            self._cc_residual = enc.residual
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_UPDATE, enc.payload)
             # a delta is only meaningful against the round's broadcast
             # base — tag it so the server can drop stragglers from a
             # timed-out round instead of reconstructing against the
@@ -214,10 +269,13 @@ class ClientMasterManager(FedMLCommManager):
             # link); the sync server links them off its wait span
             obs_trace.inject(out, usp)
             self.send_message(out)
+        self._save_wire_state()
 
     def handle_message_finish(self, msg: Message) -> None:
         if hasattr(self, "_server_heard"):
             self._server_heard.set()
         logger.info("client rank %d: finish", self.rank)
         mlops.log_training_status("FINISHED")
+        if self._wire_ckpt is not None:
+            self._wire_ckpt.close()
         self.finish()

@@ -39,11 +39,14 @@ from ..core.algframe.client_trainer import make_trainer_spec
 from ..core.algframe.local_training import evaluate
 from ..core.algframe.types import TrainHyper
 from ..core.chaos import FaultPlan
+from ..core.collectives import tree_flatten_to_vector, vector_to_tree_like
 from ..core.distributed.communication.backoff import backoff_delays
 from ..core.distributed.communication.message import (Message, tree_to_wire,
                                                       wire_to_tree)
 from ..core.distributed.fedml_comm_manager import FedMLCommManager
 from ..core.distributed.topology import SymmetricTopologyManager
+from ..core.wire import decode_update, encode_update
+from ..utils.compression import CommCompressionSpec, is_compressed_payload
 
 logger = logging.getLogger(__name__)
 
@@ -114,6 +117,26 @@ class GossipNodeManager(FedMLCommManager):
         self._stop_resend = threading.Event()
         self._sent_wires: Dict[int, Any] = {}  # recent rounds' own params
         self._final_wire: Optional[Any] = None
+        # gossip compression (core/wire, ISSUE 19): after a dense round-0
+        # seed, each node ships ONE compressed delta per round vs its own
+        # previous broadcast reconstruction; receivers keep a per-sender
+        # reconstruction and decode in round order at mix time. Off by
+        # default: dense N2N wires, byte-identical. The chaos resend loop
+        # replays cached blobs safely — decode is keyed by round and a
+        # round's delta is applied exactly once (at mix).
+        method = getattr(args, "gossip_compression", None)
+        self.gc_spec: Optional[CommCompressionSpec] = None
+        if method:
+            self.gc_spec = CommCompressionSpec(
+                method=str(method),
+                ratio=float(getattr(args, "comm_compression_ratio", 0.1)),
+                levels=int(getattr(args, "comm_quantize_levels", 127)))
+        self._gc_sent_recon: Optional[np.ndarray] = None  # neighbors' copy of ME
+        self._gc_residual: Optional[np.ndarray] = None
+        self._gc_recv_recon: Dict[int, np.ndarray] = {}   # my copy of each peer
+        self._gc_rng = jax.random.fold_in(
+            jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 131),
+            self.rank)
 
     # --- jitted math --------------------------------------------------------
     def _train_impl(self, params, round_key, hyper):
@@ -160,7 +183,28 @@ class GossipNodeManager(FedMLCommManager):
         self._trained = self._train(
             self.params, round_key,
             self.hyper.replace(round_idx=jnp.int32(self.round_idx)))
-        wire = tree_to_wire(self._trained)
+        if self.gc_spec is not None and self._gc_sent_recon is not None:
+            # compressed rounds: the wire is the EF-compressed delta of
+            # this round's trained params vs what the neighbors hold; our
+            # tracked copy advances by DECODING our own blob (the same
+            # host routine every receiver runs — bit-identical bases)
+            enc = encode_update(
+                np.asarray(tree_flatten_to_vector(self._trained),
+                           np.float32),
+                base=self._gc_sent_recon, spec=self.gc_spec,
+                residual=self._gc_residual,
+                rng=jax.random.fold_in(self._gc_rng, self.round_idx),
+                msg_type=GossipMsg.N2N_PARAMS)
+            self._gc_residual = enc.residual
+            self._gc_sent_recon = decode_update(enc.payload,
+                                                base=self._gc_sent_recon)
+            wire = enc.payload
+        else:
+            wire = tree_to_wire(self._trained)
+            if self.gc_spec is not None:
+                # dense seed round: every neighbor now holds exactly this
+                self._gc_sent_recon = np.asarray(
+                    tree_flatten_to_vector(self._trained), np.float32)
         # retransmission cache: a SLOW neighbor may still need our round-r
         # params after we advanced to r+1 (its copy was lost) — keep the
         # last few rounds' wires so the resend loop can replay them
@@ -241,15 +285,36 @@ class GossipNodeManager(FedMLCommManager):
         if r < self.round_idx:
             return  # stale retransmission of a round we already mixed
         sender = msg.get_sender_id()
-        self._inbox.setdefault(r, {})[sender] = wire_to_tree(
-            msg.get(GossipMsg.K_PARAMS), self._template)
+        # the RAW wire is buffered and decoded at mix time: compressed
+        # deltas form a per-sender chain that must be applied in round
+        # order exactly once — mix time is the only point with both
+        # guarantees (duplicates within a round overwrite the same blob)
+        self._inbox.setdefault(r, {})[sender] = msg.get(GossipMsg.K_PARAMS)
         self._try_mix()
+
+    def _decode_neighbor(self, sender: int, wire) -> Any:
+        """Inbox wire -> params tree, advancing the per-sender
+        reconstruction when the sender ships compressed deltas."""
+        if is_compressed_payload(wire):
+            base = self._gc_recv_recon.get(sender)
+            if base is None:
+                raise RuntimeError(
+                    f"gossip node {self.rank}: compressed params from "
+                    f"{sender} before its dense seed round")
+            vec = decode_update(wire, base=base)
+            self._gc_recv_recon[sender] = vec
+            return vector_to_tree_like(vec, self._template)
+        params = wire_to_tree(wire, self._template)
+        if self.gc_spec is not None:
+            self._gc_recv_recon[sender] = np.asarray(
+                tree_flatten_to_vector(params), np.float32)
+        return params
 
     def _try_mix(self) -> None:
         box = self._inbox.get(self.round_idx, {})
         if self._trained is None or len(box) < len(self.neighbors):
             return
-        ordered = [box[j] for j in sorted(box)]
+        ordered = [self._decode_neighbor(j, box[j]) for j in sorted(box)]
         self.params = self._mix(self._trained, ordered)
         del self._inbox[self.round_idx]
         self._trained = None

@@ -77,10 +77,29 @@ def lsa_params(n_clients: int, privacy_t: int, threshold: int):
     return split_t
 
 
+def _refuse_wire_compression(args) -> None:
+    """LightSecAgg cannot compose with the core/wire compressors: its
+    field encoding maps negatives to ``p - |q|`` (full-field magnitudes
+    that overflow any low-bit lane of ``secagg_compress_bits``), and the
+    MDS-coded sub-masks split the UNPACKED ``d_pad`` vector into
+    ``split_t`` chunks — packing would change the vector the coding is
+    defined over. Per-client sparsification support sets additionally
+    leak masked coordinates. Refused outright rather than silently
+    ignored or corrupted."""
+    for knob in ("secagg_compress_bits", "comm_compression"):
+        if getattr(args, knob, None):
+            raise ValueError(
+                "%s=%r is incompatible with LightSecAgg (full-field "
+                "negative encodings overflow low-bit lanes; sparsifier "
+                "support sets leak masked coordinates)"
+                % (knob, getattr(args, knob)))
+
+
 class LSAClientManager(FedMLCommManager):
     def __init__(self, args, trainer, comm=None, rank: int = 1, size: int = 0,
                  backend: str = "INPROC"):
         super().__init__(args, comm, rank, size, backend)
+        _refuse_wire_compression(args)
         self.trainer = trainer
         self.idx = rank - 1
         self.n_clients = size - 1
@@ -185,6 +204,7 @@ class LSAServerManager(FedMLCommManager):
     def __init__(self, args, global_params, eval_fn=None, comm=None,
                  rank: int = 0, size: int = 0, backend: str = "INPROC"):
         super().__init__(args, comm, rank, size, backend)
+        _refuse_wire_compression(args)
         self.global_params = global_params
         self.eval_fn = eval_fn
         self.n_clients = size - 1

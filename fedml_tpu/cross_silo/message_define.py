@@ -24,6 +24,11 @@ class MyMessage:
     # precision (bf16 bit views)
     MSG_ARG_KEY_MODEL_UPDATE = "model_update"
     MSG_ARG_KEY_WIRE_DTYPE = "wire_dtype"
+    # adaptive wire pipeline (core/wire): the sync carries the round's
+    # keep-ratio when the stats-driven schedule is on, so client uplinks
+    # and the server decoder agree per round; absent otherwise (the
+    # default wire stays byte-identical)
+    MSG_ARG_KEY_CC_RATIO = "cc_ratio"
     # statuses
     MSG_CLIENT_STATUS_ONLINE = "ONLINE"
     MSG_CLIENT_STATUS_IDLE = "IDLE"

@@ -63,6 +63,8 @@ from ...core.collectives import (tree_flatten_to_vector, vector_to_tree_like)
 from ...core.mpc import (P, dequantize, expand_mask, quantize,
                          shamir_reconstruct, shamir_share)
 from ...core.mpc import channels
+from ...core.wire import (LanePlan, field_encode, lane_dequantize_sum,
+                          plan_for, record_update_stages, suggest_scale)
 
 logger = logging.getLogger(__name__)
 _P_I = int(P)
@@ -72,6 +74,21 @@ def _round_tag(round_idx: int) -> bytes:
     """AAD domain tag binding sealed share blobs to one FL round — a blob
     recorded in round r fails authentication if replayed in round r'."""
     return b"sa-round-%d" % int(round_idx)
+
+
+def _refuse_sparsified_wire(args) -> None:
+    """Masked summation needs every client on the same dense coordinate
+    set — a per-client top-k/rand-k support set would leak exactly the
+    coordinates masking hides AND misalign the mod-p sums. Lane
+    quantization (``secagg_compress_bits``) is the SecAgg-compatible
+    compression path; sparsifiers are refused outright."""
+    if getattr(args, "comm_compression", None):
+        raise ValueError(
+            "comm_compression=%r cannot compose with SecAgg: per-client "
+            "sparsification support sets leak masked coordinates and "
+            "break masked-sum alignment. Use secagg_compress_bits "
+            "(4|8|16-bit field lanes) instead."
+            % getattr(args, "comm_compression"))
 
 
 def _checked_threshold(args, n_clients: int) -> int:
@@ -120,6 +137,10 @@ class SAMessage:
     KEY_DROPPED = "dropped"
     KEY_SEED_SHARES = "seed_shares"
     KEY_KEY_SHARES = "key_shares"
+    # lane-compressed field quantization (core/wire, ISSUE 19): the train
+    # broadcast carries {bits, k_max, scale} when secagg_compress_bits is
+    # on; absent otherwise (dense field vectors, byte-identical wire)
+    KEY_WIRE = "wire"
 
 
 class SecAggClientManager(FedMLCommManager):
@@ -131,6 +152,7 @@ class SecAggClientManager(FedMLCommManager):
         super().__init__(args, comm, rank, size, backend)
         self.trainer = trainer
         self.n_clients = int(getattr(args, "client_num_per_round", size - 1))
+        _refuse_sparsified_wire(args)
         self.threshold = _checked_threshold(args, self.n_clients)
         self.idx = self.rank - 1  # client index 0..n-1
         # ALL secret material comes from OS entropy, never from the public
@@ -143,6 +165,12 @@ class SecAggClientManager(FedMLCommManager):
         self.round_idx = 0
         self._round: Optional[Dict[str, Any]] = None  # this round's secrets
         self._responded_rounds: set = set()
+        # lane compression (core/wire): error-feedback residual carrying
+        # this client's quantization + clip error across rounds. Committed
+        # only when the masked vector is actually SENT — a round sat out
+        # (not in the cohort) must not advance the residual for mass that
+        # was never shipped.
+        self._ef_residual: Optional[np.ndarray] = None
 
     def register_message_receive_handlers(self) -> None:
         h = self.register_message_receive_handler
@@ -174,12 +202,31 @@ class SecAggClientManager(FedMLCommManager):
         delta = jax.tree_util.tree_map(
             lambda a, b: np.asarray(a) - np.asarray(b), new_params, params)
         vec = np.asarray(tree_flatten_to_vector(delta), np.float32)
-        q = np.asarray(quantize(vec * np.float32(n))).astype(np.uint64)
+        wire_cfg = msg.get(SAMessage.KEY_WIRE)
+        residual_next = None
+        if wire_cfg is not None:
+            # lane-compressed field path (core/wire): EF-compensate, clip,
+            # stochastically round into b-bit lanes and pack L per uint32 —
+            # the masked vector shrinks by L while the masked SUM stays
+            # bit-exact (lane headroom covers k_max summands below p).
+            # Rounding randomness need not be secret; seeded per
+            # (client, round) so sessions replay deterministically.
+            plan = LanePlan.from_wire(wire_cfg)
+            scale = float(wire_cfg["scale"])
+            packed, residual_next = field_encode(
+                vec * np.float32(n), scale, plan, self._ef_residual,
+                np.random.default_rng(((self.idx + 1) << 20)
+                                      ^ self.round_idx))
+            q = packed.astype(np.uint64)
+        else:
+            q = np.asarray(quantize(vec * np.float32(n))).astype(np.uint64)
         # fresh mask material for THIS round only (see module docstring)
         mask_sk, mask_pk = channels.keygen()
         self._round = {
             "round": self.round_idx,
             "q": q, "n": float(n),
+            "d_model": int(vec.shape[0]),
+            "residual_next": residual_next,
             "mask_sk": mask_sk, "mask_pk": mask_pk,
             "self_seed": self._rng.randbits(channels.SEED_BITS),
             "pks": {}, "held": {},
@@ -268,6 +315,13 @@ class SecAggClientManager(FedMLCommManager):
         out.add_params(SAMessage.KEY_ROUND, r["round"])
         out.add_params(SAMessage.KEY_MASKED, masked)
         out.add_params(SAMessage.KEY_N, r["n"])
+        # per-stage byte ledger: dense-equivalent vs post-mask field bytes
+        record_update_stages(SAMessage.C2S_MASKED_MODEL,
+                             raw=int(r["d_model"]) * 4,
+                             masked=int(masked.nbytes))
+        if r["residual_next"] is not None:
+            # the quantized vector ships now — commit the EF residual
+            self._ef_residual = r["residual_next"]
         self.send_message(out)
 
     def on_unmask_request(self, msg: Message) -> None:
@@ -321,6 +375,7 @@ class SecAggServerManager(FedMLCommManager):
         self.global_params = global_params
         self.eval_fn = eval_fn
         self.n_clients = int(getattr(args, "client_num_per_round", size - 1))
+        _refuse_sparsified_wire(args)
         self.threshold = _checked_threshold(args, self.n_clients)
         self.round_num = int(getattr(args, "comm_round", 1))
         self.round_timeout = float(getattr(args, "round_timeout_s", 0) or 0)
@@ -340,6 +395,19 @@ class SecAggServerManager(FedMLCommManager):
         self.result: Optional[dict] = None
         self._template_vec = np.asarray(
             tree_flatten_to_vector(global_params))
+        # lane-compressed field quantization (core/wire): pack L b-bit
+        # lanes per uint32 field element so the masked wire drops from
+        # 4 B/coord to 4/L. k_max = the full client count — the lane
+        # headroom must cover every summand the protocol could admit.
+        bits = int(getattr(args, "secagg_compress_bits", 0) or 0)
+        self._wire_plan: Optional[LanePlan] = None
+        self._wire_scale = 0.0
+        self._round_scale = 0.0
+        if bits:
+            self._wire_plan = plan_for(bits, self.n_clients)
+            self._wire_scale = suggest_scale(
+                float(getattr(args, "secagg_compress_clip", 4.0)),
+                self._wire_plan)
         self._lock = threading.Lock()
         # setup -> (pk -> shares -> collect -> unmask -> aggregate)* -> done
         self._phase = "setup"
@@ -464,10 +532,19 @@ class SecAggServerManager(FedMLCommManager):
             self._dropped = []
             self._arm_timer(self._leash_s, "pk")
         wire = tree_to_wire(self.global_params)
+        wire_cfg = None
+        if self._wire_plan is not None:
+            # freeze this round's scale: every client must quantize with
+            # the exact value the server will dequantize the sum with
+            self._round_scale = float(self._wire_scale)
+            wire_cfg = dict(self._wire_plan.to_wire(),
+                            scale=self._round_scale)
         for rank in range(1, self.n_clients + 1):
             out = Message(SAMessage.S2C_TRAIN, 0, rank)
             out.add_params(SAMessage.KEY_MODEL, wire)
             out.add_params(SAMessage.KEY_ROUND, self.round_idx)
+            if wire_cfg is not None:
+                out.add_params(SAMessage.KEY_WIRE, wire_cfg)
             self.send_message(out)
 
     def on_round_pk(self, msg: Message) -> None:
@@ -632,7 +709,12 @@ class SecAggServerManager(FedMLCommManager):
 
     def _unmask_and_advance(self) -> None:
         surviving = self._surviving
-        d = len(self._template_vec)
+        d_model = len(self._template_vec)
+        # with lanes on, the whole protocol (masks, Shamir-recovered mask
+        # cancellation, the mod-p sum) runs over the PACKED length — both
+        # sides derive masks from expand_mask(seed, d) with the same d
+        d = (self._wire_plan.packed_len(d_model)
+             if self._wire_plan is not None else d_model)
         total = np.zeros(d, np.uint64)
         for m in self.masked.values():
             total = (total + m.astype(np.uint64)) % _P_I
@@ -654,7 +736,25 @@ class SecAggServerManager(FedMLCommManager):
                     total = (total + _P_I - m) % _P_I
                 else:       # survivor i added -m (i>j) -> add back
                     total = (total + m) % _P_I
-        vec = np.asarray(dequantize(total.astype(np.uint32)))
+        if self._wire_plan is not None:
+            # exact masked-sum decode: the unmasked total IS the integer
+            # sum of the survivors' packed vectors (overflow bound in
+            # core/wire/field_quant), so lane extraction + the K*offset
+            # correction is bit-identical to summing unmasked quantized
+            # vectors directly — the acceptance property test_wire pins
+            vec = lane_dequantize_sum(
+                np.asarray(total, np.uint64).astype(np.uint32),
+                len(surviving), self._round_scale, self._wire_plan,
+                d_model)
+            # auto-scale: track the observed per-client aggregate
+            # magnitude with 2x margin (clip error lands in each client's
+            # EF residual, so a transiently tight scale self-corrects)
+            per_client = float(np.abs(vec).max()) / max(len(surviving), 1)
+            new_scale = suggest_scale(max(2.0 * per_client, 1e-8),
+                                      self._wire_plan)
+            self._wire_scale = 0.5 * self._wire_scale + 0.5 * new_scale
+        else:
+            vec = np.asarray(dequantize(total.astype(np.uint32)))
         wsum = sum(self.weights[i] for i in surviving)
         agg_delta_vec = vec / max(wsum, 1e-12)
         agg_delta = vector_to_tree_like(agg_delta_vec.astype(np.float32),

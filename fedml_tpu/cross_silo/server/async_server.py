@@ -72,6 +72,7 @@ from ...core.async_rounds import (UpdateBuffer, adaptive_staleness_cap,
 from ...core.collectives import tree_flatten_to_vector, vector_to_tree_like
 from ...core.distributed.communication.message import (Message, tree_to_wire,
                                                        wire_to_tree)
+from ...core.wire import wire_checkpointer, wire_state_template
 from ...utils.compression import decompress_vec, is_compressed_payload
 from ..message_define import MyMessage
 from .fedml_aggregator import FedMLAggregator
@@ -122,6 +123,17 @@ class AsyncFedMLAggregator(FedMLAggregator):
         self._base_ring: Dict[int, np.ndarray] = {
             0: np.asarray(tree_flatten_to_vector(global_params),
                           np.float32)}
+        # defended pours over COMPRESSED uplinks: a compressed upload is a
+        # delta the silo's error-feedback already committed — a defense
+        # exclusion silently loses that movement and the silo never
+        # re-sends it. Thread a server-side EF loop through the base
+        # ring instead: an excluded re-based row is carried per sender
+        # (stamped with the version it was re-based to), re-based again
+        # onto the pour's version, and folded into the sender's NEXT row
+        # before the defense re-judges it; a kept verdict clears it.
+        # Dense uploads stay uncarried — their next upload is absolute.
+        self._ef_carry: Dict[int, tuple] = {}
+        self._compressed_senders: set = set()
 
     # --- uploads ------------------------------------------------------------
     def base_for(self, version: int) -> np.ndarray:
@@ -146,6 +158,7 @@ class AsyncFedMLAggregator(FedMLAggregator):
             # a compressed upload IS the delta vs the broadcast the silo
             # holds — exactly its dispatch base; no reconstruction needed
             delta = np.asarray(payload, np.float32)
+            self._compressed_senders.add(int(rank))
         else:
             # payload: the uploaded model as a flat f32 vector (callers
             # flatten OUTSIDE any lock — see the manager) or a tree
@@ -194,8 +207,19 @@ class AsyncFedMLAggregator(FedMLAggregator):
             # staleness 0 the correction is zero and the pour is exactly
             # the sync defended round's math. The poured K varies, which
             # is fine host-side (the kernels retrace per shape).
-            rows = [np.asarray(e.update, np.float32)
-                    - (base - self.base_for(e.version)) for e in entries]
+            rows = []
+            for e in entries:
+                row = (np.asarray(e.update, np.float32)
+                       - (base - self.base_for(e.version)))
+                carry = self._ef_carry.pop(int(e.client_id), None)
+                if carry is not None:
+                    # the stored row satisfied base_{v_s} + row = target;
+                    # re-expressing against the CURRENT base subtracts the
+                    # server movement since v_s — same algebra as the
+                    # fresh row's own re-base, read off the same ring
+                    cv, cres = carry
+                    row = row + (cres - (base - self.base_for(cv)))
+                rows.append(row)
             # norm_w IS the staleness-folded relative mix (pour_weights,
             # the one staleness implementation); the kernels normalize
             # internally, so passing it is exactly the decayed weighting
@@ -207,6 +231,14 @@ class AsyncFedMLAggregator(FedMLAggregator):
                 client_ids=ranks)
             agg = np.asarray(jax.device_get(vec), np.float32)
             verdict = verdict_from_info(info, len(entries))
+            if verdict is not None:
+                for i, e in enumerate(entries):
+                    rid = int(e.client_id)
+                    if (float(np.asarray(verdict)[i]) < 0.5
+                            and rid in self._compressed_senders):
+                        self._ef_carry[rid] = (self.version,
+                                               np.asarray(rows[i],
+                                                          np.float32))
             if verdict is not None:
                 # defense verdicts are the silo reputation stream —
                 # select_silos benches silos the defenses keep excluding.
@@ -299,6 +331,57 @@ class AsyncFedMLServerManager(FedMLServerManager):
         self.pour_timeout_s = (t if t > 0 else self.round_timeout_s
                                if self.round_timeout_s > 0
                                else self.DEFAULT_POUR_TIMEOUT_S)
+        # async wire state (ISSUE 19 satellite): the sync manager's slot
+        # holds broadcast EF state, which async never has (dense
+        # broadcasts only) — replace it with this mode's own namespace
+        # carrying the defended-pour per-sender EF residuals
+        self._wire_ckpt = wire_checkpointer(args, "async_server")
+        if self._wire_ckpt is not None:
+            self._restore_wire_state()
+
+    # --- wire-state checkpointing (per-sender pour residuals) ---------------
+    def _wire_template(self) -> dict:
+        n = int(getattr(self.args, "client_num_in_total",
+                        self.client_num)) + 1
+        d = int(self.aggregator._base_ring[
+            min(self.aggregator._base_ring)].shape[0])
+        t = wire_state_template(d, (), matrices={"ef_residual": n})
+        t["ef_version"] = np.zeros((n,), np.int32)
+        t["compressed"] = np.zeros((n,), np.int32)
+        return t
+
+    def _save_wire_state(self, completed_round: int) -> None:
+        if self._wire_ckpt is None or not self._wire_ckpt.enabled:
+            return
+        st = self._wire_template()
+        st["round"] = np.asarray(completed_round, np.int32)
+        n = st["compressed"].shape[0]
+        for rid in self.aggregator._compressed_senders:
+            if 0 <= rid < n:
+                st["compressed"][rid] = 1
+        for rid, (cv, cres) in self.aggregator._ef_carry.items():
+            if 0 <= rid < n:
+                st["ef_residual_set"][rid] = 1
+                st["ef_version"][rid] = cv
+                st["ef_residual"][rid] = cres
+        self._wire_ckpt.maybe_save(completed_round, st)
+
+    def _restore_wire_state(self) -> None:
+        if self._wire_ckpt is None or not self._wire_ckpt.enabled:
+            return
+        got = self._wire_ckpt.latest(self._wire_template())
+        if got is None:
+            return
+        _, st = got
+        agg = self.aggregator
+        agg._compressed_senders = {
+            int(r) for r in np.flatnonzero(np.asarray(st["compressed"]))}
+        agg._ef_carry = {
+            int(r): (int(st["ef_version"][r]),
+                     np.asarray(st["ef_residual"][r], np.float32))
+            for r in np.flatnonzero(np.asarray(st["ef_residual_set"]))}
+        logger.info("async server: restored wire EF state for %d senders",
+                    len(agg._ef_carry))
 
     # --- handshake + redemption ---------------------------------------------
     def handle_message_client_status_update(self, msg: Message) -> None:
@@ -471,6 +554,7 @@ class AsyncFedMLServerManager(FedMLServerManager):
                               "staleness_cap":
                                   self.aggregator.staleness_cap})
                 contributors = sorted({int(a["client"]) for a in arrivals})
+                self._save_wire_state(version - 1)
             psp.set_attr("poured", len(arrivals))
             for a in arrivals:
                 if a.get("trace"):

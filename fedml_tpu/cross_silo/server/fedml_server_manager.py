@@ -27,8 +27,11 @@ from ...core.distributed.communication.message import (WIRE_DTYPE_BF16,
                                                        tree_to_wire_bf16,
                                                        wire_to_tree)
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
-from ...utils.compression import (decompress_vec, ef_compress_vec,
-                                  is_compressed_payload, spec_from_args)
+from ...core.wire import (AdaptiveRatioBounds, adaptive_keep_ratio,
+                          decode_update, encode_update, pack_optional_vec,
+                          unpack_optional_vec, wire_checkpointer,
+                          wire_state_template)
+from ...utils.compression import is_compressed_payload, spec_from_args
 from ..message_define import MyMessage
 
 logger = logging.getLogger(__name__)
@@ -42,6 +45,8 @@ class FedMLServerManager(FedMLCommManager):
     chaos = FaultPlan()
     quorum = 1
     _timeout_graced = False
+    _wire_ckpt = None
+    _cc_adaptive = None
     _bcast_t0 = None
     _round_targets: list = []
     _round_selected: list = []
@@ -90,6 +95,27 @@ class FedMLServerManager(FedMLCommManager):
         self._bcast_residual = None
         self._cc_rng = jax.random.PRNGKey(
             int(getattr(args, "random_seed", 0)) + 53)
+        # adaptive keep-ratio schedule (core/wire/adaptive): the stats
+        # store's observed upload latency + dropout posterior pick each
+        # round's ratio within configured bounds; the chosen ratio rides
+        # the sync so client uplinks agree. Off by default.
+        self._cc_adaptive = None
+        if (getattr(args, "comm_compression_adaptive", False)
+                and self.cc_spec is not None
+                and self.cc_spec.method is not None):
+            rmax = float(getattr(args, "comm_compression_ratio_max", None)
+                         or self.cc_spec.ratio)
+            rmin = float(getattr(args, "comm_compression_ratio_min", None)
+                         or max(rmax / 4.0, 1e-4))
+            budget = getattr(args, "comm_compression_latency_budget_s", None)
+            self._cc_adaptive = AdaptiveRatioBounds(
+                rmin, rmax, float(budget) if budget else None)
+        # crash-resume: the broadcast base + server-side EF residual join
+        # the round checkpoint (core/wire/state) — see the client manager
+        self._wire_ckpt = None
+        if self.cc_spec is not None and self.cc_spec.method is not None:
+            self._wire_ckpt = wire_checkpointer(args, "server")
+            self._restore_wire_state()
         # bytes-on-wire ledger mark for per-round accounting (counts this
         # process's encodes: all S2C traffic; in-proc sessions also count
         # the client threads' uploads, which is what the bench wants)
@@ -109,6 +135,33 @@ class FedMLServerManager(FedMLCommManager):
         return np.asarray(
             tree_flatten_to_vector(self.aggregator.global_params),
             np.float32)
+
+    # --- wire-state checkpointing (ISSUE 19 satellite) ----------------------
+    def _save_wire_state(self, completed_round: int) -> None:
+        if self._wire_ckpt is None or not self._wire_ckpt.enabled:
+            return
+        d = int(self._global_f32_vec().shape[0])
+        bf, bv = pack_optional_vec(self._bcast_prev_vec, d)
+        rf, res = pack_optional_vec(self._bcast_residual, d)
+        self._wire_ckpt.maybe_save(completed_round, {
+            "round": np.asarray(completed_round, np.int32),
+            "bcast_prev_vec_set": bf, "bcast_prev_vec": bv,
+            "bcast_residual_set": rf, "bcast_residual": res})
+
+    def _restore_wire_state(self) -> None:
+        if self._wire_ckpt is None or not self._wire_ckpt.enabled:
+            return
+        got = self._wire_ckpt.latest(wire_state_template(
+            int(self._global_f32_vec().shape[0]),
+            ("bcast_prev_vec", "bcast_residual")))
+        if got is None:
+            return
+        step, st = got
+        self._bcast_prev_vec = unpack_optional_vec(
+            st["bcast_prev_vec_set"], st["bcast_prev_vec"])
+        self._bcast_residual = unpack_optional_vec(
+            st["bcast_residual_set"], st["bcast_residual"])
+        logger.info("server: restored wire state from round %d", step)
 
     # --- FSM wiring ---------------------------------------------------------
     def register_message_receive_handlers(self) -> None:
@@ -233,7 +286,7 @@ class FedMLServerManager(FedMLCommManager):
         update = msg.get(MyMessage.MSG_ARG_KEY_MODEL_UPDATE)
         if is_compressed_payload(update):  # delta vs the broadcast model
             up_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
-            delta = decompress_vec(update)  # stateless: outside the lock
+            delta = decode_update(update)  # stateless: outside the lock
             with self._round_lock:
                 stale = (up_round is not None
                          and int(up_round) != self.round_idx)
@@ -447,6 +500,7 @@ class FedMLServerManager(FedMLCommManager):
                                                      "method", None))
             self.history.append(rec)
             mlops.log_round_info(self.round_num, completed_round)
+            self._save_wire_state(completed_round)
         self._end_round_trace(reported=len(self._round_selected),
                               wire_bytes=rec["wire_bytes"])
         if self.round_idx >= self.round_num:
@@ -454,10 +508,26 @@ class FedMLServerManager(FedMLCommManager):
             return
         self.sync_model_to_clients()
 
+    def _round_ratio(self) -> Optional[float]:
+        """The adaptive schedule's keep-ratio for the round about to
+        broadcast (None when the knob is off — nothing rides the wire)."""
+        if self._cc_adaptive is None:
+            return None
+        return adaptive_keep_ratio(
+            self._cc_adaptive,
+            getattr(self.aggregator, "silo_stats", None),
+            self._round_targets or sorted(self.client_online_status))
+
     def _sync_payload(self):
         """Build the per-round sync payload once (shared by every client):
         list of (param_key, value) pairs added to each sync message."""
         spec = self.cc_spec
+        ratio = self._round_ratio()
+        extra = []
+        if ratio is not None:
+            import dataclasses
+            spec = dataclasses.replace(spec, ratio=ratio)
+            extra = [(MyMessage.MSG_ARG_KEY_CC_RATIO, float(ratio))]
         if (spec is not None and spec.broadcast == "compress"
                 and self._bcast_prev_vec is not None):
             # ship the compressed delta of the global model vs what the
@@ -470,12 +540,15 @@ class FedMLServerManager(FedMLCommManager):
             # is BIT-identical to theirs — the algebraic shortcut
             # (comp - residual) is not bit-exact in f32 and would let
             # the bases drift apart by an accumulating rounding gap
-            gvec = self._global_f32_vec()
-            blob, self._bcast_residual = ef_compress_vec(
-                gvec - self._bcast_prev_vec, self._bcast_residual, spec,
-                jax.random.fold_in(self._cc_rng, self.round_idx))
-            self._bcast_prev_vec = self._bcast_prev_vec + decompress_vec(blob)
-            return [(MyMessage.MSG_ARG_KEY_MODEL_UPDATE, blob)]
+            enc = encode_update(
+                self._global_f32_vec(), base=self._bcast_prev_vec,
+                spec=spec, residual=self._bcast_residual,
+                rng=jax.random.fold_in(self._cc_rng, self.round_idx),
+                msg_type=MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+            self._bcast_residual = enc.residual
+            self._bcast_prev_vec = decode_update(enc.payload,
+                                                 base=self._bcast_prev_vec)
+            return [(MyMessage.MSG_ARG_KEY_MODEL_UPDATE, enc.payload)] + extra
         if spec is not None and spec.broadcast == "bf16":
             wire = tree_to_wire_bf16(self.aggregator.global_params)
             if spec.method is not None:
@@ -489,14 +562,15 @@ class FedMLServerManager(FedMLCommManager):
                     bf16_wire_to_tree(wire, self.aggregator.global_params)),
                     np.float32)
             return [(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire),
-                    (MyMessage.MSG_ARG_KEY_WIRE_DTYPE, WIRE_DTYPE_BF16)]
+                    (MyMessage.MSG_ARG_KEY_WIRE_DTYPE, WIRE_DTYPE_BF16)] \
+                + extra
         if spec is not None and spec.method is not None:
             # dense 'full' broadcast with compressed uplinks: the clients
             # will train from (and delta against) the exact f32 global —
             # refresh the tracked base now, before any client can reply
             self._bcast_prev_vec = self._global_f32_vec()
         return [(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                 tree_to_wire(self.aggregator.global_params))]
+                 tree_to_wire(self.aggregator.global_params))] + extra
 
     def sync_model_to_clients(self) -> None:
         self._begin_round_trace()
@@ -574,4 +648,9 @@ class FedMLServerManager(FedMLCommManager):
                        "final_test_acc": last_eval.get("test_acc"),
                        "rounds": self.round_num}
         mlops.log_aggregation_status("FINISHED")
+        # flush pending async wire-state saves before teardown — an
+        # unawaited orbax commit races interpreter shutdown and loses
+        # the final round's residual state
+        if self._wire_ckpt is not None:
+            self._wire_ckpt.close()
         self.finish()

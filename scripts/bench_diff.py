@@ -71,14 +71,22 @@ from typing import Dict, List, Optional, Tuple
 # `violations` covers fairness_violations and `overlap` the
 # overlap_devices isolation column — both must stay pinned at 0, so any
 # increase is a regression (DOWN).
+# wire-pipeline additions (ISSUE 19): the cross-silo wire bench's
+# secagg_compressed/gossip_compressed legs ride `bytes` (DOWN — the
+# masked/N2N wire must stay shrunk); `reduction` covers their
+# reduction_vs_* ratio columns (UP — HIGHER wins the probe before the
+# `bytes` substring in reduction_vs_dense_field can read it DOWN) and
+# `rounds_to` the rounds_to_target trajectory gates (DOWN — compression
+# that costs convergence rounds is a regression, the ±2-round
+# acceptance bound).
 HIGHER_MARKERS = ("per_s", "per_hour", "mfu", "acc", "tokens", "speedup",
                   "goodput", "success", "hit_rate", "hits", "reused",
-                  "efficiency", "swaps", "attributed")
+                  "efficiency", "swaps", "attributed", "reduction")
 LOWER_MARKERS = ("seconds", "bytes", "latency", "recompiles", "compiles",
                  "time_to", "step_time", "wall", "round_s",
                  "resets", "trips", "faults", "fragmentation", "ttft",
                  "bound_share", "_ms", "overhead", "scale_events", "drops",
-                 "violations", "overlap")
+                 "violations", "overlap", "rounds_to")
 
 
 def _wrapper_rc(path: str) -> Optional[int]:

@@ -1,0 +1,534 @@
+"""Unified wire pipeline (core/wire, ISSUE 19): lane-packed field
+quantization round-trip bounds, overflow-safe K-lane sums below p,
+mask-then-sum == sum-then-unmask bit-exactness, the adaptive keep-ratio
+schedule, the per-stage byte ledger, wire-state checkpoint resume
+parity, and knob-off byte-identity on the gossip and cross-device
+transports (the sync cross-silo pins live in test_comm_compression)."""
+
+import tempfile
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu.arguments import Arguments
+from fedml_tpu.core.distributed.communication.message import WIRE_STATS
+from fedml_tpu.core.mpc import P, expand_mask
+from fedml_tpu.core.selection.stats import ClientStatsStore
+from fedml_tpu.core.wire import (AdaptiveRatioBounds, EncodedUpdate,
+                                 LanePlan, adaptive_keep_ratio,
+                                 decode_update, encode_update, field_encode,
+                                 lane_dequantize_sum, lane_pack,
+                                 lane_quantize, lane_unpack_sum, mask_packed,
+                                 pack_optional_vec, plan_for, suggest_scale,
+                                 unpack_optional_vec, wire_checkpointer,
+                                 wire_state_template)
+from fedml_tpu.utils.compression import CommCompressionSpec
+
+pytestmark = pytest.mark.wire
+
+
+def make_args(**kw):
+    base = dict(dataset="synthetic_mnist", model="lr",
+                client_num_in_total=4, client_num_per_round=4,
+                comm_round=3, epochs=1, batch_size=32, learning_rate=0.1,
+                random_seed=13, training_type="cross_silo")
+    base.update(kw)
+    return Arguments(**base)
+
+
+# ---------------------------------------------------------------------------
+# lane plan geometry
+# ---------------------------------------------------------------------------
+
+class TestLanePlan:
+    @pytest.mark.parametrize("bits,k_max,width,lanes", [
+        (4, 4, 6, 5),     # the bench leg: 0.8 B/coord
+        (4, 16, 8, 3),
+        (8, 16, 12, 2),
+        (16, 8, 19, 1),
+    ])
+    def test_geometry(self, bits, k_max, width, lanes):
+        plan = plan_for(bits, k_max)
+        assert plan.width == width and plan.lanes == lanes
+        assert plan.bytes_per_coord() == pytest.approx(4.0 / lanes)
+        # headroom invariant: a full lane sum never reaches the next lane
+        assert k_max * ((1 << bits) - 1) <= (1 << width) - 1
+        # and the packed budget stays under the field prime
+        assert plan.lanes * plan.width <= 30
+
+    def test_packed_len_ceil(self):
+        plan = plan_for(4, 4)   # 5 lanes
+        assert plan.packed_len(10) == 2
+        assert plan.packed_len(11) == 3
+
+    def test_invalid_plans_raise(self):
+        with pytest.raises(ValueError):
+            plan_for(5, 4)          # bits not in (4, 8, 16)
+        with pytest.raises(ValueError):
+            plan_for(4, 0)          # k_max < 1
+        with pytest.raises(ValueError):
+            plan_for(16, 1 << 15)   # width 31 > 30-bit budget
+
+    def test_wire_roundtrip(self):
+        plan = plan_for(8, 16)
+        assert LanePlan.from_wire(plan.to_wire()) == plan
+
+
+# ---------------------------------------------------------------------------
+# quantization round-trip + overflow safety
+# ---------------------------------------------------------------------------
+
+class TestLaneQuant:
+    @pytest.mark.parametrize("bits", [4, 8, 16])
+    def test_roundtrip_error_bound(self, bits):
+        """Stochastic rounding without clipping: per-coordinate error
+        strictly below one quantization step."""
+        plan = plan_for(bits, 4)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=257).astype(np.float32)
+        scale = suggest_scale(float(np.abs(x).max()), plan)
+        packed, residual = lane_quantize(x, scale, plan,
+                                         np.random.default_rng(1))
+        dec = lane_dequantize_sum(packed, 1, scale, plan, x.shape[0])
+        assert np.max(np.abs(dec - x)) < scale + 1e-6
+        # the residual IS the quantization error, exactly
+        np.testing.assert_allclose(residual, x - dec, atol=1e-6)
+
+    def test_residual_algebra_with_ef_carry(self):
+        """field_encode: scale*q + new_residual == delta + old_residual
+        (error feedback loses nothing)."""
+        plan = plan_for(4, 4)
+        rng = np.random.default_rng(2)
+        delta = rng.normal(size=100).astype(np.float32)
+        old = rng.normal(scale=0.1, size=100).astype(np.float32)
+        scale = suggest_scale(4.0, plan)
+        packed, new = field_encode(delta, scale, plan, old,
+                                   np.random.default_rng(3))
+        dec = lane_dequantize_sum(packed, 1, scale, plan, 100)
+        np.testing.assert_allclose(dec + new, delta + old, atol=1e-5)
+
+    def test_tail_padding_decodes_to_zero(self):
+        plan = plan_for(4, 4)   # 5 lanes: d=7 pads 3 tail lanes
+        u = np.full(7, plan.offset + 3, np.uint64)
+        packed = lane_pack(u, plan)
+        s = lane_unpack_sum(packed.astype(np.uint64), 1, plan, 7)
+        assert np.all(s == 3)
+        # the padded lanes (coords 7..9 of the 2 words) decode to 0
+        full = lane_unpack_sum(packed.astype(np.uint64), 1, plan, 10)
+        assert np.all(full[7:] == 0)
+
+    @pytest.mark.parametrize("bits,k_max", [(4, 4), (4, 16), (8, 16)])
+    def test_worst_case_k_sum_below_p(self, bits, k_max):
+        """All-qmax vectors from k_max clients: the packed integer sum
+        stays below 2**30 < p (no mod-p wrap, no lane carry)."""
+        plan = plan_for(bits, k_max)
+        d = 64
+        u = np.full(d, plan.offset + plan.qmax, np.uint64)  # max encoding
+        packed = lane_pack(u, plan).astype(np.uint64)
+        total = packed * np.uint64(k_max)                   # exact int sum
+        assert int(total.max()) < 2**30 < P
+        s = lane_unpack_sum(total, k_max, plan, d)
+        assert np.all(s == k_max * plan.qmax)
+
+    def test_unpack_rejects_k_above_plan(self):
+        plan = plan_for(4, 4)
+        with pytest.raises(ValueError, match="k_max"):
+            lane_unpack_sum(np.zeros(4, np.uint64), 5, plan, 16)
+
+
+# ---------------------------------------------------------------------------
+# mask-then-sum == sum-then-unmask (the SecAgg-compatibility property)
+# ---------------------------------------------------------------------------
+
+class TestMaskedSum:
+    @pytest.mark.parametrize("bits,k", [(4, 4), (4, 16), (8, 16), (16, 8)])
+    def test_bit_exact_mask_cancellation(self, bits, k):
+        plan = plan_for(bits, k)
+        d = 131
+        plen = plan.packed_len(d)
+        rng = np.random.default_rng(bits * 100 + k)
+        scale = suggest_scale(4.0, plan)
+        packs = []
+        for i in range(k):
+            vec = rng.normal(size=d).astype(np.float32) * 2.0
+            packed, _ = field_encode(vec, scale, plan, None,
+                                     np.random.default_rng(1000 + i))
+            packs.append(packed.astype(np.uint64))
+        # pairwise masks with integer seeds; +s_ij for i<j, -s_ij else
+        masked_total = np.zeros(plen, np.uint64)
+        plain_total = np.zeros(plen, np.uint64)
+        for i in range(k):
+            m = packs[i] % P
+            for j in range(k):
+                if i == j:
+                    continue
+                s = expand_mask((min(i, j) << 8) ^ max(i, j),
+                                plen).astype(np.uint64)
+                m = (m + s) % P if i < j else (m + P - s) % P
+            masked_total = (masked_total + m) % P
+            plain_total = (plain_total + packs[i]) % P
+        # masks cancel bit-for-bit...
+        assert np.array_equal(masked_total, plain_total)
+        # ...and the decoded sum is bit-identical either way
+        a = lane_dequantize_sum(masked_total, k, scale, plan, d)
+        b = lane_dequantize_sum(plain_total, k, scale, plan, d)
+        assert np.array_equal(a, b)
+
+    def test_mask_packed_helper_roundtrip(self):
+        plan = plan_for(4, 4)
+        rng = np.random.default_rng(7)
+        vec = rng.normal(size=50).astype(np.float32)
+        scale = suggest_scale(4.0, plan)
+        packed, _ = field_encode(vec, scale, plan, None,
+                                 np.random.default_rng(8))
+        plen = packed.shape[0]
+        mask = expand_mask(12345, plen).astype(np.uint64)
+        masked = mask_packed(packed, mask)
+        unmasked = (masked.astype(np.uint64) + np.uint64(P) - mask) \
+            % np.uint64(P)
+        assert np.array_equal(unmasked.astype(np.uint32), packed)
+
+
+# ---------------------------------------------------------------------------
+# adaptive keep-ratio schedule
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveRatio:
+    def bounds(self, **kw):
+        base = dict(ratio_min=0.02, ratio_max=0.2, latency_budget_s=10.0)
+        base.update(kw)
+        return AdaptiveRatioBounds(**base)
+
+    def test_invalid_bounds_raise(self):
+        with pytest.raises(ValueError):
+            AdaptiveRatioBounds(0.0, 0.1)
+        with pytest.raises(ValueError):
+            AdaptiveRatioBounds(0.5, 0.1)
+        with pytest.raises(ValueError):
+            AdaptiveRatioBounds(0.1, 0.5, latency_budget_s=0.0)
+
+    def test_no_stats_is_ratio_max(self):
+        b = self.bounds()
+        assert adaptive_keep_ratio(b, None, [1, 2]) == b.ratio_max
+        assert adaptive_keep_ratio(b, ClientStatsStore(4), []) \
+            == b.ratio_max
+
+    def test_unobserved_cohort_is_ratio_max(self):
+        stats = ClientStatsStore(8)
+        assert adaptive_keep_ratio(self.bounds(), stats, [1, 2, 3]) \
+            == self.bounds().ratio_max
+
+    def test_latency_pressure_tightens_ratio(self):
+        b = self.bounds()
+        stats = ClientStatsStore(8)
+        stats.record_latency(2, 5.0)            # half the budget
+        mid = adaptive_keep_ratio(b, stats, [1, 2, 3])
+        assert b.ratio_min < mid < b.ratio_max
+        stats.record_latency(3, 50.0)           # way over budget: clamps
+        assert adaptive_keep_ratio(b, stats, [1, 2, 3]) == b.ratio_min
+
+    def test_dropout_pressure_tightens_ratio(self):
+        b = self.bounds(latency_budget_s=None)
+        stats = ClientStatsStore(8)
+        for _ in range(30):
+            stats.record_availability(1, participated=False)
+        assert adaptive_keep_ratio(b, stats, [1, 2]) < b.ratio_max
+
+    def test_deterministic(self):
+        stats = ClientStatsStore(8)
+        stats.record_latency(1, 3.0)
+        b = self.bounds()
+        assert adaptive_keep_ratio(b, stats, [1, 2]) \
+            == adaptive_keep_ratio(b, stats, [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# the encode seam + per-stage byte ledger
+# ---------------------------------------------------------------------------
+
+class TestEncodeSeam:
+    def test_knob_off_is_noop(self):
+        vec = np.ones(16, np.float32)
+        res_in = np.zeros(16, np.float32)
+        enc = encode_update(vec, spec=None, residual=res_in)
+        assert isinstance(enc, EncodedUpdate)
+        assert enc.payload is None and enc.payload_bytes == 0
+        assert enc.residual is res_in           # untouched, not copied
+        assert enc.raw_bytes == vec.nbytes
+
+    def test_delta_roundtrip_with_base(self):
+        import jax
+        spec = CommCompressionSpec(method="topk_qsgd", ratio=0.5)
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=64).astype(np.float32)
+        vec = base + rng.normal(scale=0.1, size=64).astype(np.float32)
+        enc = encode_update(vec, base=base, spec=spec,
+                            rng=jax.random.PRNGKey(0))
+        assert enc.payload is not None and enc.payload_bytes > 0
+        out = decode_update(enc.payload, base=base)
+        # EF residual holds exactly what the wire dropped
+        np.testing.assert_allclose(out + enc.residual, vec, atol=1e-5)
+
+    def test_decode_rejects_dense(self):
+        with pytest.raises(ValueError):
+            decode_update({"not": "a blob"})
+
+    def test_stage_ledger_by_msg_type(self):
+        import jax
+        WIRE_STATS.reset()
+        spec = CommCompressionSpec(method="topk_qsgd", ratio=0.25)
+        vec = np.random.default_rng(1).normal(size=100).astype(np.float32)
+        encode_update(vec, spec=spec, rng=jax.random.PRNGKey(0),
+                      msg_type=3)
+        snap = WIRE_STATS.snapshot()["by_stage"]
+        rec = snap.get("3", snap.get(3))
+        assert rec["raw"] == 400
+        assert 0 < rec["sparsified"] < rec["raw"]
+        WIRE_STATS.reset()
+        assert WIRE_STATS.snapshot()["by_stage"] == {}
+
+
+# ---------------------------------------------------------------------------
+# wire-state checkpointing: resume == uninterrupted
+# ---------------------------------------------------------------------------
+
+def _client_manager_stub(tmpdir, d=32):
+    """A ClientMasterManager carrying only the wire-state attrs (the
+    repo's __new__ idiom for FSM-free unit tests)."""
+    from fedml_tpu.cross_silo.client.fedml_client_master_manager import \
+        ClientMasterManager
+    m = ClientMasterManager.__new__(ClientMasterManager)
+    m.rank = 1
+    m.round_idx = 0
+    m._cc_residual = None
+    m._global_vec = None
+    m.trainer = types.SimpleNamespace(
+        params_to_vec=lambda t: np.asarray(t, np.float32),
+        params_template=np.zeros(d, np.float32))
+    m._wire_ckpt = wire_checkpointer(
+        make_args(checkpoint_dir=tmpdir, checkpoint_every_rounds=1),
+        "client_1")
+    return m
+
+
+class TestWireCheckpoint:
+    def test_optional_vec_pack_roundtrip(self):
+        f, a = pack_optional_vec(None, 4)
+        assert unpack_optional_vec(f, a) is None
+        v = np.arange(4, dtype=np.float32)
+        f, a = pack_optional_vec(v, 4)
+        np.testing.assert_array_equal(unpack_optional_vec(f, a), v)
+
+    def test_checkpointer_off_without_knobs(self):
+        assert wire_checkpointer(make_args(), "client_1") is None
+        assert wire_checkpointer(
+            make_args(checkpoint_dir="/tmp/x"), "s") is None
+
+    def test_client_resume_matches_uninterrupted(self, tmp_path):
+        """The satellite pin: a client whose wire state is restored from
+        the checkpoint produces the SAME compressed uplinks as one that
+        never crashed — EF residual and broadcast base both survive."""
+        import jax
+        d = 32
+        spec = CommCompressionSpec(method="topk_qsgd", ratio=0.25)
+        rng = np.random.default_rng(5)
+        globals_ = [rng.normal(size=d).astype(np.float32)
+                    for _ in range(4)]
+        trained = [g + rng.normal(scale=0.1, size=d).astype(np.float32)
+                   for g in globals_]
+
+        def run_rounds(mgr, start, stop):
+            blobs = []
+            for r in range(start, stop):
+                mgr.round_idx = r
+                mgr._global_vec = globals_[r]
+                enc = encode_update(trained[r], base=mgr._global_vec,
+                                    spec=spec, residual=mgr._cc_residual,
+                                    rng=jax.random.fold_in(
+                                        jax.random.PRNGKey(97), r))
+                mgr._cc_residual = enc.residual
+                blobs.append(enc.payload)
+                mgr._save_wire_state()
+            return blobs
+
+        uninterrupted = _client_manager_stub(str(tmp_path / "a"), d)
+        blobs_a = run_rounds(uninterrupted, 0, 4)
+        uninterrupted._wire_ckpt.close()
+
+        crashed = _client_manager_stub(str(tmp_path / "b"), d)
+        blobs_b = run_rounds(crashed, 0, 2)
+        crashed._wire_ckpt.close()           # "crash" after round 1 save
+        resumed = _client_manager_stub(str(tmp_path / "b"), d)
+        resumed._restore_wire_state()
+        np.testing.assert_array_equal(resumed._cc_residual,
+                                      crashed._cc_residual)
+        blobs_b += run_rounds(resumed, 2, 4)
+        resumed._wire_ckpt.close()
+
+        for a, b in zip(blobs_a, blobs_b):
+            assert set(a) == set(b)
+            for key in ("v", "i"):
+                if key in a:
+                    np.testing.assert_array_equal(a[key], b[key])
+
+    def test_async_ef_carry_roundtrip(self, tmp_path):
+        """The async server's per-sender pour residuals survive a
+        save/restore cycle (versions, vectors, compressed-sender set)."""
+        from fedml_tpu.cross_silo.server.async_server import \
+            AsyncFedMLServerManager
+
+        d = 16
+        args = make_args(checkpoint_dir=str(tmp_path),
+                         checkpoint_every_rounds=1)
+
+        def stub():
+            m = AsyncFedMLServerManager.__new__(AsyncFedMLServerManager)
+            m.args = args
+            m.client_num = 4
+            m.aggregator = types.SimpleNamespace(
+                _base_ring={0: np.zeros(d, np.float32)},
+                _ef_carry={}, _compressed_senders=set(), version=0)
+            m._wire_ckpt = wire_checkpointer(args, "async_server")
+            return m
+
+        saver = stub()
+        carry = np.arange(d, dtype=np.float32)
+        saver.aggregator._ef_carry = {2: (3, carry)}
+        saver.aggregator._compressed_senders = {1, 2}
+        saver._save_wire_state(5)
+        saver._wire_ckpt.close()
+
+        loader = stub()
+        loader._restore_wire_state()
+        loader._wire_ckpt.close()
+        assert loader.aggregator._compressed_senders == {1, 2}
+        assert set(loader.aggregator._ef_carry) == {2}
+        cv, cres = loader.aggregator._ef_carry[2]
+        assert cv == 3
+        np.testing.assert_array_equal(cres, carry)
+
+
+# ---------------------------------------------------------------------------
+# defended async pour: excluded compressed rows re-enter via the carry
+# ---------------------------------------------------------------------------
+
+class TestAsyncEFCarry:
+    def test_excluded_row_carried_and_rebased(self):
+        """A defense-excluded compressed sender's re-based row is stored,
+        re-based across the server movement it missed, and folded into
+        the sender's next row before the next defense pass."""
+        from fedml_tpu.cross_silo.server.async_server import \
+            AsyncFedMLAggregator
+
+        d = 8
+        agg = AsyncFedMLAggregator.__new__(AsyncFedMLAggregator)
+        agg._ef_carry = {}
+        agg._compressed_senders = {1}
+        base0 = np.zeros(d, np.float32)
+        base1 = np.full(d, 0.5, np.float32)
+        agg._base_ring = {0: base0, 1: base1}
+        agg.version = 1
+        # simulate the pour bookkeeping: the row excluded at version 0
+        row = np.full(d, 2.0, np.float32)
+        agg._ef_carry[1] = (0, row)
+        # re-base to version 1 exactly as the pour does
+        base = agg._base_ring[agg.version]
+        cv, cres = agg._ef_carry.pop(1)
+        rebased = cres - (base - agg.base_for(cv))
+        # stored row satisfied base0 + row = target; the re-based one
+        # must satisfy base1 + rebased = the same target
+        np.testing.assert_allclose(base + rebased, base0 + row)
+
+
+# ---------------------------------------------------------------------------
+# refused combinations fail fast (README compatibility matrix)
+# ---------------------------------------------------------------------------
+
+class TestRefusedCombos:
+    def test_secagg_refuses_sparsifiers(self):
+        pytest.importorskip("cryptography")
+        from fedml_tpu.cross_silo.secagg import _refuse_sparsified_wire
+        with pytest.raises(ValueError, match="support sets"):
+            _refuse_sparsified_wire(make_args(comm_compression="topk"))
+        _refuse_sparsified_wire(make_args())          # knob off: fine
+        _refuse_sparsified_wire(make_args(secagg_compress_bits=4))  # lanes ok
+
+    def test_lightsecagg_refuses_wire_compression(self):
+        pytest.importorskip("cryptography")
+        from fedml_tpu.cross_silo.lightsecagg import \
+            _refuse_wire_compression
+        with pytest.raises(ValueError, match="incompatible"):
+            _refuse_wire_compression(make_args(secagg_compress_bits=4))
+        with pytest.raises(ValueError, match="incompatible"):
+            _refuse_wire_compression(make_args(comm_compression="topk"))
+        _refuse_wire_compression(make_args())
+
+
+# ---------------------------------------------------------------------------
+# knob-off byte identity per transport (session level)
+# ---------------------------------------------------------------------------
+
+class TestKnobOffByteIdentity:
+    _dense_gossip = None   # memoized across tests: 3 sessions, not 4
+
+    def _gossip_bytes(self, **kw):
+        from fedml_tpu import data as data_mod
+        from fedml_tpu import model as model_mod
+        from fedml_tpu.cross_silo.decentralized import run_gossip_inproc
+        args = make_args(comm_round=2, client_num_in_total=3,
+                         client_num_per_round=3, **kw)
+        fed, od = data_mod.load(args)
+        bundle = model_mod.create(args, od)
+        WIRE_STATS.reset()
+        result = run_gossip_inproc(args, fed, bundle)
+        snap = WIRE_STATS.snapshot()
+        return snap, result
+
+    def _dense(self):
+        if TestKnobOffByteIdentity._dense_gossip is None:
+            TestKnobOffByteIdentity._dense_gossip = self._gossip_bytes()
+        return TestKnobOffByteIdentity._dense_gossip
+
+    def test_gossip_knob_off_byte_identical_and_unstaged(self):
+        snap1, r1 = self._dense()
+        snap2, r2 = self._gossip_bytes(gossip_compression=None)
+        # byte-for-byte identical wire, nothing enters the pipeline
+        assert snap1["by_type"] == snap2["by_type"]
+        assert snap1["by_stage"] == {} and snap2["by_stage"] == {}
+        assert r1["final_test_acc"] == r2["final_test_acc"]
+
+    def test_gossip_knob_on_shrinks_n2n(self):
+        snap_off, _ = self._dense()
+        snap_on, r_on = self._gossip_bytes(gossip_compression="topk_qsgd",
+                                           comm_compression_ratio=0.1)
+        key = next(k for k in snap_off["by_type"] if str(k) == "301")
+        assert snap_on["by_type"][key]["bytes"] \
+            < snap_off["by_type"][key]["bytes"]
+        assert snap_on["by_stage"]            # the ledger saw the stages
+        assert r_on["final_test_acc"] is not None
+
+    def test_cross_device_knob_off_artifacts_dense(self, tmp_path):
+        from fedml_tpu import data as data_mod
+        from fedml_tpu import model as model_mod
+        from fedml_tpu.cross_device.runner import run_cross_device_inproc
+
+        def session(subdir, **kw):
+            args = make_args(training_type="cross_device", comm_round=2,
+                             client_num_in_total=2, client_num_per_round=2,
+                             model_file_cache_dir=str(tmp_path / subdir),
+                             **kw)
+            fed, od = data_mod.load(args)
+            bundle = model_mod.create(args, od)
+            WIRE_STATS.reset()
+            result = run_cross_device_inproc(args, fed, bundle)
+            return WIRE_STATS.snapshot(), result
+
+        snap_off, r_off = session("off")
+        assert snap_off["by_stage"] == {}     # dense artifacts: no stages
+        snap_on, r_on = session("on", device_wire_compression="topk_qsgd",
+                                comm_compression_ratio=0.1)
+        rec = snap_on["by_stage"].get("d2s_model")
+        assert rec and 0 < rec["sparsified"] < rec["raw"]
+        assert r_off["final_test_acc"] is not None
+        assert r_on["final_test_acc"] is not None
